@@ -1,0 +1,29 @@
+"""dist_svgd_tpu — a TPU-native framework for distributed Stein Variational
+Gradient Descent (SVGD).
+
+Brand-new JAX/XLA/pjit design with the capabilities of the reference
+implementation `Sandy4321/dist-svgd` (see SURVEY.md):
+
+- `Sampler`        — single-device SVGD sampler (reference: dsvgd/sampler.py:6-74)
+- `DistSampler`    — sharded SVGD over a TPU mesh with three exchange modes
+                     (reference: dsvgd/distsampler.py:8-205)
+- `ops`            — fused kernel/φ/step primitives (jit/vmap, analytic ∇k)
+                     and the Wasserstein/JKO term (host LP + on-device Sinkhorn)
+- `models`         — GMM and Bayesian logistic regression log-densities
+- `parallel`       — mesh utilities + SPMD exchange strategies
+- `utils`          — datasets, history recording, RNG helpers
+
+Where the reference evaluates k(x, y) and its autograd one particle-pair at a
+time in Python loops, this framework computes each SVGD step as a single fused
+XLA program over an HBM-resident (n, d) particle array and shards particles
+across a `jax.sharding.Mesh` with `lax.all_gather` / `lax.psum` /
+`lax.ppermute` collectives.
+"""
+
+from dist_svgd_tpu.sampler import Sampler
+from dist_svgd_tpu.distsampler import DistSampler
+from dist_svgd_tpu.ops.kernels import RBF, median_bandwidth
+
+__version__ = "0.1.0"
+
+__all__ = ["Sampler", "DistSampler", "RBF", "median_bandwidth", "__version__"]

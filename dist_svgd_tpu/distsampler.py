@@ -1,0 +1,289 @@
+"""Sharded SVGD sampler over a TPU mesh.
+
+TPU-native counterpart of the reference's ``DistSampler``
+(dsvgd/distsampler.py:8-205).  The reference runs one Python process per rank,
+each owning a particle block and a data slice, exchanging state through
+``torch.distributed`` collectives.  Here a *single* SPMD program drives the
+whole mesh: the global ``(n, d)`` particle array is sharded along a 1-D mesh
+axis, and every exchange strategy is a collective inside one jitted step
+(``lax.all_gather`` / ``lax.psum`` / data-rotation for the ring — see
+``parallel/exchange.py``).  When the host has fewer devices than shards the
+identical per-shard code runs under ``vmap(axis_name=...)`` — exact semantics,
+one device.
+
+Reference parity notes (SURVEY.md §7.4):
+
+- particles not divisible by ``num_shards`` are dropped, like
+  dsvgd/distsampler.py:42-45; same for data rows (experiments/logreg.py:35).
+- the update is Jacobi (simultaneous) rather than the reference's in-place
+  Gauss–Seidel sweep — deliberate, documented deviation with the same fixed
+  point (SURVEY.md §3.2).
+- the Wasserstein ``previous_particles`` snapshot reproduces the reference's
+  exact (warty) semantics: in exchanged modes each rank's "previous" set is
+  the all-gathered array with only *its own* block post-update
+  (dsvgd/distsampler.py:202-205 snapshots ``self._particles``, whose other
+  blocks are stale pre-update values from that step's gather); in
+  ``partitions`` mode each rank snapshots the block it just updated and next
+  step compares the *newly adopted* block against it, which under the
+  data-rotation formulation pairs device ``b``'s block with the snapshot of
+  block ``(b+1) mod S``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_svgd_tpu.ops.kernels import RBF
+from dist_svgd_tpu.ops.ot import wasserstein_grad_lp, wasserstein_grad_sinkhorn
+from dist_svgd_tpu.parallel.exchange import (
+    ALL_PARTICLES,
+    ALL_SCORES,
+    PARTITIONS,
+    make_shard_step,
+)
+from dist_svgd_tpu.parallel.mesh import AXIS, bind_shard_fn, make_mesh
+
+
+def _data_rows(data) -> int:
+    leaves = jax.tree_util.tree_leaves(data)
+    return leaves[0].shape[0] if leaves else 0
+
+
+class DistSampler:
+    """Distributed SVGD sampler.
+
+    Args:
+        num_shards: mesh size S (the reference's world size).  The reference's
+            per-process ``rank`` argument has no SPMD counterpart — one program
+            owns all shards.
+        logp: ``logp(theta, data_local)`` scalar log-density where
+            ``data_local`` is the shard's slice of ``data`` (or ``None``).
+            This replaces the reference's per-rank closure
+            ``lambda x: logp(rank, x)`` (experiments/logreg.py:68).
+        kernel: kernel for :func:`dist_svgd_tpu.ops.svgd.phi`; ``None`` means
+            the reference's ``RBF(bandwidth=1)``.
+        particles: ``(n, d)`` global initial particle array.  Truncated to
+            ``S · (n // S)`` rows (reference drop policy).
+        data: optional pytree of arrays with a common leading data axis.
+            Replicated to every device and sliced per-shard, matching the
+            reference where every rank loads the full dataset and slices its
+            contiguous block (experiments/logreg.py:28,41-51).
+        N_local / N_global: importance-scaling sizes; derived from ``data``
+            when omitted (``N_local = N // S`` rows per shard, remainder
+            dropped).  The ``N_global / N_local`` factor is applied exactly
+            where the reference applies it: on scores that were *not*
+            all-reduced (dsvgd/distsampler.py:96-99).
+        exchange_particles / exchange_scores: strategy flags with the
+            reference's constraint (scores ⇒ particles,
+            dsvgd/distsampler.py:26).  (True, True) = ``all_scores``,
+            (True, False) = ``all_particles``, (False, False) =
+            ``partitions``.
+        include_wasserstein: add the W2/JKO proximal term each step.
+        wasserstein_solver: ``'lp'`` (host LP, exact reference parity) or
+            ``'sinkhorn'`` (on-device entropic OT, jit-fused fast path).
+        mesh: ``'auto'`` (build a real mesh if the host has ≥ S devices, else
+            vmap emulation), an explicit ``jax.sharding.Mesh``, or ``None``
+            to force emulation.
+    """
+
+    def __init__(
+        self,
+        num_shards: int,
+        logp: Callable,
+        kernel,
+        particles,
+        data=None,
+        N_local: Optional[int] = None,
+        N_global: Optional[int] = None,
+        exchange_particles: bool = True,
+        exchange_scores: bool = True,
+        include_wasserstein: bool = True,
+        wasserstein_solver: str = "lp",
+        sinkhorn_eps: float = 0.05,
+        sinkhorn_iters: int = 200,
+        mesh="auto",
+    ):
+        assert not (exchange_scores and not exchange_particles), (
+            "must exchange particles to also exchange scores"
+        )
+        if wasserstein_solver not in ("lp", "sinkhorn"):
+            raise ValueError(f"unknown wasserstein_solver {wasserstein_solver!r}")
+
+        self._num_shards = int(num_shards)
+        self._logp = logp
+        self._kernel = kernel if kernel is not None else RBF(1.0)
+        self._exchange_particles = exchange_particles
+        self._exchange_scores = exchange_scores
+        self._include_wasserstein = include_wasserstein
+        self._wasserstein_solver = wasserstein_solver
+        self._sinkhorn_eps = sinkhorn_eps
+        self._sinkhorn_iters = sinkhorn_iters
+
+        particles = jnp.asarray(particles)
+        n = particles.shape[0]
+        self._particles_per_shard = n // self._num_shards
+        self._num_particles = self._particles_per_shard * self._num_shards
+        # NOTE: drops particles if not divisible by num_shards (reference
+        # behaviour, dsvgd/distsampler.py:42-45).
+        self._particles = particles[: self._num_particles]
+        self._d = particles.shape[1]
+
+        self._data = None if data is None else jax.tree_util.tree_map(jnp.asarray, data)
+        # Physical slice size per shard is always rows // S (reference drop
+        # policy); N_local/N_global are pure importance-scale factors like the
+        # reference's constructor args (dsvgd/distsampler.py:96-99), defaulting
+        # to the derived slice sizes.
+        rows = _data_rows(self._data) if self._data is not None else 0
+        self._rows_per_shard = rows // self._num_shards
+        self._N_local = int(N_local) if N_local is not None else self._rows_per_shard
+        if N_global is not None:
+            self._N_global = int(N_global)
+        else:
+            self._N_global = self._N_local * self._num_shards
+        if self._N_local:
+            self._score_scale = float(self._N_global) / float(self._N_local)
+        else:
+            self._score_scale = 1.0
+
+        if exchange_particles:
+            self._mode = ALL_SCORES if exchange_scores else ALL_PARTICLES
+        else:
+            self._mode = PARTITIONS
+
+        self._mesh = make_mesh(self._num_shards) if mesh == "auto" else mesh
+
+        step = make_shard_step(
+            logp=self._logp,
+            kernel=self._kernel,
+            mode=self._mode,
+            num_shards=self._num_shards,
+            n_local_data=self._rows_per_shard,
+            score_scale=self._score_scale,
+        )
+        self._step = jax.jit(
+            bind_shard_fn(
+                step,
+                self._num_shards,
+                self._mesh,
+                in_specs=(0, None, 0, None, None, None),
+                out_specs=(0,),
+            )
+        )
+
+        # Wasserstein "previous particles" state.  In exchanged modes this is
+        # a per-shard (S, n, d) stack (each shard's own warty mixed snapshot);
+        # in partitions mode a (S, n_loc, d) stack of owned-block snapshots;
+        # None until the first step, like the reference
+        # (dsvgd/distsampler.py:50, :186-188).
+        self._previous: Optional[np.ndarray] = None
+        self._t = 0  # make_step call counter (drives the partitions rotation)
+        self._sinkhorn_batched = None  # lazily-built jitted vmap solver
+
+    # ------------------------------------------------------------------ #
+    # State views
+
+    @property
+    def particles(self) -> jax.Array:
+        """Global ``(n, d)`` particle array, logical block order."""
+        return self._particles
+
+    @property
+    def num_particles(self) -> int:
+        return self._num_particles
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def owned_block(self, rank: int) -> jax.Array:
+        """The block currently updated against data shard ``rank`` — the SPMD
+        equivalent of the reference's per-rank ``.particles`` view
+        (dsvgd/distsampler.py:53-56 with the ring's rotating ownership
+        ranges, :148-150)."""
+        s = self._particles_per_shard
+        if self._mode == PARTITIONS:
+            b = (rank - self._t) % self._num_shards
+        else:
+            b = rank
+        return self._particles[b * s : (b + 1) * s]
+
+    # ------------------------------------------------------------------ #
+    # Wasserstein bookkeeping (host side; see module docstring for the
+    # reference's exact snapshot semantics being replicated)
+
+    def _blocks(self, arr) -> np.ndarray:
+        return np.asarray(arr).reshape(self._num_shards, self._particles_per_shard, self._d)
+
+    def _wasserstein_grad(self) -> jnp.ndarray:
+        """Per-shard W2 gradient, stacked to global ``(n, d)``."""
+        cur = self._blocks(self._particles)
+        grads = np.zeros_like(cur)
+        if self._mode == PARTITIONS and self._num_shards > 1:
+            # Device b's block pairs with the snapshot taken (last step) of
+            # block (b+1) mod S — the ring-ownership pairing, see module doc.
+            prev_for = np.roll(self._previous, -1, axis=0)
+        else:
+            prev_for = self._previous  # (S, n, d) mixed snapshots
+        if self._wasserstein_solver == "lp":
+            for b in range(self._num_shards):
+                grads[b] = wasserstein_grad_lp(cur[b], prev_for[b])
+            return jnp.asarray(grads.reshape(self._num_particles, self._d))
+        # sinkhorn: one jitted vmap over the stacked blocks — a single device
+        # call computes every shard's gradient (no per-block host round-trips)
+        if self._sinkhorn_batched is None:
+            self._sinkhorn_batched = jax.jit(
+                jax.vmap(
+                    lambda c, p: wasserstein_grad_sinkhorn(
+                        c, p, eps=self._sinkhorn_eps, iters=self._sinkhorn_iters
+                    )
+                )
+            )
+        out = self._sinkhorn_batched(jnp.asarray(cur), jnp.asarray(prev_for))
+        return out.reshape(self._num_particles, self._d)
+
+    def _snapshot_previous(self, pre_update: np.ndarray) -> None:
+        post = self._blocks(self._particles)
+        if self._mode == PARTITIONS and self._num_shards > 1:
+            self._previous = post.copy()  # owned-block snapshots
+        else:
+            pre_blocks = self._blocks(pre_update)
+            # Shard r's snapshot: gathered pre-update set with only its own
+            # block updated (reference dsvgd/distsampler.py:202-203).
+            prev = np.broadcast_to(
+                pre_blocks.reshape(1, self._num_particles, self._d),
+                (self._num_shards, self._num_particles, self._d),
+            ).copy()
+            s = self._particles_per_shard
+            for r in range(self._num_shards):
+                prev[r, r * s : (r + 1) * s] = post[r]
+            self._previous = prev
+
+    # ------------------------------------------------------------------ #
+
+    def make_step(self, step_size: float, h: float = 1.0) -> jax.Array:
+        """Perform one distributed SVGD step — reference API
+        (dsvgd/distsampler.py:172-205).  Returns the global particle array.
+        """
+        self._t += 1
+        dtype = self._particles.dtype
+        if self._include_wasserstein and self._previous is not None:
+            w_grad = self._wasserstein_grad().astype(dtype)
+        else:
+            w_grad = jnp.zeros_like(self._particles)
+
+        pre_update = np.asarray(self._particles) if self._include_wasserstein else None
+        self._particles = self._step(
+            self._particles,
+            self._data,
+            w_grad,
+            jnp.asarray(self._t, dtype=jnp.int32),
+            jnp.asarray(step_size, dtype=dtype),
+            jnp.asarray(h, dtype=dtype),
+        )
+        if self._include_wasserstein:
+            self._snapshot_previous(pre_update)
+        return self._particles

@@ -1,0 +1,15 @@
+"""Model log-densities (user-supplied closures in the reference; shipped here
+as a library of JAX-traceable builders)."""
+
+from dist_svgd_tpu.models.gmm import make_gmm_logp, gmm_logp
+from dist_svgd_tpu.models.logreg import (
+    make_logreg_logp,
+    posterior_predictive_prob,
+)
+
+__all__ = [
+    "make_gmm_logp",
+    "gmm_logp",
+    "make_logreg_logp",
+    "posterior_predictive_prob",
+]

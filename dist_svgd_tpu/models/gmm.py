@@ -1,0 +1,52 @@
+"""1-D Gaussian-mixture target — the reference's sanity-check model
+(experiments/gmm.py:14-21).
+
+Reference quirk, replicated deliberately (SURVEY.md §7.4): the comment at
+experiments/gmm.py:20 describes the mixture as ``1/3·p1 + 2/3·p2`` but the
+code weights *both* components 1/3.  Unnormalised densities are fine for
+scores (reference notes.md:1-8), and we replicate the CODE, not the comment.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+_LOG_SQRT_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+def _normal_logpdf(x, loc, scale):
+    z = (x - loc) / scale
+    return -0.5 * z * z - jnp.log(scale) - _LOG_SQRT_2PI
+
+
+def make_gmm_logp(
+    means: Sequence[float] = (-2.0, 2.0),
+    scales: Sequence[float] = (1.0, 1.0),
+    weights: Sequence[float] = (1.0 / 3.0, 1.0 / 3.0),
+):
+    """Build ``logp(theta)`` for a (possibly unnormalised) Gaussian mixture.
+
+    ``theta`` has shape ``(d,)``; dimensions are treated independently and
+    summed, so ``d=1`` reproduces the reference exactly.  The reference's
+    ``log(Σ_i w_i exp(logpdf_i))`` (experiments/gmm.py:19-21) is computed in
+    the numerically-stable logsumexp form — identical in exact arithmetic.
+    """
+    means_a = jnp.asarray(means)
+    scales_a = jnp.asarray(scales)
+    log_w = jnp.log(jnp.asarray(weights))
+
+    def logp(theta, data=None):
+        del data  # no dataset — the target density is the model
+        comp = log_w[:, None] + _normal_logpdf(theta[None, :], means_a[:, None], scales_a[:, None])
+        return jnp.sum(logsumexp(comp, axis=0))
+
+    return logp
+
+
+#: Reference-parity instance: mixture 1/3·N(-2,1) + 1/3·N(2,1)
+#: (experiments/gmm.py:16-21 — code weights, not comment weights).
+gmm_logp = make_gmm_logp()

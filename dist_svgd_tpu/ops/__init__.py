@@ -1,0 +1,21 @@
+"""Fused numerical primitives for SVGD on TPU."""
+
+from dist_svgd_tpu.ops.kernels import (
+    RBF,
+    kernel_matrix,
+    kernel_grad_matrix,
+    median_bandwidth,
+    squared_distances,
+)
+from dist_svgd_tpu.ops.svgd import phi, svgd_step, svgd_step_sequential
+
+__all__ = [
+    "RBF",
+    "kernel_matrix",
+    "kernel_grad_matrix",
+    "median_bandwidth",
+    "squared_distances",
+    "phi",
+    "svgd_step",
+    "svgd_step_sequential",
+]

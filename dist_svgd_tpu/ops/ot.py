@@ -1,0 +1,99 @@
+"""Wasserstein-2 / JKO proximal term.
+
+The reference adds an optional W2 gradient to each SVGD step
+(dsvgd/distsampler.py:103-129, applied at :190-198): solve the discrete-OT
+linear program between the current particles ``x`` (weights 1/m) and the
+previous step's particles ``y`` (weights 1/n) with cost ``‖x_i − y_j‖²``, then
+
+    w_grad_i = Σ_j  plan_ij · (x_i − y_j).
+
+Two solvers are provided:
+
+- :func:`wasserstein_grad_lp` — exact-parity path: the same dense LP the
+  reference builds, solved on the **host** with ``scipy.optimize.linprog``.
+  O((m+n)·m·n) constraint matrix — the reference's single biggest scalability
+  cliff (SURVEY.md §3.3); kept for fidelity and as the oracle for tests.
+- :func:`wasserstein_grad_sinkhorn` — TPU-native fast path: entropic OT via
+  log-domain Sinkhorn iterations, fully jittable (``lax.fori_loop``), fusable
+  into the sharded step.  Converges to the LP plan as ``eps → 0``; tested
+  against the LP on small problems.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.special import logsumexp
+
+from dist_svgd_tpu.ops.kernels import squared_distances
+
+
+def wasserstein_grad_lp(particles, previous) -> np.ndarray:
+    """Exact discrete-OT W2 gradient via the host LP (reference parity).
+
+    Builds the same flattened cost/equality system as the reference
+    (dsvgd/distsampler.py:111-127): ``c`` is the row-major flattened squared
+    distance matrix, the first ``m`` rows of ``A_eq`` constrain row sums to
+    ``1/m``, the next ``n`` rows constrain column sums to ``1/n``.  scipy's
+    modern default (HiGHS) replaces the scipy-1.1-era simplex; both return a
+    vertex solution (a matching when ``m == n``).
+    """
+    import scipy.optimize
+
+    x = np.asarray(particles, dtype=np.float64)
+    y = np.asarray(previous, dtype=np.float64)
+    m, d = x.shape
+    n = y.shape[0]
+
+    diffs = x[:, None, :] - y[None, :, :]  # (m, n, d)
+    c = np.sum(diffs**2, axis=2).reshape(-1)  # row-major flatten
+
+    a_rows = np.kron(np.eye(m), np.ones((1, n)))  # row-sum constraints
+    a_cols = np.kron(np.ones((1, m)), np.eye(n))  # column-sum constraints
+    a_eq = np.vstack([a_rows, a_cols])
+    b_eq = np.concatenate([np.full(m, 1.0 / m), np.full(n, 1.0 / n)])
+
+    res = scipy.optimize.linprog(c, A_eq=a_eq, b_eq=b_eq)
+    if res.x is None:  # pragma: no cover - defensive
+        raise RuntimeError(f"OT linear program failed: {res.message}")
+    plan = res.x.reshape(m, n)
+    return np.sum(plan[:, :, None] * diffs, axis=1)
+
+
+def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200):
+    """Entropic-OT transport plan between uniform measures on ``x`` and ``y``.
+
+    ``eps`` is *relative*: the entropic regulariser is ``eps · mean(C)``,
+    making the solver scale-free across targets.  Log-domain updates for
+    stability; fixed iteration count so the loop is a compile-time constant
+    (XLA-friendly control flow).
+    """
+    m, n = x.shape[0], y.shape[0]
+    cost = squared_distances(x, y)
+    reg = eps * jnp.maximum(jnp.mean(cost), jnp.finfo(cost.dtype).tiny)
+    log_k = -cost / reg
+    log_a = jnp.full((m,), -jnp.log(float(m)), dtype=cost.dtype)
+    log_b = jnp.full((n,), -jnp.log(float(n)), dtype=cost.dtype)
+
+    def body(_, carry):
+        log_u, log_v = carry
+        log_u = log_a - logsumexp(log_k + log_v[None, :], axis=1)
+        log_v = log_b - logsumexp(log_k + log_u[:, None], axis=0)
+        return log_u, log_v
+
+    log_u = jnp.zeros((m,), dtype=cost.dtype)
+    log_v = jnp.zeros((n,), dtype=cost.dtype)
+    log_u, log_v = lax.fori_loop(0, iters, body, (log_u, log_v))
+    return jnp.exp(log_u[:, None] + log_k + log_v[None, :])
+
+
+def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05, iters: int = 200):
+    """W2 gradient from the Sinkhorn plan — same formula as the LP path:
+    ``grad_i = Σ_j P_ij (x_i − y_j) = x_i · rowsum_i − P @ y``, computed
+    without materialising the ``(m, n, d)`` difference tensor."""
+    plan = sinkhorn_plan(particles, previous, eps=eps, iters=iters)
+    row = jnp.sum(plan, axis=1)
+    return particles * row[:, None] - plan @ previous

@@ -1,0 +1,126 @@
+"""The fused SVGD step.
+
+The update everything else exists to compute (reference writeup Algorithm 1,
+writeup/writeup.tex:106-124):
+
+    θ_i ← θ_i + ε · φ̂*(θ_i)
+    φ̂*(y) = (1/m) Σ_j [ k(x_j, y) · ∇_{x_j} log p(x_j) + ∇_{x_j} k(x_j, y) ]
+
+The reference computes φ̂ with a Python loop over pairs, building two fresh
+autograd graphs per pair (dsvgd/sampler.py:35-40, dsvgd/distsampler.py:84-101)
+— the dominant cost identified in SURVEY.md §3.3.  Here the entire step is one
+fused XLA program:
+
+- scores come in batched (``vmap(grad(logp))`` computed by the caller, so the
+  same φ works for exact/scaled/exchanged score variants);
+- the Gram matrix is one broadcasted matmul on the MXU;
+- for the RBF kernel the repulsive term uses the closed form
+  ``Σ_j ∇_{x_j} k(x_j, y) = (2/h) (y·Σ_j K_j  −  Kᵀ x)``,
+  so no ``(m, k, d)`` tensor is materialised — O(m·k + (m+k)·d) memory.
+
+Update semantics: the vectorised step is **Jacobi** (all particles updated
+simultaneously), a deliberate, documented deviation from the reference's
+in-place Gauss–Seidel sweep (dsvgd/sampler.py:62-68) — same fixed point,
+different trajectory (SURVEY.md §3.2).  ``svgd_step_sequential`` provides a
+``lax.scan`` Gauss–Seidel mode with the reference's exact semantics for
+small-n verification.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dist_svgd_tpu.ops.kernels import RBF, kernel_grad_matrix, kernel_matrix
+
+
+def phi(
+    updated: jax.Array,
+    interacting: jax.Array,
+    scores: jax.Array,
+    kernel=None,
+) -> jax.Array:
+    """Stein variational direction φ̂* for each row of ``updated``.
+
+    Args:
+        updated: ``(k, d)`` particles being moved (the local block).
+        interacting: ``(m, d)`` interaction set (full set in the ``all_*``
+            exchange modes, the local block in ``partitions`` mode —
+            reference dsvgd/distsampler.py:85-87).
+        scores: ``(m, d)`` score vectors ``∇ log p`` for each interacting
+            particle (already scaled/exchanged by the caller as the exchange
+            mode dictates).
+        kernel: an :class:`RBF` instance (fused path) or any scalar kernel
+            callable (autograd fallback).  Defaults to the reference's
+            ``RBF(bandwidth=1)``.
+
+    Returns:
+        ``(k, d)`` array of update directions.
+    """
+    if kernel is None:
+        kernel = RBF(1.0)
+    m = interacting.shape[0]
+    if isinstance(kernel, RBF):
+        K = kernel.matrix(interacting, updated)  # (m, k)
+        drive = K.T @ scores  # Σ_j k(x_j, y_i) s_j
+        # Σ_j ∇_{x_j} k(x_j, y_i) = (2/h) (y_i Σ_j K_ji − Σ_j K_ji x_j)
+        ksum = jnp.sum(K, axis=0)  # (k,)
+        repulse = (2.0 / kernel.bandwidth) * (updated * ksum[:, None] - K.T @ interacting)
+        return (drive + repulse) / m
+    K = kernel_matrix(kernel, interacting, updated)  # (m, k)
+    gK = kernel_grad_matrix(kernel, interacting, updated)  # (m, k, d)
+    return (K.T @ scores + jnp.sum(gK, axis=0)) / m
+
+
+def svgd_step(
+    particles: jax.Array,
+    scores: jax.Array,
+    step_size,
+    kernel=None,
+    extra_grad: Optional[jax.Array] = None,
+    extra_weight=0.0,
+) -> jax.Array:
+    """One Jacobi SVGD step over the full particle set.
+
+    ``extra_grad``/``extra_weight`` add an optional proximal term the way the
+    reference adds its Wasserstein/JKO gradient: ``δ += h · w_grad`` before
+    ``θ += ε · δ`` (dsvgd/distsampler.py:194-200).
+    """
+    delta = phi(particles, particles, scores, kernel)
+    if extra_grad is not None:
+        delta = delta + extra_weight * extra_grad
+    return particles + step_size * delta
+
+
+def svgd_step_sequential(
+    particles: jax.Array,
+    score_fn: Callable[[jax.Array], jax.Array],
+    step_size,
+    kernel=None,
+) -> jax.Array:
+    """Gauss–Seidel SVGD sweep with the reference's exact in-place semantics.
+
+    Particle ``i``'s update sees particles ``< i`` already updated, and every
+    pair re-evaluates the score at the *current* value of the interacting
+    particle (reference dsvgd/sampler.py:62-68: ``particles[i] = particle +
+    ε·φ̂`` mutates the array the next ``_phi_hat`` reads, and ``_dlogp(other)``
+    is called fresh per pair).  O(n²) score evaluations per sweep — use only
+    for small-n parity verification; the Jacobi path is the TPU-native mode.
+    """
+    if kernel is None:
+        kernel = RBF(1.0)
+    n = particles.shape[0]
+    batched_score = jax.vmap(score_fn)
+
+    def body(parts, i):
+        scores = batched_score(parts)
+        y = lax.dynamic_slice_in_dim(parts, i, 1, axis=0)  # (1, d)
+        delta = phi(y, parts, scores, kernel)
+        parts = lax.dynamic_update_slice_in_dim(parts, y + step_size * delta, i, axis=0)
+        return parts, None
+
+    parts, _ = lax.scan(body, particles, jnp.arange(n))
+    return parts

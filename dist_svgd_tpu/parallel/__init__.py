@@ -1,0 +1,19 @@
+"""SPMD parallelism: mesh utilities and particle/score exchange strategies."""
+
+from dist_svgd_tpu.parallel.mesh import AXIS, make_mesh, bind_shard_fn
+from dist_svgd_tpu.parallel.exchange import (
+    ALL_PARTICLES,
+    ALL_SCORES,
+    PARTITIONS,
+    make_shard_step,
+)
+
+__all__ = [
+    "AXIS",
+    "make_mesh",
+    "bind_shard_fn",
+    "ALL_PARTICLES",
+    "ALL_SCORES",
+    "PARTITIONS",
+    "make_shard_step",
+]

@@ -1,0 +1,126 @@
+"""The three particle/score exchange strategies, as one fused per-shard step.
+
+Reference semantics (dsvgd/distsampler.py:131-170,172-205 — SURVEY.md §2.3):
+
+- ``all_particles`` — every shard gathers the full particle set
+  (``dist.all_gather`` → ``lax.all_gather``) and computes scores for *all* n
+  particles using only its **local data slice**, importance-scaled by
+  ``N_global / N_local`` (dsvgd/distsampler.py:96-99).
+- ``all_scores``    — after the particle gather, per-shard local-data scores
+  for all n particles are summed across shards (``dist.all_reduce(SUM)`` →
+  ``lax.psum``), yielding the **exact global score**; no extra scaling
+  (the reference's open TODO at dsvgd/distsampler.py:93 — the SUM already
+  globalises the estimate).
+- ``partitions``    — ring migration: each rank hands its particle block to
+  rank+1 and adopts the block from rank−1, then interacts **only within the
+  owned block** (dsvgd/distsampler.py:131-150, interaction set :85-87).
+
+The ``partitions`` mode is re-derived for SPMD: instead of migrating particle
+blocks between devices (mutable ownership ranges don't exist under pjit),
+each device keeps its particle block pinned and the **data-shard assignment
+rotates** — block ``b`` at step ``t`` is updated against data slice
+``(b + t) mod S``, which is exactly the pairing the reference's ring produces
+(owner of block ``b`` at step ``t`` is rank ``(b + t) mod S``, whose data is
+slice ``(b + t) mod S``).  The global particle array therefore stays in
+logical order at all times.  Like the reference — where every rank loads the
+full dataset and slices its block (experiments/logreg.py:28,41-51) — the
+dataset is replicated across devices and sliced per-shard with
+``lax.dynamic_slice``; a sharded-data path with ``ppermute`` rotation is the
+planned optimisation for datasets that don't fit per-device HBM.
+
+Each strategy is one jit-compiled function; XLA overlaps the collective with
+the score/kernel compute.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dist_svgd_tpu.ops.svgd import phi
+from dist_svgd_tpu.parallel.mesh import AXIS
+
+ALL_PARTICLES = "all_particles"
+ALL_SCORES = "all_scores"
+PARTITIONS = "partitions"
+
+MODES = (ALL_PARTICLES, ALL_SCORES, PARTITIONS)
+
+
+def _slice_data(data, start: jax.Array, size: int):
+    """Per-shard data slice: every leaf is sliced ``[start : start+size]``
+    along axis 0 (the reference's contiguous block convention,
+    experiments/logreg.py:41-51)."""
+    if data is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda a: lax.dynamic_slice_in_dim(a, start, size, axis=0), data
+    )
+
+
+def make_shard_step(
+    logp: Callable,
+    kernel,
+    mode: str,
+    num_shards: int,
+    n_local_data: int,
+    score_scale: float,
+) -> Callable:
+    """Build the per-shard SVGD step for one exchange strategy.
+
+    Args:
+        logp: ``logp(theta, data_local)`` scalar log-density; ``data_local``
+            is the shard's data slice (or ``None`` for data-free targets).
+        kernel: kernel object/callable for :func:`dist_svgd_tpu.ops.svgd.phi`.
+        mode: one of :data:`MODES`.
+        num_shards: mesh size S.
+        n_local_data: rows per data shard (``N_global // S``, remainder
+            dropped — reference drop policy, experiments/logreg.py:35).
+        score_scale: ``N_global / N_local`` importance factor applied when
+            scores are *not* exchanged (dsvgd/distsampler.py:96-99); pass 1.0
+            for data-free targets.
+
+    Returns:
+        ``step(block, data_full, w_grad_block, t, step_size, h) -> new_block``
+        written against block-local shapes and the named axis
+        :data:`~dist_svgd_tpu.parallel.mesh.AXIS`; bind it with
+        :func:`~dist_svgd_tpu.parallel.mesh.bind_shard_fn`.
+
+        ``w_grad_block`` is the per-shard Wasserstein/JKO gradient (zeros when
+        disabled), added as ``δ += h·w_grad`` before ``θ += ε·δ`` exactly as
+        the reference does (dsvgd/distsampler.py:194-200).  ``t`` is the
+        1-based step counter driving the ``partitions`` rotation.
+    """
+    if mode not in MODES:
+        raise ValueError(f"unknown exchange mode {mode!r}")
+
+    score_fn = jax.grad(logp, argnums=0)
+    batched_score = jax.vmap(score_fn, in_axes=(0, None))
+
+    def step(block, data_full, w_grad_block, t, step_size, h):
+        r = lax.axis_index(AXIS)
+        if mode == PARTITIONS:
+            data_rank = (r + t.astype(r.dtype)) % num_shards
+        else:
+            data_rank = r
+        data_local = _slice_data(data_full, data_rank * n_local_data, n_local_data)
+
+        if mode == PARTITIONS:
+            interacting = block
+            scores = score_scale * batched_score(block, data_local)
+        else:
+            interacting = lax.all_gather(block, AXIS, tiled=True)
+            local_scores = batched_score(interacting, data_local)
+            if mode == ALL_SCORES:
+                scores = lax.psum(local_scores, AXIS)
+            else:
+                scores = score_scale * local_scores
+
+        delta = phi(block, interacting, scores, kernel)
+        delta = delta + h * w_grad_block
+        return block + step_size * delta
+
+    return step

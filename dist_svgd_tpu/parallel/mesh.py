@@ -1,0 +1,108 @@
+"""Device-mesh utilities.
+
+The reference's communication layer is per-process ``torch.distributed`` with
+explicit rank bookkeeping (SURVEY.md §2.4).  The TPU-native replacement is a
+1-D ``jax.sharding.Mesh`` over the particle axis: ownership ranges become
+sharding specs, and the three exchange collectives become
+``lax.all_gather`` / ``lax.psum`` / ``lax.ppermute`` inside one jitted step.
+
+Two interchangeable backends execute the same per-shard function:
+
+- **shard_map** over a real device mesh (TPU ICI, or
+  ``--xla_force_host_platform_device_count`` CPU devices in tests);
+- **vmap with a named axis** — an exact single-device emulation used when the
+  host has fewer devices than shards (e.g. benchmarking 8-shard semantics on
+  the one real TPU chip).  JAX collectives are semantically identical under
+  ``vmap(axis_name=...)``, so both backends run the *same* code path.
+
+Multi-host: call ``jax.distributed.initialize()`` before ``make_mesh`` and the
+same program spans DCN-connected hosts via global arrays (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+#: Name of the particle-sharding mesh axis used throughout the framework.
+AXIS = "shards"
+
+
+def make_mesh(num_shards: int, devices: Optional[Sequence] = None) -> Optional[Mesh]:
+    """Build a 1-D mesh of ``num_shards`` devices, or ``None`` when the host
+    does not have enough devices (callers then use the vmap emulation backend).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_shards == 1:
+        return None
+    if len(devices) < num_shards:
+        return None
+    return Mesh(np.asarray(devices[:num_shards]), (AXIS,))
+
+
+def bind_shard_fn(
+    fn: Callable,
+    num_shards: int,
+    mesh: Optional[Mesh],
+    in_specs: Sequence[Optional[int]],
+    out_specs: Sequence[Optional[int]],
+) -> Callable:
+    """Bind a per-shard function to a mesh (shard_map) or emulate it (vmap).
+
+    ``fn`` is written once against block-local shapes and the named axis
+    :data:`AXIS`.  Each spec entry is ``None`` (replicated — whole value seen
+    by every shard, pytrees allowed) or an int axis index along which the
+    *global* value is split into ``num_shards`` equal blocks.  The bound
+    callable always takes/returns global arrays, so callers are oblivious to
+    the backend.
+    """
+    in_specs = tuple(in_specs)
+    out_specs = tuple(out_specs)
+    single_out = len(out_specs) == 1
+
+    if mesh is not None:
+        def to_p(s):
+            return P() if s is None else P(*([None] * s + [AXIS]))
+
+        sm_out = to_p(out_specs[0]) if single_out else tuple(to_p(s) for s in out_specs)
+        return shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=tuple(to_p(s) for s in in_specs),
+            out_specs=sm_out,
+            check_vma=False,
+        )
+
+    vf = jax.vmap(
+        fn,
+        in_axes=in_specs,
+        out_axes=out_specs[0] if single_out else out_specs,
+        axis_name=AXIS,
+        axis_size=num_shards,
+    )
+
+    def split(a, s):
+        if s is None:
+            return a
+        shape = a.shape
+        assert shape[s] % num_shards == 0, (shape, s, num_shards)
+        return a.reshape(shape[:s] + (num_shards, shape[s] // num_shards) + shape[s + 1:])
+
+    def merge(o, s):
+        if s is None:
+            return o
+        shape = o.shape
+        return o.reshape(shape[:s] + (shape[s] * shape[s + 1],) + shape[s + 2:])
+
+    def wrapped(*args):
+        outs = vf(*[split(a, s) for a, s in zip(args, in_specs)])
+        if single_out:
+            return merge(outs, out_specs[0])
+        return tuple(merge(o, s) for o, s in zip(outs, out_specs))
+
+    return wrapped

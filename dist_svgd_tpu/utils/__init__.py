@@ -1,0 +1,21 @@
+"""Utilities: dataset loading, history recording, and RNG helpers."""
+
+from dist_svgd_tpu.utils.datasets import (
+    DATASET_NAMES,
+    Fold,
+    load_benchmark,
+    load_covertype,
+)
+from dist_svgd_tpu.utils.history import history_to_dataframe
+from dist_svgd_tpu.utils.rng import as_key, init_particles, init_particles_per_shard
+
+__all__ = [
+    "DATASET_NAMES",
+    "Fold",
+    "load_benchmark",
+    "load_covertype",
+    "history_to_dataframe",
+    "as_key",
+    "init_particles",
+    "init_particles_per_shard",
+]

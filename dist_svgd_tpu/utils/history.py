@@ -1,0 +1,44 @@
+"""Particle-history recording.
+
+The reference accumulates one pandas row per (timestep, particle) with the
+particle value as a numpy vector, snapshotted *before* each update plus one
+final post-update snapshot (dsvgd/sampler.py:62-73, experiments/logreg.py:78-87
+— SURVEY.md §7.4 timestep convention).  The TPU-native samplers record the
+whole history as a stacked device array inside ``lax.scan`` and convert to the
+reference's DataFrame schema once, on the host, at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+def history_to_dataframe(
+    history: np.ndarray,
+    timesteps: Optional[Sequence[int]] = None,
+    particle_ids: Optional[Sequence[int]] = None,
+    include_particle_column: bool = True,
+) -> pd.DataFrame:
+    """Convert a ``(T, n, d)`` history array to the reference DataFrame schema.
+
+    Columns: ``timestep`` (int), ``particle`` (int, optional — the reference's
+    distributed driver records only timestep/value, experiments/logreg.py:81),
+    ``value`` (numpy ``(d,)`` vector), matching ``dsvgd/sampler.py:66,74``.
+    """
+    history = np.asarray(history)
+    T, n, _ = history.shape
+    if timesteps is None:
+        timesteps = np.arange(T)
+    if particle_ids is None:
+        particle_ids = np.arange(n)
+    rows = {
+        "timestep": np.repeat(np.asarray(timesteps), n),
+        "particle": np.tile(np.asarray(particle_ids), T),
+        "value": [history[t, i] for t in range(T) for i in range(n)],
+    }
+    if not include_particle_column:
+        del rows["particle"]
+    return pd.DataFrame(rows)

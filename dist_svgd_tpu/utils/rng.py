@@ -1,0 +1,46 @@
+"""RNG helpers.
+
+The reference seeds torch's global RNG per rank (``torch.manual_seed(rank)``,
+experiments/logreg.py:24) so each rank draws an entirely different initial
+particle array yet only uses its own block (SURVEY.md §7.3.5).  JAX's explicit
+keys make the equivalent well-defined globally: one root key, ``fold_in`` per
+shard, each shard's block drawn from its own independent stream.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+
+def as_key(seed_or_key: Union[int, jax.Array]) -> jax.Array:
+    """Accept either an integer seed or a PRNG key."""
+    if isinstance(seed_or_key, int):
+        return jax.random.PRNGKey(seed_or_key)
+    return seed_or_key
+
+
+def init_particles(key, n: int, d: int, dtype=jnp.float32) -> jax.Array:
+    """Standard-normal initial particles, matching the reference's
+    ``Normal(0, 1).sample((d, 1))`` per particle (dsvgd/sampler.py:58-60)."""
+    return jax.random.normal(as_key(key), (n, d), dtype=dtype)
+
+
+def init_particles_per_shard(key, n: int, d: int, num_shards: int, dtype=jnp.float32) -> jax.Array:
+    """Global ``(n, d)`` initial particles where shard ``r``'s block comes from
+    an independent stream ``fold_in(key, r)`` — the distributional equivalent
+    of the reference's per-rank seeding (experiments/logreg.py:24,63-66).
+
+    ``n`` must be divisible by ``num_shards`` (the caller applies the
+    reference's drop-remainder policy first).
+    """
+    key = as_key(key)
+    assert n % num_shards == 0
+    block = n // num_shards
+    blocks = [
+        jax.random.normal(jax.random.fold_in(key, r), (block, d), dtype=dtype)
+        for r in range(num_shards)
+    ]
+    return jnp.concatenate(blocks, axis=0)

@@ -1,0 +1,210 @@
+"""Literal-semantics numpy oracle of the reference algorithm.
+
+Re-implemented from the structural spec in SURVEY.md §3 (per-pair loops,
+in-place sweeps, ring ownership, Wasserstein snapshot warts) — NOT copied from
+the reference — to serve as the ground truth the fused TPU implementations are
+tested against.  Everything here is deliberately slow, loopy float64 numpy.
+
+Semantics encoded:
+
+- RBF kernel k(x,y) = exp(-||x-y||^2), fixed bandwidth 1.
+- φ̂(y) = (1/m) Σ_j [ k(x_j,y)·s_j + ∇_{x_j} k(x_j,y) ].
+- Gauss–Seidel sweep: particle i's update sees particles < i updated, and
+  per-pair scores are evaluated fresh at the interacting particle's *current*
+  value.
+- Jacobi sweep: scores and kernels all evaluated at pre-update values
+  (the TPU-native mode — used to validate the vectorised step exactly).
+- Distributed: S ranks, contiguous particle blocks and data slices;
+  `all_particles` (gather + N_g/N_l-scaled local scores), `all_scores`
+  (gather + summed local scores, unscaled), `partitions` (ring ownership
+  rotation, block-local interactions).
+- Wasserstein/JKO: discrete-OT LP between current owned particles and the
+  rank's previous-snapshot set; delta += h·w_grad; snapshot rules per mode,
+  including the exchanged-mode "own block fresh, other blocks stale" wart.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.optimize
+
+
+def rbf(x, y):
+    d = x - y
+    return float(np.exp(-np.dot(d, d)))
+
+
+def drbf_dx(x, y):
+    """∇_x k(x, y) for the bandwidth-1 RBF."""
+    return -2.0 * (x - y) * rbf(x, y)
+
+
+def phi_hat(y, interacting, pair_score):
+    """φ̂(y); `pair_score(j, xj)` returns the score attributed to interacting
+    particle j at its current value xj (already scaled per the mode)."""
+    total = np.zeros_like(y)
+    for j, xj in enumerate(interacting):
+        total += drbf_dx(xj, y)
+        total += rbf(xj, y) * pair_score(j, xj)
+    return total / len(interacting)
+
+
+def gauss_seidel_sweep(particles, score_of, step_size):
+    """Reference single-device sweep: in-place, fresh per-pair scores."""
+    parts = np.array(particles, dtype=np.float64)
+    for i in range(parts.shape[0]):
+        delta = phi_hat(parts[i], parts, lambda j, xj: score_of(xj))
+        parts[i] = parts[i] + step_size * delta
+    return parts
+
+
+def jacobi_sweep(particles, score_of, step_size):
+    """Simultaneous update; all quantities at pre-update values."""
+    parts = np.array(particles, dtype=np.float64)
+    scores = [score_of(p) for p in parts]
+    new = np.empty_like(parts)
+    for i in range(parts.shape[0]):
+        delta = phi_hat(parts[i], parts, lambda j, xj: scores[j])
+        new[i] = parts[i] + step_size * delta
+    return new
+
+
+def wasserstein_grad(particles, previous):
+    """Discrete-OT LP gradient, built the loopy way the reference builds it."""
+    x = np.asarray(particles, dtype=np.float64)
+    y = np.asarray(previous, dtype=np.float64)
+    m, d = x.shape
+    n = y.shape[0]
+    diffs = np.zeros((m, n, d))
+    for i in range(m):
+        for j in range(n):
+            diffs[i][j] = x[i] - y[j]
+    c = np.array([np.dot(diffs[i][j], diffs[i][j]) for i in range(m) for j in range(n)])
+    a_eq = np.zeros((m + n, m * n))
+    for i in range(m):
+        a_eq[i, n * i : n * (i + 1)] = 1
+    for j in range(n):
+        for k in range(m):
+            a_eq[m + j, j + k * n] = 1
+    b_eq = np.concatenate([np.full(m, 1.0 / m), np.full(n, 1.0 / n)])
+    plan = scipy.optimize.linprog(c, A_eq=a_eq, b_eq=b_eq).x.reshape(m, n)
+    return np.sum(plan[:, :, None] * diffs, axis=1)
+
+
+class RefDistOracle:
+    """Simulates the reference's S-rank distributed sampler faithfully.
+
+    `score_of(rank, x)` is the local-data score ∇logp_rank(x) (including any
+    prior terms, exactly as each rank's logp closure would compute it).
+
+    `update_rule='jacobi'` evaluates all scores/kernels at pre-update values
+    (matches the TPU-native DistSampler exactly); `'gauss_seidel'` replicates
+    the reference's in-place sweep.
+    """
+
+    def __init__(
+        self,
+        num_shards,
+        score_of,
+        particles,
+        exchange_particles=True,
+        exchange_scores=True,
+        include_wasserstein=False,
+        score_scale=1.0,
+        update_rule="jacobi",
+    ):
+        assert not (exchange_scores and not exchange_particles)
+        self.S = num_shards
+        self.score_of = score_of
+        self.scale = score_scale
+        self.exchange_particles = exchange_particles
+        self.exchange_scores = exchange_scores
+        self.include_wasserstein = include_wasserstein
+        self.update_rule = update_rule
+
+        parts = np.array(particles, dtype=np.float64)
+        self.per_shard = parts.shape[0] // num_shards
+        self.n = self.per_shard * num_shards
+        self.global_particles = parts[: self.n]
+        # owner[b] = rank currently updating block b
+        self.owner = list(range(num_shards))
+        # per-rank previous-particle snapshot for the W2 term
+        self.previous = [None] * num_shards
+
+    def _block(self, b):
+        s = self.per_shard
+        return self.global_particles[b * s : (b + 1) * s]
+
+    def block_of_rank(self, r):
+        return self.owner.index(r)
+
+    def make_step(self, step_size, h=1.0):
+        S, s = self.S, self.per_shard
+        if S > 1 and not self.exchange_particles:
+            # ring migration: rank r adopts the block rank r-1 owned
+            self.owner = [(r + 1) % S for r in self.owner]
+
+        # per-rank interaction sets and scores, all at post-exchange values
+        new_blocks = {}
+        for r in range(S):
+            b = self.block_of_rank(r)
+            own = self._block(b).copy()
+            if self.exchange_particles and S >= 1:
+                interacting = self.global_particles.copy()
+                own_range = (b * s, (b + 1) * s)
+            else:
+                interacting = own.copy()
+                own_range = (0, s)
+
+            if self.exchange_scores and S > 1:
+                # summed local-data scores for every interacting particle,
+                # computed at pre-update values, no extra scaling
+                fixed_scores = [
+                    np.sum([self.score_of(rr, p) for rr in range(S)], axis=0)
+                    for p in interacting
+                ]
+                pair_score = lambda j, xj, fs=fixed_scores: fs[j]
+            elif self.update_rule == "jacobi":
+                pre_scores = [self.scale * self.score_of(r, p) for p in interacting]
+                pair_score = lambda j, xj, ps=pre_scores: ps[j]
+            else:
+                pair_score = lambda j, xj, rr=r: self.scale * self.score_of(rr, xj)
+
+            w_grad = None
+            if self.include_wasserstein and self.previous[r] is not None:
+                w_grad = wasserstein_grad(own, self.previous[r])
+
+            if self.update_rule == "jacobi":
+                frozen = interacting.copy()
+                new = own.copy()
+                for i in range(s):
+                    delta = phi_hat(own[i], frozen, pair_score)
+                    if w_grad is not None:
+                        delta = delta + h * w_grad[i]
+                    new[i] = own[i] + step_size * delta
+                new_blocks[b] = (r, new, interacting, own_range)
+            else:
+                # in-place sweep over the rank's own block inside its view
+                view = interacting
+                lo, _ = own_range
+                for i in range(s):
+                    delta = phi_hat(view[lo + i], view, pair_score)
+                    if w_grad is not None:
+                        delta = delta + h * w_grad[i]
+                    view[lo + i] = view[lo + i] + step_size * delta
+                new_blocks[b] = (r, view[lo : lo + s].copy(), view, own_range)
+
+        # commit all blocks, then take per-rank previous snapshots
+        for b, (r, new, interacting, own_range) in new_blocks.items():
+            self.global_particles[b * s : (b + 1) * s] = new
+        for b, (r, new, interacting, own_range) in new_blocks.items():
+            if not self.include_wasserstein:
+                continue
+            if self.exchange_particles:
+                snap = interacting.copy()
+                lo, hi = own_range
+                snap[lo:hi] = new  # own block fresh, others stale (the wart)
+                self.previous[r] = snap
+            else:
+                self.previous[r] = new.copy()
+        return self.global_particles

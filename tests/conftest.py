@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+Distributed-without-hardware (SURVEY.md §4): the TPU analog of the
+reference's local-multiprocess fixture is XLA host emulation — 8 virtual CPU
+devices, so the same Mesh/shard_map code paths run on any machine.
+
+Environment note: this image boots every interpreter with an `axon` TPU PJRT
+plugin pre-registered via sitecustomize and `JAX_PLATFORMS=axon` exported.
+Tests must run on the virtual CPU mesh, so we (a) force the platform to cpu
+through jax.config (the env var may be pre-set to axon), and (b) drop the
+axon backend factory before any client initialises — leaving it registered
+makes CPU-only init block on the TPU tunnel.
+
+float64 is enabled so vectorised implementations can be compared against the
+numpy oracle at tight tolerances.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from jax._src import xla_bridge  # noqa: E402
+
+xla_bridge._backend_factories.pop("axon", None)
+
+assert len(jax.devices("cpu")) >= 8, "expected 8 virtual CPU devices for mesh tests"

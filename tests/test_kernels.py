@@ -1,0 +1,95 @@
+"""Unit tests: closed-form kernel values and gradients (SURVEY.md §4 unit tier)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dist_svgd_tpu.ops.kernels import (
+    RBF,
+    kernel_grad_matrix,
+    kernel_matrix,
+    median_bandwidth,
+    squared_distances,
+)
+
+from _oracle import rbf as oracle_rbf, drbf_dx as oracle_drbf
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_squared_distances_matches_bruteforce(rng):
+    x = rng.normal(size=(5, 3))
+    y = rng.normal(size=(7, 3))
+    got = np.asarray(squared_distances(jnp.asarray(x), jnp.asarray(y)))
+    want = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+    assert (got >= 0).all()
+
+
+def test_rbf_scalar_matches_reference_formula(rng):
+    k = RBF(1.0)
+    x, y = rng.normal(size=3), rng.normal(size=3)
+    got = float(k(jnp.asarray(x), jnp.asarray(y)))
+    assert got == pytest.approx(oracle_rbf(x, y), rel=1e-12)
+
+
+def test_rbf_matrix_matches_scalar(rng):
+    k = RBF(2.5)
+    x = rng.normal(size=(4, 2))
+    y = rng.normal(size=(6, 2))
+    mat = np.asarray(k.matrix(jnp.asarray(x), jnp.asarray(y)))
+    for i in range(4):
+        for j in range(6):
+            assert mat[i, j] == pytest.approx(float(k(jnp.asarray(x[i]), jnp.asarray(y[j]))), rel=1e-12)
+
+
+def test_kernel_grad_matrix_matches_analytic(rng):
+    """Generic autograd path must equal the closed form −2(x−y)k for RBF."""
+    x = rng.normal(size=(3, 2))
+    y = rng.normal(size=(4, 2))
+    k = RBF(1.0)
+    g = np.asarray(kernel_grad_matrix(k, jnp.asarray(x), jnp.asarray(y)))
+    for i in range(3):
+        for j in range(4):
+            np.testing.assert_allclose(g[i, j], oracle_drbf(x[i], y[j]), rtol=1e-10)
+
+
+def test_generic_kernel_matrix_fallback(rng):
+    """A plain callable (no .matrix) goes through the vmap fallback."""
+    x = rng.normal(size=(3, 2))
+    y = rng.normal(size=(4, 2))
+
+    def plain(a, b):
+        return jnp.exp(-jnp.sum((a - b) ** 2))
+
+    got = np.asarray(kernel_matrix(plain, jnp.asarray(x), jnp.asarray(y)))
+    want = np.asarray(RBF(1.0).matrix(jnp.asarray(x), jnp.asarray(y)))
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_median_bandwidth_positive_and_scales(rng):
+    x = jnp.asarray(rng.normal(size=(50, 4)))
+    h = float(median_bandwidth(x))
+    assert h > 0
+    h10 = float(median_bandwidth(10.0 * x))
+    assert h10 == pytest.approx(100.0 * h, rel=1e-6)
+
+
+def test_median_bandwidth_excludes_diagonal_and_jits():
+    """n=2 at distance a: off-diagonal median is a², not a²/2 — and the
+    heuristic must be traceable under jit (used inside jitted steps)."""
+    import math
+
+    x = jnp.asarray([[0.0], [3.0]])
+    want = 9.0 / math.log(3.0)
+    assert float(median_bandwidth(x)) == pytest.approx(want, rel=1e-10)
+    assert float(jax.jit(median_bandwidth)(x)) == pytest.approx(want, rel=1e-10)
+
+
+def test_rbf_rejects_bad_bandwidth():
+    with pytest.raises(ValueError):
+        RBF(0.0)

@@ -1,0 +1,65 @@
+"""Wasserstein/JKO term: LP parity and Sinkhorn fidelity (SURVEY.md §7.3.2)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dist_svgd_tpu.ops.ot import (
+    sinkhorn_plan,
+    wasserstein_grad_lp,
+    wasserstein_grad_sinkhorn,
+)
+
+from _oracle import wasserstein_grad as oracle_wgrad
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(13)
+
+
+def test_lp_matches_oracle_square(rng):
+    x = rng.normal(size=(5, 2))
+    y = rng.normal(size=(5, 2))
+    np.testing.assert_allclose(wasserstein_grad_lp(x, y), oracle_wgrad(x, y), atol=1e-8)
+
+
+def test_lp_matches_oracle_rectangular(rng):
+    """m ≠ n — the distributed case (local block vs full previous set)."""
+    x = rng.normal(size=(3, 2))
+    y = rng.normal(size=(6, 2))
+    np.testing.assert_allclose(wasserstein_grad_lp(x, y), oracle_wgrad(x, y), atol=1e-8)
+
+
+def test_lp_identity_transport(rng):
+    """x == y → optimal plan is the identity matching → zero gradient."""
+    x = rng.normal(size=(4, 3))
+    np.testing.assert_allclose(wasserstein_grad_lp(x, x), np.zeros_like(x), atol=1e-9)
+
+
+def test_lp_two_point_matching():
+    """Hand-checkable: two points, obvious matching, grad_i = (x_i − y_σ(i))/m
+    with the uniform 1/m mass on the matched pair."""
+    x = np.array([[0.0, 0.0], [10.0, 0.0]])
+    y = np.array([[0.5, 0.0], [9.0, 0.0]])
+    g = wasserstein_grad_lp(x, y)
+    np.testing.assert_allclose(g, (x - y) / 2.0, atol=1e-9)
+
+
+def test_sinkhorn_marginals(rng):
+    x = jnp.asarray(rng.normal(size=(6, 2)))
+    y = jnp.asarray(rng.normal(size=(4, 2)))
+    plan = np.asarray(sinkhorn_plan(x, y, eps=0.05, iters=500))
+    np.testing.assert_allclose(plan.sum(axis=1), np.full(6, 1 / 6), atol=1e-6)
+    np.testing.assert_allclose(plan.sum(axis=0), np.full(4, 1 / 4), atol=1e-6)
+
+
+def test_sinkhorn_approaches_lp(rng):
+    """Small relative eps → Sinkhorn gradient ≈ LP gradient."""
+    x = rng.normal(size=(6, 2))
+    y = rng.normal(size=(6, 2)) + 0.5
+    lp = wasserstein_grad_lp(x, y)
+    sk = np.asarray(
+        wasserstein_grad_sinkhorn(jnp.asarray(x), jnp.asarray(y), eps=0.002, iters=5000)
+    )
+    np.testing.assert_allclose(sk, lp, atol=0.05)
