@@ -1,0 +1,92 @@
+"""Benchmark harness — prints ONE JSON line with the primary metric.
+
+Primary metric (BASELINE.md): SVGD particle-updates/sec on distributed
+Bayesian logistic regression (banana fold 42).  The reference's published
+numbers (notes.md:120-135, reproduced in BASELINE.md) top out at **421
+updates/sec** at world size 8 (50 particles, 500 iterations, CPU); world
+size 1 is 12.5 up/s.  ``vs_baseline`` is measured-updates/sec divided by the
+reference's best (421) — the north-star config is 10k particles on TPU.
+
+The benchmark runs the same fused jitted step the framework uses everywhere:
+one `lax.scan` over SVGD iterations on an HBM-resident (n, d) particle array,
+with `vmap(grad(logp))` scores over the full banana training fold.
+"""
+
+import json
+import sys
+import time
+
+
+REFERENCE_BEST_UPDATES_PER_SEC = 421.0  # notes.md:129 (ws=8) via BASELINE.md
+N_PARTICLES = 10_000
+N_ITERS = 500
+
+
+def _init_platform():
+    """Prefer the real TPU; fall back to CPU (honestly labelled) when the
+    chip pool is unavailable."""
+    import jax
+
+    try:
+        devs = jax.devices()
+        return jax.devices()[0].platform, devs
+    except Exception as e:  # TPU pool unavailable — rerun on CPU
+        print(f"[bench] default backend failed ({type(e).__name__}); CPU fallback", file=sys.stderr)
+        from dist_svgd_tpu.utils.platform import force_cpu_backend
+
+        force_cpu_backend()
+        return "cpu", jax.devices()
+
+
+def main():
+    platform, _ = _init_platform()
+
+    import jax
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import make_logreg_logp
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+
+    fold = load_benchmark("banana", 42)
+    logp = make_logreg_logp(fold.x_train, fold.t_train.reshape(-1))
+    d = 1 + fold.x_train.shape[1]
+
+    n_iters = N_ITERS if platform != "cpu" else 50  # CPU: measure less, same metric
+    sampler = dt.Sampler(d, logp)
+
+    # warmup with the *same* iteration count so the scan program is already
+    # compiled (the compile cache is keyed by num_iter); timing measures
+    # execution only
+    sampler.run(N_PARTICLES, n_iters, 3e-3, seed=0, record=False)[0].block_until_ready()
+    t0 = time.perf_counter()
+    final, _ = sampler.run(N_PARTICLES, n_iters, 3e-3, seed=0, record=False)
+    final.block_until_ready()
+    wall = time.perf_counter() - t0
+
+    updates_per_sec = N_PARTICLES * n_iters / wall
+
+    # context: the reference's exact headline config (50 particles, 500 iters)
+    sampler_small = dt.Sampler(d, logp)
+    sampler_small.run(50, 500, 3e-3, seed=0, record=False)[0].block_until_ready()
+    t0 = time.perf_counter()
+    f2, _ = sampler_small.run(50, 500, 3e-3, seed=0, record=False)
+    f2.block_until_ready()
+    small_wall = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": "particle_updates_per_sec (BayesLR banana, 10k particles)",
+        "value": round(updates_per_sec, 1),
+        "unit": "updates/sec",
+        "vs_baseline": round(updates_per_sec / REFERENCE_BEST_UPDATES_PER_SEC, 2),
+        "platform": platform,
+        "n_particles": N_PARTICLES,
+        "n_iters_measured": n_iters,
+        "wall_s": round(wall, 3),
+        "ref_headline_config_wall_s": round(small_wall, 3),
+        "ref_headline_config_ref_wall_s": 2007.11,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -199,16 +199,21 @@ class DistSampler:
     def mode(self) -> str:
         return self._mode
 
+    def owned_block_index(self, rank: int) -> int:
+        """Logical block index currently owned by (= updated against the data
+        slice of) shard ``rank``: ``(rank − t) mod S`` under the ring rotation
+        (dsvgd/distsampler.py:148-150), ``rank`` otherwise."""
+        if self._mode == PARTITIONS:
+            return (rank - self._t) % self._num_shards
+        return rank
+
     def owned_block(self, rank: int) -> jax.Array:
         """The block currently updated against data shard ``rank`` — the SPMD
         equivalent of the reference's per-rank ``.particles`` view
         (dsvgd/distsampler.py:53-56 with the ring's rotating ownership
         ranges, :148-150)."""
         s = self._particles_per_shard
-        if self._mode == PARTITIONS:
-            b = (rank - self._t) % self._num_shards
-        else:
-            b = rank
+        b = self.owned_block_index(rank)
         return self._particles[b * s : (b + 1) * s]
 
     # ------------------------------------------------------------------ #

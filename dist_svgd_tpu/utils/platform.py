@@ -1,0 +1,34 @@
+"""Backend/platform helpers for this framework's runtime environments.
+
+Some images pre-register an out-of-tree TPU PJRT plugin ("axon") in *every*
+interpreter via sitecustomize; its factory blocks CPU-only backend init.  All
+CPU-forcing code paths (tests, ``--backend=cpu``, bench fallback, the
+multichip dry run) share this one helper instead of three hand-rolled copies.
+"""
+
+from __future__ import annotations
+
+
+def drop_axon_factory() -> None:
+    """Unregister the axon backend factory if present (no-op elsewhere).
+
+    Uses a private jax API (``jax._src.xla_bridge._backend_factories``);
+    guarded so a jax upgrade degrades to a no-op rather than a crash.
+    """
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def force_cpu_backend() -> None:
+    """Force jax onto the CPU backend, working around the blocked init.
+
+    Must be called before the first backend use.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    drop_axon_factory()

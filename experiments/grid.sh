@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Full configuration sweep — port of the reference's grid.sh:1-13:
+# 7 datasets x 100 folds x {1,2,4,8} shards x 3 exchange modes x +/-wasserstein.
+set -u
+cd "$(dirname "$0")/.."
+for dataset in banana diabetis german image splice titanic waveform; do
+  for fold in $(seq 1 100); do
+    for nproc in 1 2 4 8; do
+      for exchange in partitions all_particles all_scores; do
+        time python experiments/logreg.py --dataset=$dataset --fold=$fold --nproc=$nproc --nparticles=50 --niter=500 \
+          --exchange=$exchange --no-wasserstein --plots
+        time python experiments/logreg.py --dataset=$dataset --fold=$fold --nproc=$nproc --nparticles=50 --niter=500 \
+          --exchange=$exchange --wasserstein --plots
+      done
+    done
+  done
+done

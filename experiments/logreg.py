@@ -1,0 +1,158 @@
+"""Distributed Bayesian logistic regression — the reference's flagship
+experiment (experiments/logreg.py:23-147), rebuilt as a single SPMD program.
+
+The reference spawns one OS process per rank, each initialising
+``torch.distributed`` over TCP and running its own sampler
+(experiments/logreg.py:94-140).  Here one process drives all shards through
+``DistSampler``: shards map to mesh devices (or to vmap lanes when the host
+has fewer devices), the rendezvous env-var machinery disappears, and the
+``--master_addr/--master_port`` flags are kept for CLI-surface compatibility
+as documented no-ops.
+
+Flag surface mirrors the reference CLI (experiments/logreg.py:105-118), plus
+``--backend {auto,tpu,cpu}`` per the BASELINE.json north star.
+
+Per-shard outputs keep the reference's exact conventions: a pandas pickle
+``shard-<rank>.pkl`` per shard with columns ``timestep``/``value``, snapshots
+of the shard's *owned* block taken before each step plus one final post-update
+snapshot (experiments/logreg.py:78-92).
+"""
+
+import os
+import shutil
+
+import click
+import numpy as np
+import pandas as pd
+
+from paths import DATA_DIR, RESULTS_DIR  # noqa: F401  (bootstraps sys.path)
+
+from logreg_plots import get_results_dir, make_plots
+
+
+def _select_backend(backend: str):
+    if backend == "auto":
+        return
+    if backend == "cpu":
+        from dist_svgd_tpu.utils.platform import force_cpu_backend
+
+        force_cpu_backend()
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", backend)
+
+
+def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, wasserstein):
+    """One SPMD run over ``num_shards`` shards; writes per-shard pickles."""
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import logreg_logp
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    fold_data = load_benchmark(
+        dataset_name, fold, mat_path=os.path.join(DATA_DIR, "benchmarks.mat")
+    )
+    x_train = jnp.asarray(fold_data.x_train)
+    t_train = jnp.asarray(fold_data.t_train.reshape(-1))
+    d = 1 + x_train.shape[1]  # particle layout (log α, w), logreg.py:37
+
+    # NOTE: drops particles when not divisible by num_shards — the
+    # reference's policy (dsvgd/distsampler.py:42-45); grid.sh runs 50
+    # particles on 4 and 8 shards, so truncation is load-bearing.  The
+    # results-dir name keeps the *requested* count, like the reference.
+    n_used = (nparticles // num_shards) * num_shards
+    # per-shard independent init streams — the SPMD equivalent of the
+    # reference's per-rank torch.manual_seed(rank) (experiments/logreg.py:24)
+    particles = init_particles_per_shard(0, n_used, d, num_shards)
+
+    sampler = dt.DistSampler(
+        num_shards,
+        logreg_logp,
+        None,  # reference RBF(bandwidth=1) kernel
+        particles,
+        data=(x_train, t_train),
+        exchange_particles=exchange in ("all_particles", "all_scores"),
+        exchange_scores=exchange == "all_scores",
+        include_wasserstein=wasserstein,
+    )
+
+    # history: reference records each rank's owned block before every step
+    # plus a final post-update snapshot (experiments/logreg.py:78-87).
+    # Blocks are accumulated as numpy snapshots and turned into the pickle
+    # schema once at the end, so the hot loop does one device sync per step.
+    shard_blocks = [[] for _ in range(num_shards)]
+
+    def record():
+        global_now = np.asarray(sampler.particles)
+        per = global_now.shape[0] // num_shards
+        for r in range(num_shards):
+            b = sampler.owned_block_index(r)
+            shard_blocks[r].append(global_now[b * per : (b + 1) * per])
+
+    for _ in range(niter):
+        record()
+        sampler.make_step(stepsize, h=10.0)  # h=10 matches logreg.py:83
+    record()
+
+    results_dir = get_results_dir(
+        dataset_name, fold, num_shards, nparticles, stepsize, exchange, wasserstein
+    )
+    for r in range(num_shards):
+        rows = [
+            pd.Series([t, block[i]], index=["timestep", "value"])
+            for t, block in enumerate(shard_blocks[r])
+            for i in range(block.shape[0])
+        ]
+        pd.DataFrame(rows).to_pickle(os.path.join(results_dir, f"shard-{r}.pkl"))
+    return sampler
+
+
+@click.command()
+@click.option("--dataset", type=click.Choice([
+    "banana", "diabetis", "german", "image", "splice", "titanic", "waveform"]),
+    default="banana")
+@click.option("--fold", type=int, default=42)
+@click.option("--nproc", type=click.IntRange(0, 32), default=1,
+              help="number of shards (the reference's world size)")
+@click.option("--nparticles", type=int, default=10)
+@click.option("--niter", type=int, default=100)
+@click.option("--stepsize", type=float, default=1e-3)
+@click.option("--exchange", type=click.Choice(["partitions", "all_particles", "all_scores"]),
+              default="partitions")
+@click.option("--wasserstein/--no-wasserstein", default=False)
+@click.option("--master_addr", default="127.0.0.1", type=str,
+              help="no-op under SPMD; kept for reference CLI compatibility")
+@click.option("--master_port", default=29500, type=int,
+              help="no-op under SPMD; kept for reference CLI compatibility")
+@click.option("--backend", type=click.Choice(["auto", "tpu", "cpu"]), default="auto",
+              help="device backend for the jitted step")
+@click.option("--plots/--no-plots", default=True)
+@click.pass_context
+def cli(ctx, dataset, fold, nproc, nparticles, niter, stepsize, exchange,
+        wasserstein, master_addr, master_port, backend, plots):
+    _select_backend(backend)
+    # normalise nproc=0 to a single shard up front so the results dir, the
+    # run, and the plots all agree on the same config name
+    nproc = max(nproc, 1)
+
+    # clean out any previous results (reference behaviour, logreg.py:120-124)
+    results_dir = get_results_dir(dataset, fold, nproc, nparticles, stepsize, exchange, wasserstein)
+    if os.path.isdir(results_dir):
+        shutil.rmtree(results_dir)
+    os.makedirs(results_dir)
+
+    run(nproc, dataset, fold, nparticles, niter, stepsize, exchange, wasserstein)
+
+    if plots:
+        ctx.invoke(
+            make_plots, dataset=dataset, fold=fold, nproc=nproc,
+            nparticles=nparticles, stepsize=stepsize, exchange=exchange,
+            wasserstein=wasserstein,
+        )
+
+
+if __name__ == "__main__":
+    cli()

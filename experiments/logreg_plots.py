@@ -1,0 +1,170 @@
+"""Evaluation / plotting for the Bayesian-logreg experiment.
+
+Counterpart of the reference's ``experiments/logreg_plots.py:19-127`` with
+matplotlib PNGs in place of the visdom server (a dead-weight external
+dependency — SURVEY.md §5 metrics row): the test-accuracy-vs-iteration curve
+against an sklearn ``LogisticRegression`` baseline, plus (for banana) particle
+scatter and α histograms.
+
+Reference quirks handled deliberately (SURVEY.md §7.4):
+- results-dir naming doubles as the config record and must keep the exact
+  reference format for sweep compatibility (logreg_plots.py:19-22);
+- the posterior-predictive ``prob`` decodes α but uses only w
+  (logreg_plots.py:44-48) — replicated via models.logreg;
+- the reference gates the banana scatter/histogram plots on the string
+  literal comparison ``'dataset' == 'banana'`` which is always False
+  (logreg_plots.py:116, dead code) — fixed here to compare the variable, as
+  clearly intended.
+"""
+
+import os
+from glob import glob
+
+import click
+import numpy as np
+import pandas as pd
+
+from paths import DATA_DIR, FIGURES_DIR, RESULTS_DIR
+
+from dist_svgd_tpu.models.logreg import posterior_predictive_prob
+from dist_svgd_tpu.utils.datasets import load_benchmark
+
+TIMESTEPS_BETWEEN_KDE_PLOTS = 10
+
+
+def get_results_dir(dataset_name, fold, nproc, nparticles, stepsize, exchange, wasserstein):
+    """Config-encoded results dir — exact reference naming
+    (logreg_plots.py:19-22)."""
+    subdir = "logreg_{}_{}-nshards={}-nparticles={}-exchange={}-wasserstein={}-stepsize={:.0e}".format(
+        dataset_name, fold, nproc, nparticles, exchange, wasserstein, stepsize
+    )
+    return os.path.join(RESULTS_DIR, subdir)
+
+
+def _mat_path():
+    return os.path.join(DATA_DIR, "benchmarks.mat")
+
+
+def sklearn_baseline_accuracy(fold_data) -> float:
+    """Reference baseline: sklearn LogisticRegression fit on the same fold
+    (logreg_plots.py:37-39)."""
+    from sklearn.linear_model import LogisticRegression
+
+    clf = LogisticRegression()
+    clf.fit(fold_data.x_train, fold_data.t_train.reshape(-1))
+    return float(clf.score(fold_data.x_test, fold_data.t_test.reshape(-1)))
+
+
+def test_accuracy_curve(df, fold_data):
+    """Per-timestep ensemble posterior-predictive-mean accuracy
+    (reference logreg_plots.py:42-57 semantics: mean σ(x·w) over particles,
+    threshold 0.5, compare t > 0)."""
+    t_test = fold_data.t_test.reshape(-1) > 0
+    rows = []
+    for t, group in df.groupby("timestep"):
+        particles = np.stack(group["value"].values)
+        probs = np.asarray(posterior_predictive_prob(particles, fold_data.x_test))
+        acc = float(((probs.mean(axis=0) > 0.5) == t_test).mean())
+        rows.append((int(t), acc))
+    rows.sort()
+    return np.asarray(rows)
+
+
+def plot_test_acc(df, plot_title, dataset_name, fold, out_path):
+    fold_data = load_benchmark(dataset_name, fold, mat_path=_mat_path())
+    baseline = sklearn_baseline_accuracy(fold_data)
+    curve = test_accuracy_curve(df, fold_data)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.plot(curve[:, 0], curve[:, 1], label="dsvgd")
+    ax.axhline(baseline, color="tab:orange", ls="--", label="sklearn logreg")
+    ax.set_xlabel("Iteration")
+    ax.set_ylabel("Test accuracy")
+    ax.set_title(plot_title, fontsize=8)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=150)
+    plt.close(fig)
+    return curve, baseline
+
+
+def plot_w_scatters(df, plot_title, out_dir):
+    """Particle (w1, w2) scatter per sampled timestep
+    (reference logreg_plots.py:69-80)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    for t in range(0, int(df["timestep"].max()), TIMESTEPS_BETWEEN_KDE_PLOTS):
+        vals = np.stack(df[df["timestep"] == t]["value"].values)
+        fig, ax = plt.subplots(figsize=(4, 4))
+        ax.scatter(vals[:, 1], vals[:, 2], s=8)
+        ax.set_xlim(-1.5, 1.5)
+        ax.set_ylim(-3, 2)
+        ax.set_xlabel("w1")
+        ax.set_ylabel("w2")
+        ax.set_title(plot_title(t), fontsize=7)
+        fig.tight_layout()
+        fig.savefig(os.path.join(out_dir, f"particles_w1_w2_t{t}.png"), dpi=120)
+        plt.close(fig)
+
+
+def plot_alpha_hist(df, plot_title, out_dir):
+    """Histogram of the (log) α component (reference logreg_plots.py:82-93)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    for t in range(0, int(df["timestep"].max()), TIMESTEPS_BETWEEN_KDE_PLOTS):
+        vals = np.stack(df[df["timestep"] == t]["value"].values)[:, 0]
+        fig, ax = plt.subplots(figsize=(4, 3))
+        ax.hist(vals, bins=20, range=(-2, 2))
+        ax.set_xlabel("alpha")
+        ax.set_title(plot_title(t), fontsize=7)
+        fig.tight_layout()
+        fig.savefig(os.path.join(out_dir, f"particles_alpha_t{t}.png"), dpi=120)
+        plt.close(fig)
+
+
+@click.command()
+@click.option("--dataset", type=click.Choice([
+    "banana", "diabetis", "german", "image", "splice", "titanic", "waveform"]),
+    default="banana")
+@click.option("--fold", type=int, default=42)
+@click.option("--nproc", type=click.IntRange(0, 32), default=1)
+@click.option("--nparticles", type=int, default=10)
+@click.option("--stepsize", type=float, default=1e-3)
+@click.option("--exchange", type=click.Choice(["partitions", "all_particles", "all_scores"]),
+              default="partitions")
+@click.option("--wasserstein/--no-wasserstein", default=False)
+def make_plots(dataset, fold, nproc, nparticles, stepsize, exchange, wasserstein, **kwargs):
+    """Aggregate shard-*.pkl results and write evaluation PNGs
+    (reference make_plots, logreg_plots.py:95-124)."""
+    results_dir = get_results_dir(dataset, fold, nproc, nparticles, stepsize, exchange, wasserstein)
+    df = pd.concat(map(pd.read_pickle, glob(os.path.join(results_dir, "shard-*.pkl"))))
+
+    cfg = "logreg_{}_{} {} nshards={} nparticles={} exchange={} wasserstein={} stepsize={:.0e}".format(
+        dataset, fold, "test_acc", nproc, nparticles, exchange, wasserstein, stepsize)
+    fig_base = os.path.basename(results_dir)
+    curve, baseline = plot_test_acc(
+        df, cfg, dataset, fold, os.path.join(FIGURES_DIR, fig_base + "-test_acc.png"))
+    print(f"final dsvgd accuracy {curve[-1, 1]:.4f} vs sklearn {baseline:.4f}")
+
+    if dataset == "banana":  # reference had dead `'dataset' == 'banana'` here
+        out_dir = os.path.join(FIGURES_DIR, fig_base)
+        os.makedirs(out_dir, exist_ok=True)
+        title_w = lambda t: f"{fig_base} particles_w1_w2 t={t}"
+        plot_w_scatters(df, title_w, out_dir)
+        title_a = lambda t: f"{fig_base} particles_alpha t={t}"
+        plot_alpha_hist(df, title_a, out_dir)
+
+
+if __name__ == "__main__":
+    make_plots()

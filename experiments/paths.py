@@ -1,0 +1,19 @@
+"""Repo-relative path constants — counterpart of the reference's
+``definitions.py:1-7`` — plus the sys.path bootstrap that lets the experiment
+scripts import ``dist_svgd_tpu`` when run directly
+(``python experiments/gmm.py``)."""
+
+import os
+import sys
+
+EXPERIMENTS_DIR = os.path.dirname(os.path.abspath(__file__))
+ROOT_DIR = os.path.dirname(EXPERIMENTS_DIR)
+FIGURES_DIR = os.path.join(EXPERIMENTS_DIR, "figures")
+DATA_DIR = os.path.join(EXPERIMENTS_DIR, "data")
+RESULTS_DIR = os.path.join(EXPERIMENTS_DIR, "results")
+
+if ROOT_DIR not in sys.path:
+    sys.path.insert(0, ROOT_DIR)
+
+for _d in (FIGURES_DIR, DATA_DIR, RESULTS_DIR):
+    os.makedirs(_d, exist_ok=True)

@@ -1,0 +1,79 @@
+"""Experiment-driver smoke tests: the CLIs run end-to-end in a subprocess
+(fresh interpreter, CPU backend) and produce the reference's artifacts."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_script(args, timeout=110):
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
+    return subprocess.run(
+        [sys.executable] + args, cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+def test_logreg_cli_grid_style_config(tmp_path):
+    """grid.sh's awkward case: 50 particles on 4 shards (not divisible —
+    must truncate, not crash) with plots."""
+    res = run_script([
+        "experiments/logreg.py", "--dataset", "banana", "--fold", "3",
+        "--nproc", "4", "--nparticles", "50", "--niter", "5",
+        "--stepsize", "3e-3", "--exchange", "all_particles",
+        "--no-wasserstein", "--plots",
+    ])
+    assert res.returncode == 0, res.stderr[-2000:]
+    results_dir = os.path.join(
+        REPO, "experiments", "results",
+        "logreg_banana_3-nshards=4-nparticles=50-exchange=all_particles-wasserstein=False-stepsize=3e-03",
+    )
+    pkls = sorted(os.listdir(results_dir))
+    assert pkls == [f"shard-{r}.pkl" for r in range(4)]
+    df = pd.read_pickle(os.path.join(results_dir, "shard-0.pkl"))
+    assert list(df.columns) == ["timestep", "value"]
+    # 50 // 4 * 4 = 48 → 12 per shard, niter+1 snapshots
+    assert len(df) == 12 * 6
+    assert "accuracy" in res.stdout
+
+
+@pytest.mark.slow
+def test_logreg_cli_nproc_zero_normalised():
+    res = run_script([
+        "experiments/logreg.py", "--dataset", "titanic", "--fold", "1",
+        "--nproc", "0", "--nparticles", "6", "--niter", "2",
+        "--exchange", "partitions", "--no-wasserstein", "--no-plots",
+    ])
+    assert res.returncode == 0, res.stderr[-2000:]
+    results_dir = os.path.join(
+        REPO, "experiments", "results",
+        "logreg_titanic_1-nshards=1-nparticles=6-exchange=partitions-wasserstein=False-stepsize=1e-03",
+    )
+    assert os.path.exists(os.path.join(results_dir, "shard-0.pkl"))
+
+
+@pytest.mark.slow
+def test_gmm_experiment_writes_figure():
+    # tiny config via import (same process would fight the conftest backend;
+    # subprocess keeps it faithful to `python experiments/gmm.py`)
+    code = (
+        "import gmm, os; df = gmm.run(seed=42); "
+        "p = gmm.plot(df, os.path.join(gmm.FIGURES_DIR, 'gmm_test.png')); print(p)"
+    )
+    res = run_script(["-c", f"import sys; sys.path.insert(0, 'experiments'); {code}"])
+    assert res.returncode == 0, res.stderr[-2000:]
+    fig = os.path.join(REPO, "experiments", "figures", "gmm_test.png")
+    assert os.path.exists(fig)
+    os.remove(fig)
