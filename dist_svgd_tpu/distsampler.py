@@ -46,6 +46,7 @@ from dist_svgd_tpu.parallel.exchange import (
     make_shard_step,
 )
 from dist_svgd_tpu.parallel.mesh import AXIS, bind_shard_fn, make_mesh
+from dist_svgd_tpu.utils.rng import minibatch_key
 
 
 def _data_rows(data) -> int:
@@ -88,6 +89,21 @@ class DistSampler:
         mesh: ``'auto'`` (build a real mesh if the host has ≥ S devices, else
             vmap emulation), an explicit ``jax.sharding.Mesh``, or ``None``
             to force emulation.
+        exchange_impl: ``'gather'`` (``lax.all_gather``/``psum`` collectives)
+            or ``'ring'`` (``lax.ppermute`` block rotation with blockwise φ
+            accumulation — same semantics, O(n/S) per-device memory; see
+            ``parallel/exchange.py``).  Only affects the ``all_*`` modes.
+        shard_data: shard the data rows over the mesh instead of replicating
+            the full set to every device (``all_*`` modes only).  Rows are
+            truncated to ``S · (rows // S)`` (reference drop policy).
+        batch_size: per-step per-shard minibatch size: each shard scores a
+            fresh without-replacement sample of its rows, scaled
+            ``rows_per_shard / batch_size`` (unbiased; see
+            ``parallel/exchange.py``).  BASELINE.json config 4.
+        log_prior: optional separate prior ``log_prior(theta)``; when given,
+            ``logp`` is pure likelihood and the prior gradient is added once,
+            unscaled (see ``parallel/exchange.py``).
+        seed: root PRNG seed for the per-step minibatch streams.
     """
 
     def __init__(
@@ -106,12 +122,21 @@ class DistSampler:
         sinkhorn_eps: float = 0.05,
         sinkhorn_iters: int = 200,
         mesh="auto",
+        exchange_impl: str = "gather",
+        shard_data: bool = False,
+        batch_size: Optional[int] = None,
+        log_prior: Optional[Callable] = None,
+        seed=0,
     ):
         assert not (exchange_scores and not exchange_particles), (
             "must exchange particles to also exchange scores"
         )
         if wasserstein_solver not in ("lp", "sinkhorn"):
             raise ValueError(f"unknown wasserstein_solver {wasserstein_solver!r}")
+        if exchange_impl not in ("gather", "ring"):
+            raise ValueError(f"unknown exchange_impl {exchange_impl!r}")
+        if shard_data and not exchange_particles:
+            raise ValueError("shard_data is unsupported in partitions mode")
 
         self._num_shards = int(num_shards)
         self._logp = logp
@@ -132,6 +157,8 @@ class DistSampler:
         self._particles = particles[: self._num_particles]
         self._d = particles.shape[1]
 
+        self._exchange_impl = exchange_impl
+        self._shard_data = shard_data
         self._data = None if data is None else jax.tree_util.tree_map(jnp.asarray, data)
         # Physical slice size per shard is always rows // S (reference drop
         # policy); N_local/N_global are pure importance-scale factors like the
@@ -156,6 +183,12 @@ class DistSampler:
 
         self._mesh = make_mesh(self._num_shards) if mesh == "auto" else mesh
 
+        if shard_data and self._data is not None:
+            # truncate to divisible row count before the mesh split (the
+            # replicated path drops the remainder at slice time instead)
+            keep = self._rows_per_shard * self._num_shards
+            self._data = jax.tree_util.tree_map(lambda a: a[:keep], self._data)
+
         step = make_shard_step(
             logp=self._logp,
             kernel=self._kernel,
@@ -163,16 +196,21 @@ class DistSampler:
             num_shards=self._num_shards,
             n_local_data=self._rows_per_shard,
             score_scale=self._score_scale,
+            ring=(exchange_impl == "ring"),
+            shard_data=shard_data,
+            batch_size=batch_size,
+            log_prior=log_prior,
         )
         self._step = jax.jit(
             bind_shard_fn(
                 step,
                 self._num_shards,
                 self._mesh,
-                in_specs=(0, None, 0, None, None, None),
+                in_specs=(0, 0 if shard_data else None, 0, None, None, None, None),
                 out_specs=(0,),
             )
         )
+        self._batch_key = minibatch_key(seed)
 
         # Wasserstein "previous particles" state.  In exchanged modes this is
         # a per-shard (S, n, d) stack (each shard's own warty mixed snapshot);
@@ -286,6 +324,7 @@ class DistSampler:
             self._data,
             w_grad,
             jnp.asarray(self._t, dtype=jnp.int32),
+            jax.random.fold_in(self._batch_key, self._t),
             jnp.asarray(step_size, dtype=dtype),
             jnp.asarray(h, dtype=dtype),
         )

@@ -7,7 +7,7 @@ from dist_svgd_tpu.ops.kernels import (
     median_bandwidth,
     squared_distances,
 )
-from dist_svgd_tpu.ops.svgd import phi, svgd_step, svgd_step_sequential
+from dist_svgd_tpu.ops.svgd import phi, phi_chunked, svgd_step, svgd_step_sequential
 
 __all__ = [
     "RBF",
@@ -16,6 +16,7 @@ __all__ = [
     "median_bandwidth",
     "squared_distances",
     "phi",
+    "phi_chunked",
     "svgd_step",
     "svgd_step_sequential",
 ]

@@ -75,6 +75,41 @@ def phi(
     return (K.T @ scores + jnp.sum(gK, axis=0)) / m
 
 
+def phi_chunked(
+    updated: jax.Array,
+    interacting: jax.Array,
+    scores: jax.Array,
+    kernel=None,
+    chunk_size: int = 1024,
+) -> jax.Array:
+    """φ̂* accumulated over chunks of the interaction set — identical result
+    to :func:`phi` (modulo float summation order) without materialising the
+    full ``(m, k)`` Gram matrix.
+
+    The single-device counterpart of the distributed ring accumulation
+    (``parallel/exchange.py``): peak memory is O(chunk_size · k) instead of
+    O(m · k), for interaction sets too large for HBM (SURVEY.md §7.3 item 4).
+    """
+    if kernel is None:
+        kernel = RBF(1.0)
+    m, d = interacting.shape
+    main = (m // chunk_size) * chunk_size
+
+    def body(acc, xs):
+        x, s = xs
+        return acc + (chunk_size / m) * phi(updated, x, s, kernel), None
+
+    acc = jnp.zeros_like(updated)
+    if main:
+        xb = interacting[:main].reshape(-1, chunk_size, d)
+        sb = scores[:main].reshape(-1, chunk_size, d)
+        acc, _ = lax.scan(body, acc, (xb, sb))
+    if main < m:
+        tail = m - main
+        acc = acc + (tail / m) * phi(updated, interacting[main:], scores[main:], kernel)
+    return acc
+
+
 def svgd_step(
     particles: jax.Array,
     scores: jax.Array,

@@ -24,12 +24,31 @@ rotates** — block ``b`` at step ``t`` is updated against data slice
 slice ``(b + t) mod S``).  The global particle array therefore stays in
 logical order at all times.  Like the reference — where every rank loads the
 full dataset and slices its block (experiments/logreg.py:28,41-51) — the
-dataset is replicated across devices and sliced per-shard with
-``lax.dynamic_slice``; a sharded-data path with ``ppermute`` rotation is the
-planned optimisation for datasets that don't fit per-device HBM.
+dataset is by default replicated across devices and sliced per-shard with
+``lax.dynamic_slice``; ``shard_data=True`` instead shards the data rows over
+the mesh (for datasets that don't fit per-device HBM; ``all_*`` modes only,
+since ``partitions`` needs a different slice each step).
 
 Each strategy is one jit-compiled function; XLA overlaps the collective with
 the score/kernel compute.
+
+**Ring execution** (``ring=True``): the long-context analog (SURVEY.md §5).
+For large n the all-gather materialises the full ``(n, d)`` set and an
+``(n, n/S)`` Gram block per device.  The ring implementation instead rotates
+particle blocks hop-by-hop around the mesh with ``lax.ppermute`` — the exact
+motif of ring attention's KV rotation — and accumulates each visiting block's
+φ contribution into a running ``(n/S, d)`` array, so per-device memory is
+O(n/S · d + (n/S)²) regardless of S:
+
+- ``all_particles`` + ring: one pass; each hop scores the *visiting* block on
+  the device's local data (importance-scaled), reproducing the gather mode's
+  semantics (every rank scores all particles on its own slice,
+  dsvgd/distsampler.py:96-99) exactly — same math, different reduction order.
+- ``all_scores`` + ring: two passes.  Pass 1 rotates each block through every
+  device, accumulating local-data score contributions so each block arrives
+  home carrying the exact global score (the ``psum`` result, reference
+  dsvgd/distsampler.py:160-170).  Pass 2 rotates (block, score) pairs and
+  accumulates φ.
 """
 
 from __future__ import annotations
@@ -42,6 +61,7 @@ from jax import lax
 
 from dist_svgd_tpu.ops.svgd import phi
 from dist_svgd_tpu.parallel.mesh import AXIS
+from dist_svgd_tpu.utils.rng import draw_minibatch
 
 ALL_PARTICLES = "all_particles"
 ALL_SCORES = "all_scores"
@@ -61,6 +81,76 @@ def _slice_data(data, start: jax.Array, size: int):
     )
 
 
+def _ring_perm(num_shards: int):
+    """Send-to-next-rank permutation — the reference's ring direction
+    (rank → rank+1, dsvgd/distsampler.py:134-143)."""
+    return [(j, (j + 1) % num_shards) for j in range(num_shards)]
+
+
+def _ring_phi_local_scores(y_block, score_of, kernel, num_shards):
+    """Single-pass ring φ with ``all_particles`` semantics: the visiting block
+    is scored by *this* device's ``score_of`` (local data, importance-scaled,
+    prior included).  Equal block sizes let each hop contribute
+    ``phi(y, visiting, s)`` (already normalised by the block size) so the mean
+    over hops is the global-mean φ."""
+    perm = _ring_perm(num_shards)
+
+    def body(i, carry):
+        visiting, acc = carry
+        acc = acc + phi(y_block, visiting, score_of(visiting), kernel)
+        return lax.ppermute(visiting, AXIS, perm), acc
+
+    # S−1 (accumulate, rotate) hops, then the last visiting block needs no
+    # rotation — the loop body's trailing ppermute would be a wasted
+    # inter-device transfer XLA cannot elide.
+    visiting, acc = lax.fori_loop(
+        0, num_shards - 1, body, (y_block, jnp.zeros_like(y_block))
+    )
+    acc = acc + phi(y_block, visiting, score_of(visiting), kernel)
+    return acc / num_shards
+
+
+def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, kernel, num_shards):
+    """Two-pass ring φ with ``all_scores`` semantics.  Pass 1 carries each
+    block once around the ring, summing per-device local-data likelihood
+    scores into an accumulator that travels with it — after S hops the block
+    is home with the exact global score (the ``lax.psum`` result, modulo
+    summation order); the prior gradient (identity when the prior lives
+    inside ``logp``) is then added once.  Pass 2 rotates (block, score) pairs
+    and accumulates φ."""
+    perm = _ring_perm(num_shards)
+
+    def score_body(i, carry):
+        visiting, vscores = carry
+        vscores = vscores + lik_score_of(visiting)
+        return (
+            lax.ppermute(visiting, AXIS, perm),
+            lax.ppermute(vscores, AXIS, perm),
+        )
+
+    visiting, vscores = lax.fori_loop(
+        0, num_shards, score_body, (y_block, jnp.zeros_like(y_block))
+    )
+    vscores = vscores + prior_score_of(visiting)
+
+    def phi_body(i, carry):
+        visiting, vscores, acc = carry
+        acc = acc + phi(y_block, visiting, vscores, kernel)
+        return (
+            lax.ppermute(visiting, AXIS, perm),
+            lax.ppermute(vscores, AXIS, perm),
+            acc,
+        )
+
+    # S−1 hops + one rotation-free tail, as in _ring_phi_local_scores (here
+    # the saving is two transfers: the block and its travelling scores).
+    visiting, vscores, acc = lax.fori_loop(
+        0, num_shards - 1, phi_body, (visiting, vscores, jnp.zeros_like(y_block))
+    )
+    acc = acc + phi(y_block, visiting, vscores, kernel)
+    return acc / num_shards
+
+
 def make_shard_step(
     logp: Callable,
     kernel,
@@ -68,6 +158,10 @@ def make_shard_step(
     num_shards: int,
     n_local_data: int,
     score_scale: float,
+    ring: bool = False,
+    shard_data: bool = False,
+    batch_size: Optional[int] = None,
+    log_prior: Optional[Callable] = None,
 ) -> Callable:
     """Build the per-shard SVGD step for one exchange strategy.
 
@@ -82,9 +176,29 @@ def make_shard_step(
         score_scale: ``N_global / N_local`` importance factor applied when
             scores are *not* exchanged (dsvgd/distsampler.py:96-99); pass 1.0
             for data-free targets.
+        ring: use the ``ppermute`` ring-rotation implementation of the
+            ``all_*`` exchange (module docstring) instead of
+            ``all_gather``/``psum`` — same semantics, O(n/S) per-device
+            memory.  Ignored for ``partitions`` (already block-local).
+        shard_data: the step's ``data`` argument is this shard's slice (data
+            sharded over the mesh) rather than the replicated full set.
+            Unsupported in ``partitions`` mode, whose rotating data-rank
+            assignment needs access to every slice.
+        batch_size: per-step per-shard minibatch size B: each shard draws B
+            of its ``n_local_data`` rows without replacement (its own fold of
+            the step key) and scales the data-dependent score by
+            ``n_local_data / B`` — an unbiased estimate of its full-slice
+            score, so every exchange mode's downstream combination
+            (psum / importance scale) is unchanged (writeup.tex:214-231).
+        log_prior: optional ``log_prior(theta)``.  When given, ``logp`` is
+            treated as pure likelihood; the prior gradient is added once,
+            after the minibatch scale / psum / importance scale (so it is
+            neither minibatch-amplified nor summed S times — unlike the
+            reference, whose in-logp prior is importance-scaled,
+            dsvgd/distsampler.py:96-99, and psum-multiplied in all_scores).
 
     Returns:
-        ``step(block, data_full, w_grad_block, t, step_size, h) -> new_block``
+        ``step(block, data, w_grad_block, t, key, step_size, h) -> new_block``
         written against block-local shapes and the named axis
         :data:`~dist_svgd_tpu.parallel.mesh.AXIS`; bind it with
         :func:`~dist_svgd_tpu.parallel.mesh.bind_shard_fn`.
@@ -96,30 +210,64 @@ def make_shard_step(
     """
     if mode not in MODES:
         raise ValueError(f"unknown exchange mode {mode!r}")
+    if shard_data and mode == PARTITIONS:
+        raise ValueError("shard_data is unsupported in partitions mode")
+    if batch_size is not None and not 0 < batch_size <= n_local_data:
+        raise ValueError(
+            f"batch_size {batch_size} not in (0, {n_local_data}] local rows"
+        )
 
     score_fn = jax.grad(logp, argnums=0)
     batched_score = jax.vmap(score_fn, in_axes=(0, None))
+    if log_prior is not None:
+        batched_prior = jax.vmap(jax.grad(log_prior))
+    else:
+        batched_prior = lambda thetas: jnp.zeros_like(thetas)
 
-    def step(block, data_full, w_grad_block, t, step_size, h):
+    def step(block, data, w_grad_block, t, key, step_size, h):
         r = lax.axis_index(AXIS)
-        if mode == PARTITIONS:
-            data_rank = (r + t.astype(r.dtype)) % num_shards
+        if shard_data:
+            data_local = data
         else:
-            data_rank = r
-        data_local = _slice_data(data_full, data_rank * n_local_data, n_local_data)
+            if mode == PARTITIONS:
+                data_rank = (r + t.astype(r.dtype)) % num_shards
+            else:
+                data_rank = r
+            data_local = _slice_data(data, data_rank * n_local_data, n_local_data)
+
+        # One minibatch per shard per step, shared across every use of this
+        # shard's data within the step (keeps ring ≡ gather exactly).
+        mb_scale = jnp.asarray(1.0, dtype=block.dtype)
+        if batch_size is not None:
+            data_local, scale = draw_minibatch(
+                jax.random.fold_in(key, r), data_local, n_local_data, batch_size
+            )
+            mb_scale = jnp.asarray(scale, dtype=block.dtype)
+
+        def lik_score_of(thetas):
+            return mb_scale * batched_score(thetas, data_local)
 
         if mode == PARTITIONS:
-            interacting = block
-            scores = score_scale * batched_score(block, data_local)
+            scores = score_scale * lik_score_of(block) + batched_prior(block)
+            delta = phi(block, block, scores, kernel)
+        elif ring:
+            if mode == ALL_SCORES:
+                delta = _ring_phi_exact_scores(
+                    block, lik_score_of, batched_prior, kernel, num_shards
+                )
+            else:
+                score_of = lambda th: score_scale * lik_score_of(th) + batched_prior(th)
+                delta = _ring_phi_local_scores(block, score_of, kernel, num_shards)
         else:
             interacting = lax.all_gather(block, AXIS, tiled=True)
-            local_scores = batched_score(interacting, data_local)
+            local_scores = lik_score_of(interacting)
             if mode == ALL_SCORES:
                 scores = lax.psum(local_scores, AXIS)
             else:
                 scores = score_scale * local_scores
+            scores = scores + batched_prior(interacting)
+            delta = phi(block, interacting, scores, kernel)
 
-        delta = phi(block, interacting, scores, kernel)
         delta = delta + h * w_grad_block
         return block + step_size * delta
 

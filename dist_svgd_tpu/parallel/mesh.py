@@ -86,18 +86,23 @@ def bind_shard_fn(
         axis_size=num_shards,
     )
 
-    def split(a, s):
-        if s is None:
-            return a
+    def _split_leaf(a, s):
         shape = a.shape
         assert shape[s] % num_shards == 0, (shape, s, num_shards)
         return a.reshape(shape[:s] + (num_shards, shape[s] // num_shards) + shape[s + 1:])
 
+    def split(a, s):
+        if s is None or a is None:
+            return a
+        return jax.tree_util.tree_map(lambda x: _split_leaf(x, s), a)
+
     def merge(o, s):
-        if s is None:
+        if s is None or o is None:
             return o
-        shape = o.shape
-        return o.reshape(shape[:s] + (shape[s] * shape[s + 1],) + shape[s + 2:])
+        return jax.tree_util.tree_map(
+            lambda x: x.reshape(x.shape[:s] + (x.shape[s] * x.shape[s + 1],) + x.shape[s + 2:]),
+            o,
+        )
 
     def wrapped(*args):
         outs = vf(*[split(a, s) for a, s in zip(args, in_specs)])

@@ -25,7 +25,7 @@ from jax import lax
 from dist_svgd_tpu.ops.kernels import RBF
 from dist_svgd_tpu.ops.svgd import phi, svgd_step_sequential
 from dist_svgd_tpu.utils.history import history_to_dataframe
-from dist_svgd_tpu.utils.rng import as_key, init_particles
+from dist_svgd_tpu.utils.rng import as_key, draw_minibatch, init_particles, minibatch_key
 
 
 class Sampler:
@@ -35,12 +35,28 @@ class Sampler:
         d: particle dimensionality.
         logp: scalar log-density ``logp(theta)`` with ``theta`` of shape
             ``(d,)`` — a user-supplied JAX-traceable closure, mirroring the
-            reference's model-agnostic design (dsvgd/sampler.py:7-17).
+            reference's model-agnostic design (dsvgd/sampler.py:7-17).  When
+            ``data`` is given the signature is ``logp(theta, data_batch)``
+            instead.
         kernel: :class:`RBF` instance or scalar kernel callable; defaults to
             the reference's ``RBF(bandwidth=1)``.
         update_rule: ``'jacobi'`` (vectorised, TPU-native default) or
             ``'gauss_seidel'`` (the reference's sequential in-place sweep via
             ``lax.scan``, for small-n parity — SURVEY.md §3.2).
+        data: optional pytree of arrays with a common leading data axis,
+            passed to ``logp`` (full, or a per-step minibatch when
+            ``batch_size`` is set).
+        batch_size: per-step minibatch size B.  Each step draws B rows
+            without replacement (fresh fold of the run's seed) and scales the
+            data-dependent score by ``N / B`` — an unbiased stochastic score,
+            the writeup's minibatch approximation (writeup.tex:214-231,
+            BASELINE.json config 4).  Requires ``data``.
+        log_prior: optional ``log_prior(theta)``.  When given, ``logp`` is
+            treated as pure likelihood: only it is minibatch-scaled and the
+            prior gradient is added once, unscaled.  When omitted the ``N/B``
+            factor scales the whole ``logp`` gradient — the reference's
+            importance-scaling convention, which scales its prior term too
+            (dsvgd/distsampler.py:96-99).
     """
 
     def __init__(
@@ -49,17 +65,55 @@ class Sampler:
         logp: Callable,
         kernel=None,
         update_rule: str = "jacobi",
+        data=None,
+        batch_size: Optional[int] = None,
+        log_prior: Optional[Callable] = None,
     ):
         if update_rule not in ("jacobi", "gauss_seidel"):
             raise ValueError(f"unknown update_rule {update_rule!r}")
+        if batch_size is not None and data is None:
+            raise ValueError("batch_size requires data")
+        if batch_size is not None and update_rule != "jacobi":
+            raise ValueError("minibatching supports only the jacobi update rule")
         self._d = d
         self._logp = logp
         self._kernel = kernel if kernel is not None else RBF(1.0)
         self._update_rule = update_rule
-        self._score_fn = jax.grad(logp)
+        self._data = None if data is None else jax.tree_util.tree_map(jnp.asarray, data)
+        self._n_rows = (
+            jax.tree_util.tree_leaves(self._data)[0].shape[0]
+            if self._data is not None
+            else 0
+        )
+        self._batch_size = batch_size
+        if batch_size is not None and not 0 < batch_size <= self._n_rows:
+            raise ValueError(
+                f"batch_size {batch_size} not in (0, {self._n_rows}] rows"
+            )
+        self._log_prior = log_prior
+        if data is None:
+            if log_prior is not None:
+                full = lambda theta: logp(theta) + log_prior(theta)
+            else:
+                full = logp
+        else:
+            if log_prior is not None:
+                full = lambda theta: logp(theta, self._data) + log_prior(theta)
+            else:
+                full = lambda theta: logp(theta, self._data)
+        self._score_fn = jax.grad(full)
         self._compiled = {}
 
     # ------------------------------------------------------------------ #
+
+    def _minibatch_scores(self, parts, key):
+        """Stochastic scores: N/B-scaled batch-likelihood gradient (+ unscaled
+        prior gradient when ``log_prior`` is separate)."""
+        batch, scale = draw_minibatch(key, self._data, self._n_rows, self._batch_size)
+        scores = scale * jax.vmap(jax.grad(self._logp), in_axes=(0, None))(parts, batch)
+        if self._log_prior is not None:
+            scores = scores + jax.vmap(jax.grad(self._log_prior))(parts)
+        return scores
 
     def _run_fn(self, num_iter: int, record: bool):
         """Build (and cache) the jitted scan over `num_iter` steps."""
@@ -70,22 +124,26 @@ class Sampler:
         batched_score = jax.vmap(self._score_fn)
         kernel = self._kernel
         update_rule = self._update_rule
+        minibatch = self._batch_size is not None
 
-        def one_step(parts, step_size):
+        def one_step(parts, step_size, step_key):
+            if minibatch:
+                scores = self._minibatch_scores(parts, step_key)
+                return parts + step_size * phi(parts, parts, scores, kernel)
             if update_rule == "jacobi":
                 scores = batched_score(parts)
                 return parts + step_size * phi(parts, parts, scores, kernel)
             return svgd_step_sequential(parts, self._score_fn, step_size, kernel)
 
         @partial(jax.jit, static_argnums=())
-        def run(particles, step_size):
-            def body(parts, _):
-                new = one_step(parts, step_size)
+        def run(particles, step_size, batch_key):
+            def body(parts, i):
+                new = one_step(parts, step_size, jax.random.fold_in(batch_key, i))
                 if record:
                     return new, parts  # pre-update snapshot (reference convention)
                 return new, None
 
-            final, hist = lax.scan(body, particles, None, length=num_iter)
+            final, hist = lax.scan(body, particles, jnp.arange(num_iter))
             return final, hist
 
         self._compiled[cache_key] = run
@@ -115,7 +173,9 @@ class Sampler:
         else:
             particles = init_particles(as_key(seed), n, self._d, dtype=dtype or jnp.float32)
         run = self._run_fn(num_iter, record)
-        final, hist = run(particles, jnp.asarray(step_size, dtype=particles.dtype))
+        final, hist = run(
+            particles, jnp.asarray(step_size, dtype=particles.dtype), minibatch_key(seed)
+        )
         if record:
             hist = jnp.concatenate([hist, final[None]], axis=0)
         return final, hist

@@ -22,6 +22,26 @@ def as_key(seed_or_key: Union[int, jax.Array]) -> jax.Array:
     return seed_or_key
 
 
+def minibatch_key(seed_or_key) -> jax.Array:
+    """Root key of the minibatch stream, derived from the run seed by a fixed
+    fold so it never collides with the particle-init stream."""
+    return jax.random.fold_in(as_key(seed_or_key), 7919)
+
+
+def draw_minibatch(key, data, n_rows: int, batch_size: int):
+    """One without-replacement minibatch and its importance scale.
+
+    The single sampling convention shared by the single-device and
+    distributed samplers (writeup.tex:214-231 minibatch approximation).
+
+    Returns ``(batch, scale)`` with ``scale = n_rows / batch_size``, the
+    factor that makes ``scale · ∇logp(θ, batch)`` an unbiased estimate of the
+    full-data score for row-additive likelihoods.
+    """
+    idx = jax.random.choice(key, n_rows, (batch_size,), replace=False)
+    return jax.tree_util.tree_map(lambda a: a[idx], data), n_rows / batch_size
+
+
 def init_particles(key, n: int, d: int, dtype=jnp.float32) -> jax.Array:
     """Standard-normal initial particles, matching the reference's
     ``Normal(0, 1).sample((d, 1))`` per particle (dsvgd/sampler.py:58-60)."""

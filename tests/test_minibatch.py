@@ -1,0 +1,181 @@
+"""Minibatched stochastic scores (writeup.tex:214-231 approximation;
+BASELINE.json config 4).
+
+Key exactness property: drawing B = N rows *without replacement* is a
+permutation of the full dataset, and every likelihood here is a sum over
+rows, so the minibatch score equals the full-data score exactly (scale
+N/B = 1).  That turns the stochastic path into a deterministic test.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dist_svgd_tpu import DistSampler, Sampler
+from dist_svgd_tpu.models.logreg import logreg_logp, make_logreg_logp
+
+from test_distsampler import make_gaussian_problem
+
+
+def _problem(rng, n_rows=24):
+    d = 3
+    x = rng.normal(size=(n_rows, d - 1))
+    t = np.where(rng.normal(size=n_rows) > 0, 1.0, -1.0)
+    return (jnp.asarray(x), jnp.asarray(t)), d
+
+
+def test_full_batch_equals_full_data_sampler():
+    rng = np.random.default_rng(101)
+    data, d = _problem(rng)
+    n_rows = data[0].shape[0]
+    full = Sampler(d, make_logreg_logp(*data))
+    mb = Sampler(d, logreg_logp, data=data, batch_size=n_rows)
+    f1, _ = full.run(8, 5, 0.05, seed=3, record=False)
+    f2, _ = mb.run(8, 5, 0.05, seed=3, record=False)
+    np.testing.assert_allclose(np.asarray(f2), np.asarray(f1), rtol=1e-10)
+
+
+def test_separate_prior_full_batch():
+    """log_prior split: lik-only logp + separate prior at B=N equals the
+    fused logp (prior scale is 1 so the split is algebraically neutral)."""
+    rng = np.random.default_rng(103)
+    data, d = _problem(rng)
+    n_rows = data[0].shape[0]
+
+    def lik_only(theta, batch):
+        x, t = batch
+        z = (x @ theta[1:]) * t.reshape(-1)
+        return -jnp.sum(jnp.logaddexp(0.0, -z))
+
+    def prior(theta):
+        alpha = jnp.exp(theta[0])
+        w = theta[1:]
+        k = w.shape[0]
+        return -alpha + 0.5 * k * theta[0] - 0.5 * k * jnp.log(2 * jnp.pi) \
+            - 0.5 * alpha * jnp.dot(w, w)
+
+    init = jnp.asarray(rng.normal(size=(6, d)))  # float64 under x64: the two
+    # gradient groupings are algebraically equal, so only summation-order
+    # noise separates them — tight at double precision
+    fused = Sampler(d, logreg_logp, data=data, batch_size=n_rows)
+    split = Sampler(d, lik_only, data=data, batch_size=n_rows, log_prior=prior)
+    f1, _ = fused.run(6, 4, 0.05, seed=1, record=False, initial_particles=init)
+    f2, _ = split.run(6, 4, 0.05, seed=1, record=False, initial_particles=init)
+    np.testing.assert_allclose(np.asarray(f2), np.asarray(f1), rtol=1e-10)
+
+
+def test_minibatch_scores_unbiased():
+    """E[minibatch score] = full-data score for the log_prior-split estimator
+    (the fused-logp variant deliberately N/B-scales the prior too — the
+    reference's importance-scaling convention — and is *not* unbiased)."""
+    rng = np.random.default_rng(107)
+    data, d = _problem(rng, n_rows=12)
+    n_rows = 12
+    B = 4
+
+    def lik_only(theta, batch):
+        xb, tb = batch
+        z = (xb @ theta[1:]) * tb.reshape(-1)
+        return -jnp.sum(jnp.logaddexp(0.0, -z))
+
+    def prior(theta):
+        alpha = jnp.exp(theta[0])
+        w = theta[1:]
+        k = w.shape[0]
+        return -alpha + 0.5 * k * theta[0] - 0.5 * k * jnp.log(2 * jnp.pi) \
+            - 0.5 * alpha * jnp.dot(w, w)
+
+    theta = jnp.asarray(rng.normal(size=(d,)))
+    full_score = np.asarray(jax.grad(logreg_logp)(theta, data))
+
+    sampler = Sampler(d, lik_only, data=data, batch_size=B, log_prior=prior)
+    keys = jax.random.split(jax.random.PRNGKey(0), 4000)
+    draws = jax.vmap(lambda k: sampler._minibatch_scores(theta[None], k)[0])(keys)
+    mean = np.asarray(jnp.mean(draws, axis=0))
+    se = np.asarray(jnp.std(draws, axis=0)) / np.sqrt(len(keys))
+    np.testing.assert_allclose(mean, full_score, atol=5 * np.max(se) + 1e-8)
+
+
+def test_minibatch_deterministic_per_seed():
+    rng = np.random.default_rng(109)
+    data, d = _problem(rng)
+    outs = []
+    for _ in range(2):
+        s = Sampler(d, logreg_logp, data=data, batch_size=6)
+        f, _ = s.run(8, 5, 0.05, seed=42, record=False)
+        outs.append(np.asarray(f))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    s2 = Sampler(d, logreg_logp, data=data, batch_size=6)
+    f3, _ = s2.run(8, 5, 0.05, seed=43, record=False)
+    assert not np.allclose(outs[0], np.asarray(f3))
+
+
+@pytest.mark.parametrize("exch_s", [True, False])
+@pytest.mark.parametrize("impl", ["gather", "ring"])
+def test_dist_full_batch_equals_full_data(exch_s, impl):
+    """DistSampler with per-shard B = rows_per_shard equals the non-minibatch
+    path in every all_* variant (permutation invariance per shard)."""
+    rng = np.random.default_rng(113)
+    S = 4
+    particles, data, _ = make_gaussian_problem(rng, num_shards=S)
+    rows_per_shard = data[0].shape[0] // S
+    outs = {}
+    for bs in (None, rows_per_shard):
+        ds = DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=True, exchange_scores=exch_s,
+            include_wasserstein=False, exchange_impl=impl, batch_size=bs,
+        )
+        for _ in range(3):
+            out = ds.make_step(0.05)
+        outs[bs] = np.asarray(out)
+    np.testing.assert_allclose(outs[rows_per_shard], outs[None], rtol=1e-10)
+
+
+def test_dist_minibatch_ring_equals_gather():
+    """Same seed ⇒ same per-shard batches ⇒ ring ≡ gather holds even with
+    stochastic scores."""
+    rng = np.random.default_rng(127)
+    S = 4
+    particles, data, _ = make_gaussian_problem(rng, num_shards=S)
+    outs = {}
+    for impl in ("gather", "ring"):
+        ds = DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=True, exchange_scores=True,
+            include_wasserstein=False, exchange_impl=impl,
+            batch_size=3, seed=5,
+        )
+        for _ in range(3):
+            out = ds.make_step(0.05)
+        outs[impl] = np.asarray(out)
+    np.testing.assert_allclose(outs["ring"], outs["gather"], rtol=1e-10)
+
+
+def test_dist_partitions_minibatch_runs():
+    rng = np.random.default_rng(131)
+    S = 4
+    particles, data, _ = make_gaussian_problem(rng, num_shards=S)
+    ds = DistSampler(
+        S, logreg_logp, None, jnp.asarray(particles), data=data,
+        exchange_particles=False, exchange_scores=False,
+        include_wasserstein=False, batch_size=3,
+    )
+    for _ in range(3):
+        out = ds.make_step(0.05)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_batch_size_validation():
+    rng = np.random.default_rng(137)
+    data, d = _problem(rng, n_rows=8)
+    with pytest.raises(ValueError):
+        Sampler(d, logreg_logp, data=data, batch_size=9)
+    with pytest.raises(ValueError):
+        Sampler(d, logreg_logp, batch_size=4)  # no data
+    with pytest.raises(ValueError):
+        DistSampler(
+            2, logreg_logp, None, jnp.zeros((4, d)), data=data,
+            include_wasserstein=False, batch_size=5,  # > 8 // 2 local rows
+        )

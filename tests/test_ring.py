@@ -1,0 +1,161 @@
+"""Ring (ppermute) exchange implementation and blockwise/chunked φ.
+
+The ring path must be *exactly* semantics-equivalent to the gather path
+(SURVEY.md §5 long-context row: blockwise φ accumulation with
+ppermute-rotated particle blocks generalises the reference's ring mode,
+dsvgd/distsampler.py:131-150, from "interact with one block" to "interact
+with all blocks, one at a time").  Differences are float summation order
+only, so tolerances are tight under x64.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dist_svgd_tpu import DistSampler
+from dist_svgd_tpu.models.gmm import gmm_logp
+from dist_svgd_tpu.models.logreg import logreg_logp
+from dist_svgd_tpu.ops.kernels import RBF
+from dist_svgd_tpu.ops.svgd import phi, phi_chunked
+from dist_svgd_tpu.parallel.mesh import make_mesh
+
+from test_distsampler import make_gaussian_problem
+
+
+GATHER_MODES = [("all_scores", True), ("all_particles", False)]
+
+
+@pytest.mark.parametrize("name,exch_s", GATHER_MODES)
+@pytest.mark.parametrize("backend", ["shard_map", "vmap"])
+def test_ring_matches_gather(name, exch_s, backend):
+    """Multi-step ring trajectories equal the gather implementation."""
+    rng = np.random.default_rng(17)
+    S = 4
+    particles, data, _ = make_gaussian_problem(rng, num_shards=S)
+    mesh = make_mesh(S) if backend == "shard_map" else None
+    if backend == "shard_map":
+        assert mesh is not None
+    outs = {}
+    for impl in ("gather", "ring"):
+        ds = DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=True, exchange_scores=exch_s,
+            include_wasserstein=False, mesh=mesh, exchange_impl=impl,
+        )
+        for _ in range(4):
+            out = ds.make_step(0.05)
+        outs[impl] = np.asarray(out)
+    np.testing.assert_allclose(outs["ring"], outs["gather"], rtol=1e-10, atol=1e-12)
+
+
+@pytest.mark.parametrize("name,exch_s", GATHER_MODES)
+@pytest.mark.parametrize("impl", ["gather", "ring"])
+def test_shard_data_matches_replicated(name, exch_s, impl):
+    """Sharding the data rows over the mesh is a pure layout change: the
+    trajectory equals the replicated-data path in every all_* variant."""
+    rng = np.random.default_rng(23)
+    S = 4
+    particles, data, _ = make_gaussian_problem(rng, num_shards=S)
+    outs = {}
+    for shard_data in (False, True):
+        ds = DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=True, exchange_scores=exch_s,
+            include_wasserstein=False, exchange_impl=impl,
+            shard_data=shard_data,
+        )
+        for _ in range(3):
+            out = ds.make_step(0.05)
+        outs[shard_data] = np.asarray(out)
+    np.testing.assert_allclose(outs[True], outs[False], rtol=1e-10, atol=1e-12)
+
+
+def test_shard_data_drops_remainder_rows():
+    """Indivisible row counts shard the first S·(rows//S) rows — the same
+    rows the replicated path's slicing uses (reference drop policy,
+    experiments/logreg.py:35)."""
+    rng = np.random.default_rng(29)
+    S = 4
+    particles, (x, t), _ = make_gaussian_problem(rng, n_rows=24, num_shards=S)
+    ragged = (jnp.concatenate([x, x[:3]]), jnp.concatenate([t, t[:3]]))
+    outs = []
+    for shard_data in (False, True):
+        ds = DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=ragged,
+            exchange_particles=True, exchange_scores=True,
+            include_wasserstein=False, shard_data=shard_data,
+        )
+        outs.append(np.asarray(ds.make_step(0.05)))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-12)
+
+
+def test_ring_rejects_partitions_and_shard_data():
+    parts = jnp.zeros((8, 2))
+    with pytest.raises(ValueError):
+        DistSampler(
+            2, gmm_logp, None, parts,
+            exchange_particles=False, exchange_scores=False,
+            include_wasserstein=False, shard_data=True,
+        )
+    with pytest.raises(ValueError):
+        DistSampler(2, gmm_logp, None, parts, exchange_impl="bogus")
+
+
+def test_ring_single_shard():
+    """S=1 ring degenerates to the plain step (perm [(0,0)] self-loop)."""
+    rng = np.random.default_rng(7)
+    particles, data, _ = make_gaussian_problem(rng, num_shards=1)
+    outs = {}
+    for impl in ("gather", "ring"):
+        ds = DistSampler(
+            1, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=True, exchange_scores=True,
+            include_wasserstein=False, exchange_impl=impl,
+        )
+        outs[impl] = np.asarray(ds.make_step(0.05))
+    np.testing.assert_allclose(outs["ring"], outs["gather"], rtol=1e-12)
+
+
+@pytest.mark.parametrize("chunk_size", [4, 5, 16, 100])
+def test_phi_chunked_matches_phi(chunk_size):
+    """Chunked accumulation (including ragged tails and chunk > m) equals the
+    one-shot φ."""
+    rng = np.random.default_rng(13)
+    y = jnp.asarray(rng.normal(size=(6, 3)))
+    x = jnp.asarray(rng.normal(size=(16, 3)))
+    s = jnp.asarray(rng.normal(size=(16, 3)))
+    want = np.asarray(phi(y, x, s))
+    got = np.asarray(phi_chunked(y, x, s, chunk_size=chunk_size))
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-14)
+
+
+def test_phi_chunked_generic_kernel():
+    """Chunked path also supports non-analytic (autograd-fallback) kernels."""
+    rng = np.random.default_rng(19)
+    y = jnp.asarray(rng.normal(size=(4, 2)))
+    x = jnp.asarray(rng.normal(size=(10, 2)))
+    s = jnp.asarray(rng.normal(size=(10, 2)))
+
+    def imq(a, b):  # inverse multiquadric
+        return 1.0 / jnp.sqrt(1.0 + jnp.sum((a - b) ** 2))
+
+    want = np.asarray(phi(y, x, s, kernel=imq))
+    got = np.asarray(phi_chunked(y, x, s, kernel=imq, chunk_size=4))
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_ring_with_wasserstein_runs():
+    """Ring impl composes with the W2 term (state bookkeeping unaffected)."""
+    rng = np.random.default_rng(37)
+    S = 2
+    particles, data, _ = make_gaussian_problem(rng, n=6, d=2, n_rows=8, num_shards=S)
+    ds = DistSampler(
+        S, logreg_logp, None, jnp.asarray(particles), data=data,
+        exchange_particles=True, exchange_scores=True,
+        include_wasserstein=True, wasserstein_solver="sinkhorn",
+        exchange_impl="ring",
+    )
+    for _ in range(3):
+        out = ds.make_step(0.05, h=0.5)
+    assert bool(jnp.isfinite(out).all())
