@@ -1,6 +1,7 @@
 """Model log-densities (user-supplied closures in the reference; shipped here
 as a library of JAX-traceable builders)."""
 
+from dist_svgd_tpu.models import bnn
 from dist_svgd_tpu.models.gmm import make_gmm_logp, gmm_logp
 from dist_svgd_tpu.models.logreg import (
     make_logreg_logp,
@@ -8,6 +9,7 @@ from dist_svgd_tpu.models.logreg import (
 )
 
 __all__ = [
+    "bnn",
     "make_gmm_logp",
     "gmm_logp",
     "make_logreg_logp",

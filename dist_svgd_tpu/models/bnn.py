@@ -1,0 +1,222 @@
+"""Two-layer Bayesian neural-network regression (weight-vector SVGD).
+
+BASELINE.json config 5: "2-layer Bayesian NN regression (UCI), 500 particles,
+weight-vector SVGD".  The reference repo has no NN model, but SURVEY.md §2.3
+notes the whole weight vector is treated as one particle dimension ``d`` — no
+intra-model sharding required — so this slots into the existing samplers as
+just another user-supplied ``logp`` closure (reference design:
+dsvgd/sampler.py:7-17).
+
+Model (the standard SVGD BNN setup of Liu & Wang 2016, §5):
+
+    hidden  h(x)   = relu(x W1 + b1)            (n_hidden units)
+    output  ŷ(x)   = h(x) w2 + b2               (scalar regression)
+    y | x, w, γ    ~ N(ŷ(x), 1/γ)
+    w (all weights and biases) | λ ~ N(0, 1/λ)
+    γ ~ Gamma(a0, b0),  λ ~ Gamma(a0, b0)       (a0 = 1, b0 = 0.1)
+
+Particle layout — one flat ``(d,)`` vector per particle:
+
+    theta = [vec(W1) | b1 | w2 | b2 | log γ | log λ]
+    d = n_features·n_hidden + n_hidden + n_hidden + 1 + 2
+
+The precisions are carried in log-space so particles live on an unconstrained
+Euclidean space (SVGD's RBF kernel assumes this); the prior density includes
+the change-of-variables Jacobian ``+ log γ`` / ``+ log λ``.  (The reference's
+logreg model omits the Jacobian for its ``log α`` coordinate — a documented
+quirk we replicate *there* (models/logreg.py) but not here, since the BNN has
+no reference counterpart to stay warty-compatible with.)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+#: Gamma hyperpriors on the likelihood precision γ and weight precision λ
+#: (shape a0, rate b0) — the Liu & Wang 2016 BNN values.
+A0 = 1.0
+B0 = 0.1
+
+
+class BNNParams(NamedTuple):
+    """Unpacked view of one flat particle."""
+
+    w1: jax.Array  # (n_features, n_hidden)
+    b1: jax.Array  # (n_hidden,)
+    w2: jax.Array  # (n_hidden,)
+    b2: jax.Array  # ()
+    log_gamma: jax.Array  # () — likelihood precision
+    log_lambda: jax.Array  # () — weight-prior precision
+
+
+def num_params(n_features: int, n_hidden: int = 50) -> int:
+    """Flat particle dimensionality ``d``."""
+    return n_features * n_hidden + n_hidden + n_hidden + 1 + 2
+
+
+def unpack(theta: jax.Array, n_features: int, n_hidden: int = 50) -> BNNParams:
+    """Split a flat ``(d,)`` particle into named network parameters."""
+    k = n_features * n_hidden
+    w1 = theta[:k].reshape(n_features, n_hidden)
+    b1 = theta[k : k + n_hidden]
+    w2 = theta[k + n_hidden : k + 2 * n_hidden]
+    b2 = theta[k + 2 * n_hidden]
+    return BNNParams(w1, b1, w2, b2, theta[-2], theta[-1])
+
+
+def predict(theta: jax.Array, x: jax.Array, n_features: int, n_hidden: int = 50) -> jax.Array:
+    """Network output ``ŷ`` for one particle; ``x`` is ``(N, n_features)``,
+    result ``(N,)``."""
+    p = unpack(theta, n_features, n_hidden)
+    h = jax.nn.relu(x @ p.w1 + p.b1)
+    return h @ p.w2 + p.b2
+
+
+def _log_gamma_prior(log_prec: jax.Array) -> jax.Array:
+    """``log Gamma(prec; A0, B0) + log_prec`` — density of the *log*-precision
+    (change-of-variables Jacobian included)."""
+    prec = jnp.exp(log_prec)
+    # log Γ(A0)⁻¹ b0^a0 prec^(a0-1) e^(-b0 prec), with Γ(1) = 1
+    return A0 * math.log(B0) - math.lgamma(A0) + (A0 - 1.0) * log_prec - B0 * prec + log_prec
+
+
+def bnn_logp(
+    theta: jax.Array,
+    data: Tuple[jax.Array, jax.Array],
+    n_features: int,
+    n_hidden: int = 50,
+) -> jax.Array:
+    """Log joint density of one particle on a data slice ``(x, y)``.
+
+    ``x``: ``(N, n_features)`` standardized features; ``y``: ``(N,)`` targets.
+    The likelihood is a *sum* over rows, so the minibatch/data-sharding
+    machinery's ``N_global/N_local`` (and ``N/B``) scaling is unbiased for it
+    exactly as for the logreg model (dsvgd/distsampler.py:96-99 convention).
+    """
+    x, y = data
+    y = y.reshape(-1)
+    p = unpack(theta, n_features, n_hidden)
+    gamma = jnp.exp(p.log_gamma)
+    lam = jnp.exp(p.log_lambda)
+    n_weights = theta.shape[0] - 2
+
+    pred = predict(theta, x, n_features, n_hidden)
+    n_rows = y.shape[0]
+    lp = 0.5 * n_rows * (p.log_gamma - _LOG_2PI) - 0.5 * gamma * jnp.sum((pred - y) ** 2)
+
+    w = theta[:-2]
+    lp += 0.5 * n_weights * (p.log_lambda - _LOG_2PI) - 0.5 * lam * jnp.dot(w, w)
+    lp += _log_gamma_prior(p.log_gamma) + _log_gamma_prior(p.log_lambda)
+    return lp
+
+
+def make_bnn_logp(n_features: int, n_hidden: int = 50):
+    """``logp(theta, data)`` closure for the samplers' ``data=`` path."""
+
+    def logp(theta, data):
+        return bnn_logp(theta, data, n_features, n_hidden)
+
+    return logp
+
+
+def make_bnn_split(n_features: int, n_hidden: int = 50):
+    """``(likelihood, prior)`` pair for the samplers' ``log_prior=`` path,
+    so only the data term is minibatch-scaled (models the exact posterior
+    under stochastic scores — see Sampler docstring)."""
+
+    def likelihood(theta, data):
+        x, y = data
+        y = y.reshape(-1)
+        p = unpack(theta, n_features, n_hidden)
+        gamma = jnp.exp(p.log_gamma)
+        pred = predict(theta, x, n_features, n_hidden)
+        n_rows = y.shape[0]
+        return 0.5 * n_rows * (p.log_gamma - _LOG_2PI) - 0.5 * gamma * jnp.sum(
+            (pred - y) ** 2
+        )
+
+    def prior(theta):
+        p = unpack(theta, n_features, n_hidden)
+        lam = jnp.exp(p.log_lambda)
+        w = theta[:-2]
+        n_weights = theta.shape[0] - 2
+        lp = 0.5 * n_weights * (p.log_lambda - _LOG_2PI) - 0.5 * lam * jnp.dot(w, w)
+        return lp + _log_gamma_prior(p.log_gamma) + _log_gamma_prior(p.log_lambda)
+
+    return likelihood, prior
+
+
+def init_particles(
+    key: jax.Array, n: int, n_features: int, n_hidden: int = 50, dtype=jnp.float32
+) -> jax.Array:
+    """Initial ``(n, d)`` particle array.
+
+    Network weights ~ N(0, 1/(fan_in+1)) (the Liu & Wang init); log-precisions
+    start at log of a Gamma(A0, B0) draw.
+    """
+    d = num_params(n_features, n_hidden)
+    kw, kg, kl = jax.random.split(key, 3)
+    theta = jax.random.normal(kw, (n, d), dtype=dtype)
+    k = n_features * n_hidden
+    scale = jnp.concatenate(
+        [
+            jnp.full((k + n_hidden,), 1.0 / math.sqrt(n_features + 1.0)),
+            jnp.full((n_hidden + 1,), 1.0 / math.sqrt(n_hidden + 1.0)),
+            jnp.zeros((2,)),
+        ]
+    ).astype(dtype)
+    theta = theta * scale
+    loggam = jnp.log(jax.random.gamma(kg, A0, (n,), dtype=dtype) / B0)
+    loglam = jnp.log(jax.random.gamma(kl, A0, (n,), dtype=dtype) / B0)
+    theta = theta.at[:, -2].set(loggam).at[:, -1].set(loglam)
+    return theta
+
+
+# --------------------------------------------------------------------- #
+# Evaluation (ensemble posterior predictive)
+
+
+def ensemble_rmse(
+    particles: jax.Array,
+    x_test: jax.Array,
+    y_test: jax.Array,
+    n_features: int,
+    n_hidden: int = 50,
+    y_mean: float = 0.0,
+    y_std: float = 1.0,
+) -> jax.Array:
+    """RMSE of the posterior-predictive mean on the original target scale
+    (``y_mean``/``y_std`` undo the driver's target standardization)."""
+    preds = jax.vmap(lambda t: predict(t, x_test, n_features, n_hidden))(particles)
+    mean_pred = jnp.mean(preds, axis=0) * y_std + y_mean
+    truth = jnp.asarray(y_test).reshape(-1)
+    return jnp.sqrt(jnp.mean((mean_pred - truth) ** 2))
+
+
+def ensemble_test_loglik(
+    particles: jax.Array,
+    x_test: jax.Array,
+    y_test: jax.Array,
+    n_features: int,
+    n_hidden: int = 50,
+    y_mean: float = 0.0,
+    y_std: float = 1.0,
+) -> jax.Array:
+    """Average per-point predictive log-likelihood of the particle mixture,
+    ``mean_i log (1/n) Σ_p N(y_i; ŷ_p(x_i), 1/γ_p)``, on the original scale."""
+    truth = jnp.asarray(y_test).reshape(-1)
+
+    def per_particle(theta):
+        pred = predict(theta, x_test, n_features, n_hidden) * y_std + y_mean
+        gamma = jnp.exp(theta[-2]) / (y_std**2)  # precision on original scale
+        return 0.5 * (jnp.log(gamma) - _LOG_2PI) - 0.5 * gamma * (pred - truth) ** 2
+
+    lls = jax.vmap(per_particle)(particles)  # (n_particles, n_test)
+    n = particles.shape[0]
+    return jnp.mean(jax.scipy.special.logsumexp(lls, axis=0) - math.log(n))

@@ -32,3 +32,20 @@ def force_cpu_backend() -> None:
 
     jax.config.update("jax_platforms", "cpu")
     drop_axon_factory()
+
+
+def select_backend(backend: str) -> None:
+    """Apply an experiment driver's ``--backend {auto,tpu,cpu}`` flag.
+
+    ``auto`` keeps jax's default device resolution; ``cpu`` uses
+    :func:`force_cpu_backend`; anything else is passed to
+    ``jax.config.jax_platforms`` verbatim.  Must run before first backend use.
+    """
+    if backend == "auto":
+        return
+    if backend == "cpu":
+        force_cpu_backend()
+        return
+    import jax
+
+    jax.config.update("jax_platforms", backend)

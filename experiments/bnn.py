@@ -1,0 +1,165 @@
+"""Two-layer Bayesian-NN regression on the UCI suite — BASELINE.json config 5
+("2-layer Bayesian NN regression (UCI), 500 particles, weight-vector SVGD").
+
+No reference counterpart exists (the reference's models are GMM and logreg);
+this driver follows the reference's experiment-script shape
+(experiments/logreg.py:105-147): click CLI, per-shard result pickles under a
+config-named results dir, optional sharding via ``DistSampler``.
+
+Protocol (the standard SVGD BNN setup): 90/10 train/test split, features and
+targets z-scored by train statistics, minibatched stochastic scores with a
+separate (unscaled) prior, ensemble posterior-predictive RMSE and
+log-likelihood reported on the original target scale.
+"""
+
+import json
+import os
+import time
+
+import click
+import numpy as np
+
+from paths import DATA_DIR, RESULTS_DIR  # noqa: F401  (bootstraps sys.path)
+
+from dist_svgd_tpu.utils.platform import select_backend
+
+
+def get_results_dir(
+    dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size,
+    exchange, seed,
+):
+    """Config-encoded results dir — every CLI knob that changes the run is in
+    the name, so sweep configurations never overwrite each other (reference
+    naming convention, experiments/logreg_plots.py:19-22)."""
+    name = (
+        f"bnn-{dataset}-{split}-{nproc}-{nparticles}-{n_hidden}-{niter}-"
+        f"{stepsize}-{batch_size}-{exchange}-{seed}"
+    )
+    path = os.path.join(RESULTS_DIR, name)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def run(
+    dataset="boston",
+    split=0,
+    nproc=1,
+    nparticles=500,
+    n_hidden=50,
+    niter=1000,
+    stepsize=1e-3,
+    batch_size=100,
+    exchange="all_particles",
+    seed=0,
+):
+    """Train; returns (final_particles, metrics dict)."""
+    import jax
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models import bnn
+    from dist_svgd_tpu.utils.datasets import load_uci_regression
+
+    sp = load_uci_regression(dataset, split, data_path=DATA_DIR)
+    x_tr = jnp.asarray(sp.x_train)
+    y_tr = jnp.asarray(sp.y_train)
+    n_features = x_tr.shape[1]
+    d = bnn.num_params(n_features, n_hidden)
+
+    n_used = (nparticles // nproc) * nproc  # reference drop policy
+    particles = bnn.init_particles(jax.random.PRNGKey(seed), n_used, n_features, n_hidden)
+    likelihood, prior = bnn.make_bnn_split(n_features, n_hidden)
+    batch = min(batch_size, x_tr.shape[0] // nproc) if batch_size else None
+
+    t0 = time.perf_counter()
+    if nproc == 1:
+        sampler = dt.Sampler(
+            d, likelihood, data=(x_tr, y_tr), batch_size=batch, log_prior=prior
+        )
+        final, _ = sampler.run(
+            n_used, niter, stepsize, seed=seed, record=False,
+            initial_particles=particles,
+        )
+    else:
+        sampler = dt.DistSampler(
+            nproc,
+            likelihood,
+            None,
+            particles,
+            data=(x_tr, y_tr),
+            exchange_particles=exchange in ("all_particles", "all_scores"),
+            exchange_scores=exchange == "all_scores",
+            include_wasserstein=False,
+            batch_size=batch,
+            log_prior=prior,
+            seed=seed,
+        )
+        for _ in range(niter):
+            sampler.make_step(stepsize)
+        final = sampler.particles
+    final = jax.block_until_ready(final)
+    wall = time.perf_counter() - t0
+
+    rmse = float(
+        bnn.ensemble_rmse(
+            final, jnp.asarray(sp.x_test), sp.y_test, n_features, n_hidden,
+            y_mean=sp.y_mean, y_std=sp.y_std,
+        )
+    )
+    ll = float(
+        bnn.ensemble_test_loglik(
+            final, jnp.asarray(sp.x_test), sp.y_test, n_features, n_hidden,
+            y_mean=sp.y_mean, y_std=sp.y_std,
+        )
+    )
+    metrics = {
+        "dataset": dataset,
+        "split": split,
+        "nproc": nproc,
+        "nparticles": n_used,
+        "n_hidden": n_hidden,
+        "niter": niter,
+        "stepsize": stepsize,
+        "batch_size": batch,
+        "exchange": exchange,
+        "test_rmse": rmse,
+        "test_loglik": ll,
+        "wall_s": round(wall, 3),
+        "updates_per_sec": round(n_used * niter / wall, 1),
+    }
+    return np.asarray(final), metrics
+
+
+@click.command()
+@click.option("--dataset", default="boston")
+@click.option("--split", type=int, default=0)
+@click.option("--nproc", type=click.IntRange(1, 32), default=1,
+              help="number of shards (the reference's world size)")
+@click.option("--nparticles", type=int, default=500)
+@click.option("--n-hidden", type=int, default=50)
+@click.option("--niter", type=int, default=1000)
+@click.option("--stepsize", type=float, default=1e-3)
+@click.option("--batch-size", type=int, default=100)
+@click.option("--exchange", type=click.Choice(["all_particles", "all_scores"]),
+              default="all_particles")
+@click.option("--seed", type=int, default=0)
+@click.option("--backend", type=click.Choice(["auto", "tpu", "cpu"]), default="auto")
+def cli(dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size,
+        exchange, seed, backend):
+    select_backend(backend)
+    final, metrics = run(
+        dataset, split, nproc, nparticles, n_hidden, niter, stepsize,
+        batch_size, exchange, seed,
+    )
+    results_dir = get_results_dir(
+        dataset, split, nproc, nparticles, n_hidden, niter, stepsize,
+        batch_size, exchange, seed,
+    )
+    np.save(os.path.join(results_dir, "particles.npy"), final)
+    with open(os.path.join(results_dir, "metrics.json"), "w") as fh:
+        json.dump(metrics, fh, indent=2)
+    print(json.dumps(metrics))
+
+
+if __name__ == "__main__":
+    cli()

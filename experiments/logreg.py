@@ -29,18 +29,7 @@ from paths import DATA_DIR, RESULTS_DIR  # noqa: F401  (bootstraps sys.path)
 
 from logreg_plots import get_results_dir, make_plots
 
-
-def _select_backend(backend: str):
-    if backend == "auto":
-        return
-    if backend == "cpu":
-        from dist_svgd_tpu.utils.platform import force_cpu_backend
-
-        force_cpu_backend()
-    else:
-        import jax
-
-        jax.config.update("jax_platforms", backend)
+from dist_svgd_tpu.utils.platform import select_backend
 
 
 def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, wasserstein):
@@ -133,7 +122,7 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, w
 @click.pass_context
 def cli(ctx, dataset, fold, nproc, nparticles, niter, stepsize, exchange,
         wasserstein, master_addr, master_port, backend, plots):
-    _select_backend(backend)
+    select_backend(backend)
     # normalise nproc=0 to a single shard up front so the results dir, the
     # run, and the plots all agree on the same config name
     nproc = max(nproc, 1)
