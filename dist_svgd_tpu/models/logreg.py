@@ -59,6 +59,32 @@ def make_logreg_logp(x_train: jax.Array, t_train: jax.Array):
     return logp
 
 
+def logreg_likelihood(theta: jax.Array, data: Tuple[jax.Array, jax.Array]) -> jax.Array:
+    """Likelihood term only: ``-Σ_i log(1 + exp(-t_i·x_i·w))``
+    (experiments/logreg.py:57)."""
+    x, t = data
+    w = theta[1:]
+    z = (x @ w) * t.reshape(-1)
+    return -jnp.sum(jnp.logaddexp(0.0, -z))
+
+
+def logreg_prior(theta: jax.Array) -> jax.Array:
+    """Prior terms only: ``Gamma(1,1)`` on ``α = exp(θ₀)`` (no log-α
+    Jacobian — reference parameterisation) and ``N(0, I/α)`` on ``w``
+    (experiments/logreg.py:38-39,55-56)."""
+    alpha = jnp.exp(theta[0])
+    w = theta[1:]
+    k = w.shape[0]
+    return -alpha + 0.5 * k * theta[0] - 0.5 * k * _LOG_2PI - 0.5 * alpha * jnp.dot(w, w)
+
+
+def make_logreg_split():
+    """``(likelihood, prior)`` pair for the samplers' ``log_prior=`` path, so
+    minibatch/importance scaling touches only the data term (mirrors
+    ``bnn.make_bnn_split``).  ``likelihood + prior == logreg_logp`` exactly."""
+    return logreg_likelihood, logreg_prior
+
+
 def posterior_predictive_prob(particles: jax.Array, x_test: jax.Array) -> jax.Array:
     """Per-particle predictive probabilities ``σ(x_test · w)``.
 

@@ -65,6 +65,45 @@ def test_logreg_cli_nproc_zero_normalised():
 
 
 @pytest.mark.slow
+def test_covertype_cli_minibatched_sharded():
+    """BASELINE config 4 shape at toy scale: sharded particles, sharded data,
+    per-shard minibatched scores, separate prior."""
+    res = run_script([
+        "experiments/covertype.py", "--nrows", "800", "--nproc", "4",
+        "--nparticles", "64", "--niter", "10", "--stepsize", "1e-3",
+        "--batch-size", "32", "--backend", "cpu",
+    ], timeout=220)
+    assert res.returncode == 0, res.stderr[-2000:]
+    import json
+
+    metrics = json.loads(res.stdout.strip().splitlines()[-1])
+    assert metrics["nparticles"] == 64
+    assert metrics["shard_data"] is True
+    assert 0.0 <= metrics["test_acc"] <= 1.0
+    results_dir = os.path.join(
+        REPO, "experiments", "results",
+        "covertype-800-4-64-10-0.001-32-all_particles-shard-0",
+    )
+    assert os.path.exists(os.path.join(results_dir, "metrics.json"))
+    parts = np.load(os.path.join(results_dir, "particles.npy"))
+    assert parts.shape == (64, 55)
+    assert np.isfinite(parts).all()
+
+
+@pytest.mark.slow
+def test_bnn_cli_writes_metrics():
+    res = run_script([
+        "experiments/bnn.py", "--dataset", "yacht", "--nparticles", "32",
+        "--n-hidden", "8", "--niter", "10", "--nproc", "2", "--backend", "cpu",
+    ], timeout=220)
+    assert res.returncode == 0, res.stderr[-2000:]
+    import json
+
+    metrics = json.loads(res.stdout.strip().splitlines()[-1])
+    assert np.isfinite(metrics["test_rmse"])
+
+
+@pytest.mark.slow
 def test_gmm_experiment_writes_figure():
     # tiny config via import (same process would fight the conftest backend;
     # subprocess keeps it faithful to `python experiments/gmm.py`)

@@ -98,3 +98,15 @@ def test_ensemble_accuracy_perfect_separation():
     particles = np.array([[0.0, 5.0, 0.0]])  # w = (5, 0) → classifies by sign(x0)
     acc = float(ensemble_test_accuracy(jnp.asarray(particles), jnp.asarray(x_test), jnp.asarray(t_test)))
     assert acc == 1.0
+
+
+def test_logreg_split_equals_joint(rng):
+    """likelihood + prior from make_logreg_split sums to logreg_logp exactly."""
+    from dist_svgd_tpu.models.logreg import make_logreg_split
+
+    x = jnp.asarray(rng.normal(size=(12, 4)))
+    t = jnp.asarray(np.where(rng.normal(size=12) > 0, 1.0, -1.0))
+    theta = jnp.asarray(rng.normal(size=5))
+    lik, prior = make_logreg_split()
+    joint = float(logreg_logp(theta, (x, t)))
+    assert float(lik(theta, (x, t))) + float(prior(theta)) == pytest.approx(joint, rel=1e-12)
