@@ -306,6 +306,43 @@ class DistSampler:
             self._previous = prev
 
     # ------------------------------------------------------------------ #
+    # Checkpoint / resume (utils/checkpoint.py; SURVEY.md §5)
+
+    def state_dict(self) -> dict:
+        """Resume state: particles, the Wasserstein ``previous`` snapshot, and
+        the step counter (drives the ``partitions`` rotation *and* the
+        per-step minibatch key fold).  Restoring via :meth:`load_state_dict`
+        continues the exact uninterrupted trajectory."""
+        return {
+            "particles": np.asarray(self._particles),
+            "previous": None if self._previous is None else np.asarray(self._previous),
+            "t": np.asarray(self._t, dtype=np.int64),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        particles = jnp.asarray(state["particles"])
+        if particles.shape != (self._num_particles, self._d):
+            raise ValueError(
+                f"checkpoint particles {particles.shape} != sampler "
+                f"{(self._num_particles, self._d)}"
+            )
+        self._particles = particles
+        prev = state.get("previous")
+        if prev is not None:
+            prev = np.asarray(prev)
+            if self._mode == PARTITIONS and self._num_shards > 1:
+                want = (self._num_shards, self._particles_per_shard, self._d)
+            else:
+                want = (self._num_shards, self._num_particles, self._d)
+            if prev.shape != want:
+                raise ValueError(
+                    f"checkpoint 'previous' snapshot {prev.shape} != expected "
+                    f"{want} (was it saved with a different num_shards?)"
+                )
+        self._previous = prev
+        self._t = int(state["t"])
+
+    # ------------------------------------------------------------------ #
 
     def make_step(self, step_size: float, h: float = 1.0) -> jax.Array:
         """Perform one distributed SVGD step — reference API

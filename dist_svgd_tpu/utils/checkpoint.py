@@ -1,0 +1,137 @@
+"""Checkpoint / resume for long SVGD runs.
+
+The reference has no checkpointing: results are written once, at run end
+(experiments/logreg.py:89-92), and a crash loses the run (SURVEY.md §5).  The
+TPU-native plan from SURVEY.md §5 is an Orbax-style checkpoint of the sampler
+state every K steps plus resume; this module provides exactly that.
+
+Design:
+
+- :func:`save_state` / :func:`load_state` persist an arbitrary pytree of
+  arrays via Orbax (``PyTreeCheckpointer``), falling back to a plain ``.npz``
+  when Orbax is unavailable — both layouts are self-describing and the loader
+  auto-detects which one is on disk.
+- :class:`CheckpointManager` wraps the every-K-steps cadence with retention
+  (keep the newest ``max_to_keep`` step dirs) and latest-step discovery.
+- ``DistSampler.state_dict()`` / ``.load_state_dict()`` (distsampler.py)
+  expose the sampler's resume state: particle array, Wasserstein
+  ``previous_particles`` snapshot, and the step counter ``t`` that drives both
+  the ``partitions`` rotation and the per-step minibatch key fold — restoring
+  them reproduces the uninterrupted trajectory bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+_NPZ_NAME = "state.npz"
+
+
+def _to_numpy_tree(tree: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in tree.items():
+        if v is None:
+            continue
+        out[k] = np.asarray(v)
+    return out
+
+
+def save_state(path: str, state: Dict[str, Any]) -> str:
+    """Persist a flat dict of arrays/scalars (``None`` values are elided).
+
+    Uses Orbax when importable; ``.npz`` fallback otherwise.  ``path`` is a
+    directory; an existing checkpoint there is replaced atomically enough for
+    single-writer use (removed then rewritten).
+    """
+    state = _to_numpy_tree(state)
+    path = os.path.abspath(path)
+    # write-tmp-then-rename: a crash mid-write leaves only a stale .tmp dir,
+    # never a truncated checkpoint at the final path
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    try:
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(tmp, state)
+    except ImportError:
+        os.makedirs(tmp)
+        np.savez(os.path.join(tmp, _NPZ_NAME), **state)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.rename(tmp, path)
+    return path
+
+
+def load_state(path: str) -> Dict[str, Any]:
+    """Load a checkpoint written by :func:`save_state` (auto-detects layout)."""
+    path = os.path.abspath(path)
+    npz = os.path.join(path, _NPZ_NAME)
+    if os.path.exists(npz):
+        with np.load(npz) as data:
+            return {k: data[k] for k in data.files}
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path)
+    return dict(restored)
+
+
+class CheckpointManager:
+    """Every-K-steps checkpointing with retention.
+
+    Layout: ``<root>/step_<t>/`` per checkpoint, newest ``max_to_keep`` kept.
+    """
+
+    def __init__(self, root: str, every: int = 100, max_to_keep: int = 3):
+        if every <= 0:
+            raise ValueError("every must be positive")
+        self.root = os.path.abspath(root)
+        self.every = every
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.root, exist_ok=True)
+
+    def _step_dirs(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self.root):
+            m = _STEP_DIR_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def should_save(self, step: int) -> bool:
+        return step > 0 and step % self.every == 0
+
+    def save(self, step: int, state: Dict[str, Any]) -> str:
+        path = save_state(os.path.join(self.root, f"step_{step}"), state)
+        for old in self._step_dirs()[: -self.max_to_keep or None]:
+            if old != step:
+                shutil.rmtree(os.path.join(self.root, f"step_{old}"), ignore_errors=True)
+        return path
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._step_dirs()
+        return steps[-1] if steps else None
+
+    def restore_latest(self) -> Optional[Dict[str, Any]]:
+        """Restore the newest *loadable* checkpoint, falling back past any
+        that fail to load (e.g. a partial write from a pre-rename crash of an
+        older writer) and warning about the skip."""
+        for step in reversed(self._step_dirs()):
+            path = os.path.join(self.root, f"step_{step}")
+            try:
+                return load_state(path)
+            except Exception as e:  # corrupt/partial — try the next-oldest
+                import warnings
+
+                warnings.warn(
+                    f"skipping unloadable checkpoint {path}: {type(e).__name__}: {e}"
+                )
+        return None

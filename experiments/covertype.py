@@ -49,8 +49,19 @@ def run(
     exchange="all_particles",
     shard_data=True,
     seed=0,
+    checkpoint_every=0,
+    checkpoint_dir=None,
+    resume=False,
 ):
-    """Train; returns (final_particles, metrics dict)."""
+    """Train; returns (final_particles, metrics dict).
+
+    ``checkpoint_every > 0`` saves sampler state every K steps under
+    ``checkpoint_dir`` (utils/checkpoint.py); ``resume=True`` restores the
+    latest checkpoint there and continues the exact trajectory (sharded path
+    only — the single-process path is one fused scan).  ``checkpoint_dir``
+    defaults to ``<results dir>-ckpt``, which encodes every config knob, so
+    different configurations never share checkpoints.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -75,6 +86,7 @@ def run(
     rows_per_shard = x_train.shape[0] // nproc
     batch = min(batch_size, rows_per_shard) if batch_size else None
 
+    start = 0  # resumed-from step (sharded path may overwrite)
     t0 = time.perf_counter()
     if nproc == 1:
         sampler = dt.Sampler(
@@ -100,8 +112,26 @@ def run(
             log_prior=prior,
             seed=seed,
         )
-        for _ in range(niter):
+        mgr = None
+        if checkpoint_every or resume:
+            from dist_svgd_tpu.utils.checkpoint import CheckpointManager
+
+            if checkpoint_dir is None:
+                checkpoint_dir = get_results_dir(
+                    nrows, nproc, nparticles, niter, stepsize, batch_size,
+                    exchange, shard_data, seed,
+                ) + "-ckpt"
+            # every=0 with resume means restore-only (no new checkpoints)
+            mgr = CheckpointManager(checkpoint_dir, every=checkpoint_every or max(niter, 1))
+            if resume:
+                state = mgr.restore_latest()
+                if state is not None:
+                    sampler.load_state_dict(state)
+                    start = int(state["t"])
+        for i in range(start, niter):
             sampler.make_step(stepsize)
+            if checkpoint_every and mgr.should_save(i + 1):
+                mgr.save(i + 1, sampler.state_dict())
         final = sampler.particles
     final = jax.block_until_ready(final)
     wall = time.perf_counter() - t0
@@ -119,7 +149,12 @@ def run(
         "shard_data": shard_data,
         "test_acc": acc,
         "wall_s": round(wall, 3),
-        "updates_per_sec": round(n_used * niter / wall, 1),
+        # throughput counts only the steps *this* process ran (resume skips
+        # the first `start` steps, so n_used·niter/wall would overstate it)
+        "steps_run": niter - start,
+        "resumed_from": start,
+        "updates_per_sec": round(n_used * max(niter - start, 0) / wall, 1)
+        if niter > start else 0.0,
     }
     return np.asarray(final), metrics
 
@@ -137,13 +172,21 @@ def run(
               default="all_particles")
 @click.option("--shard-data/--replicate-data", default=True)
 @click.option("--seed", type=int, default=0)
+@click.option("--checkpoint-every", type=int, default=0,
+              help="save sampler state every K steps (0 = off; sharded path only)")
+@click.option("--resume/--no-resume", default=False,
+              help="restore the latest checkpoint and continue")
 @click.option("--backend", type=click.Choice(["auto", "tpu", "cpu"]), default="auto")
 def cli(nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
-        shard_data, seed, backend):
+        shard_data, seed, checkpoint_every, resume, backend):
     select_backend(backend)
-    final, metrics = run(
+    ckpt_dir = get_results_dir(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed,
+    ) + "-ckpt" if checkpoint_every else None
+    final, metrics = run(
+        nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
+        shard_data, seed, checkpoint_every, ckpt_dir, resume,
     )
     results_dir = get_results_dir(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
