@@ -128,6 +128,11 @@ class CheckpointManager:
             path = os.path.join(self.root, f"step_{step}")
             try:
                 return load_state(path)
+            except ImportError:
+                # environment problem (orbax-format checkpoint, no orbax
+                # installed) — not corruption; skipping would silently restart
+                # from scratch and eventually retention-delete the real state
+                raise
             except Exception as e:  # corrupt/partial — try the next-oldest
                 import warnings
 
@@ -135,3 +140,10 @@ class CheckpointManager:
                     f"skipping unloadable checkpoint {path}: {type(e).__name__}: {e}"
                 )
         return None
+
+    def clear(self) -> None:
+        """Delete every checkpoint under the root (fresh-run hygiene: a new
+        run writing into a dir holding an older run's step dirs would let
+        retention keep the *stale* high-step checkpoints and delete its own)."""
+        for step in self._step_dirs():
+            shutil.rmtree(os.path.join(self.root, f"step_{step}"), ignore_errors=True)

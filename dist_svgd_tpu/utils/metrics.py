@@ -1,0 +1,137 @@
+"""Structured metrics, timing, and profiling hooks.
+
+The reference's observability is ``print('Iteration {}')`` progress lines and
+shell ``time`` (dsvgd/sampler.py:63, grid.sh:6-8; SURVEY.md §5).  The
+TPU-native replacement here:
+
+- :class:`JsonlLogger` — structured per-step scalars as JSON lines to a file
+  and/or a stream (machine-readable sweeps instead of visdom's live server);
+- :func:`particle_stats` — one small jitted program computing the per-step
+  scalars worth logging (mean particle norm, dispersion, update magnitude) so
+  logging costs one tiny device→host transfer, not a full-array sync;
+- :class:`StepTimer` — wall-clock timing with ``block_until_ready`` fencing
+  for honest updates/sec (async dispatch otherwise under-counts);
+- :func:`profiler_trace` — ``jax.profiler.trace`` context for TensorBoard-
+  readable device traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import IO, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class JsonlLogger:
+    """Append-only JSON-lines metric log.
+
+    Each :meth:`log` call writes one line ``{"ts": <unix>, **record}``.
+    ``path`` and ``stream`` may both be given (e.g. file + stderr echo).
+    """
+
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None):
+        self._fh = open(path, "a") if path is not None else None
+        self._stream = stream
+
+    def log(self, **record) -> dict:
+        record = {"ts": round(time.time(), 3), **record}
+        line = json.dumps(record, default=_json_default)
+        if self._fh is not None:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self._stream is not None:
+            self._stream.write(line + "\n")
+        return record
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _json_default(o):
+    if isinstance(o, (np.generic,)):
+        return o.item()
+    if isinstance(o, (np.ndarray, jax.Array)):
+        return np.asarray(o).tolist()
+    raise TypeError(f"not JSON serialisable: {type(o)}")
+
+
+@jax.jit
+def _stats(particles, prev):
+    norms = jnp.linalg.norm(particles, axis=1)
+    delta = jnp.linalg.norm(particles - prev, axis=1)
+    return (
+        jnp.mean(norms),
+        jnp.std(norms),
+        jnp.mean(particles, axis=0).mean(),
+        jnp.mean(delta),
+        jnp.max(delta),
+    )
+
+
+def particle_stats(particles, prev=None) -> dict:
+    """Per-step scalar diagnostics as plain floats.
+
+    ``prev`` (the pre-step array) adds update-magnitude stats — the honest
+    φ-norm proxy: ``mean_update = ε·mean‖φ̂ + h·w_grad‖``.
+    """
+    if prev is None:
+        prev = particles
+    mean_norm, std_norm, mean_val, mean_delta, max_delta = _stats(particles, prev)
+    out = {
+        "particle_mean_norm": float(mean_norm),
+        "particle_norm_std": float(std_norm),
+        "particle_mean": float(mean_val),
+    }
+    if prev is not particles:
+        out["mean_update"] = float(mean_delta)
+        out["max_update"] = float(max_delta)
+    return out
+
+
+class StepTimer:
+    """Fenced step timing: ``mark(value)`` blocks on ``value`` (device fence)
+    and records the wall time since the previous mark."""
+
+    def __init__(self):
+        self._last = time.perf_counter()
+        self.laps: list = []
+
+    def mark(self, value=None) -> float:
+        if value is not None:
+            jax.block_until_ready(value)
+        now = time.perf_counter()
+        lap = now - self._last
+        self._last = now
+        self.laps.append(lap)
+        return lap
+
+    @property
+    def total(self) -> float:
+        return sum(self.laps)
+
+    def updates_per_sec(self, updates_per_lap: int) -> float:
+        """Throughput over all recorded laps."""
+        return len(self.laps) * updates_per_lap / self.total if self.laps else 0.0
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: Optional[str]):
+    """``jax.profiler.trace`` context; no-op when ``logdir`` is falsy."""
+    if not logdir:
+        yield
+        return
+    with jax.profiler.trace(logdir):
+        yield

@@ -17,6 +17,7 @@ Particle layout is the reference's logreg convention ``(log α, w)``, d = 55
 
 import json
 import os
+import sys
 import time
 
 import click
@@ -52,6 +53,9 @@ def run(
     checkpoint_every=0,
     checkpoint_dir=None,
     resume=False,
+    log_every=0,
+    metrics_path=None,
+    profile_dir=None,
 ):
     """Train; returns (final_particles, metrics dict).
 
@@ -61,6 +65,11 @@ def run(
     only — the single-process path is one fused scan).  ``checkpoint_dir``
     defaults to ``<results dir>-ckpt``, which encodes every config knob, so
     different configurations never share checkpoints.
+
+    ``log_every > 0`` writes per-step JSONL scalars (utils/metrics.py) to
+    ``metrics_path`` (or stdout when None); ``profile_dir`` wraps the loop in
+    a ``jax.profiler`` trace.  Sharded path only — the single-process path is
+    one fused scan with no per-step host hook.
     """
     import jax
     import jax.numpy as jnp
@@ -128,10 +137,37 @@ def run(
                 if state is not None:
                     sampler.load_state_dict(state)
                     start = int(state["t"])
-        for i in range(start, niter):
-            sampler.make_step(stepsize)
-            if checkpoint_every and mgr.should_save(i + 1):
-                mgr.save(i + 1, sampler.state_dict())
+            else:
+                mgr.clear()  # a previous run's step dirs would poison retention/resume
+        from dist_svgd_tpu.utils.metrics import (
+            JsonlLogger,
+            StepTimer,
+            particle_stats,
+            profiler_trace,
+        )
+
+        timer = StepTimer()
+        last_logged = start  # first lap after a resume may span < log_every steps
+        with JsonlLogger(
+            path=metrics_path,
+            stream=None if metrics_path or not log_every else sys.stdout,
+        ) as logger, profiler_trace(profile_dir):
+            for i in range(start, niter):
+                log_now = log_every and (i + 1) % log_every == 0
+                prev = sampler.particles if log_now else None
+                out = sampler.make_step(stepsize)
+                if log_now:
+                    lap = timer.mark(out)
+                    steps_in_lap = (i + 1) - last_logged
+                    last_logged = i + 1
+                    logger.log(
+                        step=i + 1,
+                        wall_s=round(lap, 4),
+                        updates_per_sec=round(n_used * steps_in_lap / lap, 1),
+                        **particle_stats(out, prev),
+                    )
+                if checkpoint_every and mgr.should_save(i + 1):
+                    mgr.save(i + 1, sampler.state_dict())
         final = sampler.particles
     final = jax.block_until_ready(final)
     wall = time.perf_counter() - t0
@@ -176,21 +212,24 @@ def run(
               help="save sampler state every K steps (0 = off; sharded path only)")
 @click.option("--resume/--no-resume", default=False,
               help="restore the latest checkpoint and continue")
+@click.option("--log-every", type=int, default=0,
+              help="write per-step JSONL metrics every K steps (0 = off)")
+@click.option("--profile-dir", type=str, default=None,
+              help="jax.profiler trace output dir (TensorBoard-readable)")
 @click.option("--backend", type=click.Choice(["auto", "tpu", "cpu"]), default="auto")
 def cli(nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
-        shard_data, seed, checkpoint_every, resume, backend):
+        shard_data, seed, checkpoint_every, resume, log_every, profile_dir, backend):
     select_backend(backend)
-    ckpt_dir = get_results_dir(
-        nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
-        shard_data, seed,
-    ) + "-ckpt" if checkpoint_every else None
-    final, metrics = run(
-        nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
-        shard_data, seed, checkpoint_every, ckpt_dir, resume,
-    )
     results_dir = get_results_dir(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed,
+    )
+    ckpt_dir = results_dir + "-ckpt" if checkpoint_every else None
+    final, metrics = run(
+        nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
+        shard_data, seed, checkpoint_every, ckpt_dir, resume,
+        log_every, os.path.join(results_dir, "metrics.jsonl") if log_every else None,
+        profile_dir,
     )
     np.save(os.path.join(results_dir, "particles.npy"), final)
     with open(os.path.join(results_dir, "metrics.json"), "w") as fh:

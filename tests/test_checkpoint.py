@@ -87,7 +87,6 @@ def test_save_crash_leaves_previous_checkpoint_intact(tmp_path, monkeypatch):
         raise RuntimeError("killed mid-write")
 
     monkeypatch.setattr(ckpt_mod.np, "savez", boom)
-    monkeypatch.setattr(ckpt_mod, "_orbax_unavailable_for_test", True, raising=False)
     # force the npz path by making the orbax import fail
     import builtins
 
@@ -109,6 +108,36 @@ def test_save_crash_leaves_previous_checkpoint_intact(tmp_path, monkeypatch):
 def test_manager_rejects_nonpositive_every(tmp_path):
     with pytest.raises(ValueError):
         CheckpointManager(str(tmp_path), every=0)
+
+
+def test_manager_clear(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "root"), every=1)
+    mgr.save(1, {"x": np.ones(1)})
+    mgr.save(2, {"x": np.ones(1)})
+    mgr.clear()
+    assert mgr.latest_step() is None
+    assert mgr.restore_latest() is None
+
+
+def test_restore_latest_propagates_missing_orbax(tmp_path, monkeypatch):
+    """An orbax-format checkpoint in an env without orbax must raise, not be
+    silently skipped as corruption (which would restart from scratch)."""
+    pytest.importorskip("orbax.checkpoint")
+    mgr = CheckpointManager(str(tmp_path / "root"), every=1)
+    mgr.save(1, {"x": np.ones(1)})  # orbax layout (no state.npz)
+
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_orbax(name, *a, **k):
+        if name.startswith("orbax"):
+            raise ImportError("test: no orbax")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_orbax)
+    with pytest.raises(ImportError, match="no orbax"):
+        mgr.restore_latest()
 
 
 def _make_sampler(parts, data, mode_kwargs):

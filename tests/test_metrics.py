@@ -1,0 +1,83 @@
+"""Metrics/observability (utils/metrics.py; SURVEY.md §5): JSONL logging,
+fenced timing, jitted particle diagnostics, profiler context."""
+
+import io
+import json
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dist_svgd_tpu.utils.metrics import (
+    JsonlLogger,
+    StepTimer,
+    particle_stats,
+    profiler_trace,
+)
+
+
+def test_jsonl_logger_file_and_stream(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    buf = io.StringIO()
+    with JsonlLogger(path=path, stream=buf) as lg:
+        lg.log(step=1, value=2.5)
+        lg.log(step=2, arr=np.arange(3), npfloat=np.float32(1.5))
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[1])
+    assert rec["step"] == 2
+    assert rec["arr"] == [0, 1, 2]
+    assert rec["npfloat"] == 1.5
+    assert "ts" in rec
+    assert buf.getvalue().strip().splitlines() == lines
+
+
+def test_jsonl_logger_appends(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with JsonlLogger(path=path) as lg:
+        lg.log(a=1)
+    with JsonlLogger(path=path) as lg:
+        lg.log(a=2)
+    assert len(open(path).read().strip().splitlines()) == 2
+
+
+def test_particle_stats_values():
+    parts = jnp.asarray([[3.0, 4.0], [0.0, 0.0]])
+    prev = jnp.asarray([[3.0, 4.0], [1.0, 0.0]])
+    out = particle_stats(parts, prev)
+    assert out["particle_mean_norm"] == pytest.approx(2.5)
+    assert out["particle_norm_std"] == pytest.approx(2.5)
+    assert out["particle_mean"] == pytest.approx((3.0 + 4.0) / 4)
+    assert out["mean_update"] == pytest.approx(0.5)
+    assert out["max_update"] == pytest.approx(1.0)
+
+
+def test_particle_stats_without_prev():
+    out = particle_stats(jnp.ones((4, 2)))
+    assert "mean_update" not in out
+    assert out["particle_mean_norm"] == pytest.approx(np.sqrt(2.0))
+
+
+def test_step_timer_rates():
+    t = StepTimer()
+    time.sleep(0.01)
+    lap = t.mark(jnp.ones(4) * 2)  # fences on the value
+    assert lap >= 0.01
+    assert t.total == pytest.approx(sum(t.laps))
+    assert t.updates_per_sec(100) == pytest.approx(len(t.laps) * 100 / t.total)
+
+
+def test_step_timer_empty():
+    assert StepTimer().updates_per_sec(10) == 0.0
+
+
+def test_profiler_trace_noop_and_real(tmp_path):
+    with profiler_trace(None):
+        pass  # no-op path
+    logdir = str(tmp_path / "trace")
+    with profiler_trace(logdir):
+        jnp.ones(8).block_until_ready()
+    import os
+
+    assert os.path.isdir(logdir)
