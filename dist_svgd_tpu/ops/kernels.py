@@ -37,7 +37,10 @@ def squared_distances(x: jax.Array, y: jax.Array) -> jax.Array:
     """
     x2 = jnp.sum(x * x, axis=-1)[:, None]
     y2 = jnp.sum(y * y, axis=-1)[None, :]
-    sq = x2 + y2 - 2.0 * x @ y.T
+    # HIGHEST: the TPU MXU's default bf16 passes leave ~1e-2 absolute error
+    # here, which exp(-sq/h) turns into percent-level kernel error; the
+    # distance matmul is cheap (contraction over small d) so full f32 is free
+    sq = x2 + y2 - 2.0 * jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
     return jnp.maximum(sq, 0.0)
 
 

@@ -64,11 +64,18 @@ def phi(
         kernel = RBF(1.0)
     m = interacting.shape[0]
     if isinstance(kernel, RBF):
+        # HIGHEST precision on the φ contractions: the TPU MXU's default bf16
+        # passes put ~1e-2 absolute error into the update direction (measured
+        # 6e-2 rel on a v5e); with small d these matmuls are a rounding error
+        # next to the m·k exp() evaluations, so full f32 costs ~nothing.
+        hi = jax.lax.Precision.HIGHEST
         K = kernel.matrix(interacting, updated)  # (m, k)
-        drive = K.T @ scores  # Σ_j k(x_j, y_i) s_j
+        drive = jnp.matmul(K.T, scores, precision=hi)  # Σ_j k(x_j, y_i) s_j
         # Σ_j ∇_{x_j} k(x_j, y_i) = (2/h) (y_i Σ_j K_ji − Σ_j K_ji x_j)
         ksum = jnp.sum(K, axis=0)  # (k,)
-        repulse = (2.0 / kernel.bandwidth) * (updated * ksum[:, None] - K.T @ interacting)
+        repulse = (2.0 / kernel.bandwidth) * (
+            updated * ksum[:, None] - jnp.matmul(K.T, interacting, precision=hi)
+        )
         return (drive + repulse) / m
     K = kernel_matrix(kernel, interacting, updated)  # (m, k)
     gK = kernel_grad_matrix(kernel, interacting, updated)  # (m, k, d)

@@ -57,6 +57,9 @@ class Sampler:
             factor scales the whole ``logp`` gradient — the reference's
             importance-scaling convention, which scales its prior term too
             (dsvgd/distsampler.py:96-99).
+        phi_impl: ``'auto'`` (Pallas fused-tile φ on TPU with an RBF kernel,
+            XLA elsewhere), ``'xla'``, or ``'pallas'`` (force; requires an
+            RBF kernel — see ops/pallas_svgd.py).
     """
 
     def __init__(
@@ -68,9 +71,12 @@ class Sampler:
         data=None,
         batch_size: Optional[int] = None,
         log_prior: Optional[Callable] = None,
+        phi_impl: str = "auto",
     ):
         if update_rule not in ("jacobi", "gauss_seidel"):
             raise ValueError(f"unknown update_rule {update_rule!r}")
+        if phi_impl not in ("auto", "xla", "pallas"):
+            raise ValueError(f"unknown phi_impl {phi_impl!r}")
         if batch_size is not None and data is None:
             raise ValueError("batch_size requires data")
         if batch_size is not None and update_rule != "jacobi":
@@ -91,6 +97,32 @@ class Sampler:
                 f"batch_size {batch_size} not in (0, {self._n_rows}] rows"
             )
         self._log_prior = log_prior
+
+        from dist_svgd_tpu.ops.pallas_svgd import pallas_available, phi_pallas
+
+        on_tpu = pallas_available()
+        if phi_impl == "pallas":
+            if not isinstance(self._kernel, RBF):
+                raise ValueError("phi_impl='pallas' requires an RBF kernel")
+            if update_rule != "jacobi":
+                # the gauss_seidel sweep never calls φ through self._phi, so a
+                # forced pallas choice would silently no-op
+                raise ValueError("phi_impl='pallas' requires update_rule='jacobi'")
+            use_pallas = True
+        else:
+            use_pallas = (
+                phi_impl == "auto" and on_tpu and isinstance(self._kernel, RBF)
+            )
+        if use_pallas:
+            bw = self._kernel.bandwidth
+            # forced 'pallas' off-TPU runs under the interpreter (slow but
+            # exact — how the CPU tests exercise this path)
+            interp = not on_tpu
+            self._phi = lambda y, x, s: phi_pallas(
+                y, x, s, bandwidth=bw, interpret=interp
+            )
+        else:
+            self._phi = lambda y, x, s: phi(y, x, s, self._kernel)
         if data is None:
             if log_prior is not None:
                 full = lambda theta: logp(theta) + log_prior(theta)
@@ -126,13 +158,15 @@ class Sampler:
         update_rule = self._update_rule
         minibatch = self._batch_size is not None
 
+        phi_fn = self._phi
+
         def one_step(parts, step_size, step_key):
             if minibatch:
                 scores = self._minibatch_scores(parts, step_key)
-                return parts + step_size * phi(parts, parts, scores, kernel)
+                return parts + step_size * phi_fn(parts, parts, scores)
             if update_rule == "jacobi":
                 scores = batched_score(parts)
-                return parts + step_size * phi(parts, parts, scores, kernel)
+                return parts + step_size * phi_fn(parts, parts, scores)
             return svgd_step_sequential(parts, self._score_fn, step_size, kernel)
 
         @partial(jax.jit, static_argnums=())

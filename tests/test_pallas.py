@@ -1,0 +1,101 @@
+"""Pallas fused φ kernel (ops/pallas_svgd.py) vs the XLA path (ops/svgd.py),
+run under the Pallas interpreter on CPU (SURVEY.md §4's
+distributed-without-hardware stance, applied to kernels)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dist_svgd_tpu.ops.kernels import RBF
+from dist_svgd_tpu.ops.pallas_svgd import phi_pallas
+from dist_svgd_tpu.ops.svgd import phi
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(41)
+
+
+@pytest.mark.parametrize(
+    "k,m,d",
+    [
+        (8, 8, 2),       # single tile, tiny
+        (50, 37, 3),     # ragged both axes (padding + column mask)
+        (40, 100, 55),   # m > tile? no — exercises multi-col padding of d
+        (130, 257, 7),   # multiple tiles with ragged edges (bk=bm=128 via min)
+    ],
+)
+def test_phi_pallas_matches_xla(rng, k, m, d):
+    y = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    s = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    want = np.asarray(phi(y, x, s, RBF(1.0)))
+    got = np.asarray(
+        phi_pallas(y, x, s, bandwidth=1.0, block_k=128, block_m=128, interpret=True)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_phi_pallas_nondefault_bandwidth(rng):
+    y = jnp.asarray(rng.normal(size=(24, 4)), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(24, 4)), dtype=jnp.float32)
+    s = jnp.asarray(rng.normal(size=(24, 4)), dtype=jnp.float32)
+    want = np.asarray(phi(y, x, s, RBF(2.5)))
+    got = np.asarray(phi_pallas(y, x, s, bandwidth=2.5, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_phi_pallas_self_interaction_svgd_step(rng):
+    """A full Jacobi step using the pallas φ equals the XLA step."""
+    parts = jnp.asarray(rng.normal(size=(33, 5)), dtype=jnp.float32)
+    scores = jnp.asarray(rng.normal(size=(33, 5)), dtype=jnp.float32)
+    eps = 0.05
+    want = np.asarray(parts + eps * phi(parts, parts, scores, RBF(1.0)))
+    got = np.asarray(
+        parts + eps * phi_pallas(parts, parts, scores, interpret=True)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_phi_pallas_preserves_dtype(rng):
+    y = jnp.asarray(rng.normal(size=(8, 2)))  # float64 under x64 tests
+    x = jnp.asarray(rng.normal(size=(8, 2)))
+    s = jnp.asarray(rng.normal(size=(8, 2)))
+    out = phi_pallas(y, x, s, interpret=True)
+    assert out.dtype == y.dtype
+    assert out.shape == y.shape
+
+
+def test_pallas_available_is_false_on_cpu():
+    from dist_svgd_tpu.ops.pallas_svgd import pallas_available
+
+    assert pallas_available() is False
+
+
+def test_sampler_phi_impl_pallas_matches_xla(rng):
+    """Full Sampler runs agree between implementations (forced pallas uses
+    the interpreter on CPU)."""
+    from dist_svgd_tpu import Sampler
+    from dist_svgd_tpu.models.gmm import gmm_logp
+
+    init = jnp.asarray(rng.normal(size=(12, 1)), dtype=jnp.float32)
+    ref, _ = Sampler(1, gmm_logp, phi_impl="xla").run(
+        12, 10, 0.5, record=False, initial_particles=init
+    )
+    got, _ = Sampler(1, gmm_logp, phi_impl="pallas").run(
+        12, 10, 0.5, record=False, initial_particles=init
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6)
+
+
+def test_sampler_phi_impl_validation():
+    from dist_svgd_tpu import Sampler
+    from dist_svgd_tpu.models.gmm import gmm_logp
+
+    with pytest.raises(ValueError, match="unknown phi_impl"):
+        Sampler(1, gmm_logp, phi_impl="cuda")
+    with pytest.raises(ValueError, match="requires an RBF kernel"):
+        Sampler(1, gmm_logp, kernel=lambda a, b: jnp.exp(-jnp.sum((a - b) ** 2)),
+                phi_impl="pallas")
+    with pytest.raises(ValueError, match="requires update_rule"):
+        Sampler(1, gmm_logp, update_rule="gauss_seidel", phi_impl="pallas")
