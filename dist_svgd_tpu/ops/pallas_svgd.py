@@ -44,9 +44,6 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-from dist_svgd_tpu.ops.kernels import RBF
-
-
 def _phi_kernel(y_ref, x_ref, s_ref, o_ref, acc_ref, ksum_ref, *,
                 inv_h: float, m_true: int, block_m: int, nm: int):
     """One (i, j) grid step: accumulate tile j's contribution to output tile i."""
@@ -115,6 +112,11 @@ def phi_pallas(
         block_k / block_m: output/interaction tile sizes (static; multiples of
             the f32 tile constraints are best — 128/256).
         interpret: run under the Pallas interpreter (CPU testing).
+
+    Note: computation is float32 internally regardless of input dtype (the
+    TPU MXU has no f64 path); float64 inputs are cast down and the result
+    cast back, so f64 callers get f32 accuracy — use the XLA ``phi`` when
+    genuine f64 is needed.
     """
     k, d = updated.shape
     m = interacting.shape[0]

@@ -1,0 +1,307 @@
+"""Benchmark suite over BASELINE.json's five configs (SURVEY.md §7.2 item 7).
+
+``bench.py`` at the repo root reports the single headline metric; this suite
+measures **every** BASELINE.json config plus a world-size scaling table that
+mirrors the shape of the reference's only published timing table
+(reference ``notes.md:120-135``, reproduced in ``BASELINE.md``):
+
+1. Bayesian logistic regression, 100 particles, single process.
+2. 1-D Gaussian-mixture posterior, 256 particles.
+3. Bayesian logistic regression, 10k particles, sharded over 8 shards.
+4. Bayesian logistic regression, 10k particles, Covertype, minibatched
+   scores, data sharded over the mesh.
+5. 2-layer Bayesian NN regression (UCI), 500 particles, weight-vector SVGD.
+
+Each config prints one JSON line ``{"config": ..., "updates_per_sec": ...}``;
+``--table`` additionally prints markdown tables.  On a host with fewer
+devices than shards the sharded configs run the identical SPMD program under
+vmap emulation (one device) and are labelled ``"emulated": true`` — honest
+single-chip numbers, not a multi-chip claim.
+
+Timing protocol: compile/warm up with the same shapes first, then time
+execution only, fenced with ``block_until_ready`` (SURVEY.md §5 tracing row).
+"""
+
+import json
+import time
+
+import click
+import numpy as np
+
+from paths import DATA_DIR  # noqa: F401  (bootstraps sys.path)
+
+from dist_svgd_tpu.utils.platform import select_backend
+
+from bench import REFERENCE_BEST_UPDATES_PER_SEC  # single source of truth
+
+
+def _platform():
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _emulated(num_shards: int) -> bool:
+    import jax
+
+    return len(jax.devices()) < num_shards
+
+
+def _time_sampler_run(sampler, n, iters, step_size):
+    """Warm up (compiles the scan for this iteration count), then time."""
+    sampler.run(n, iters, step_size, seed=0, record=False)[0].block_until_ready()
+    t0 = time.perf_counter()
+    final, _ = sampler.run(n, iters, step_size, seed=0, record=False)
+    final.block_until_ready()
+    return time.perf_counter() - t0
+
+
+def _time_dist_steps(sampler, iters, step_size, warmup=3):
+    for _ in range(warmup):
+        sampler.make_step(step_size)
+    np.asarray(sampler.particles)  # fence the warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = sampler.make_step(step_size)
+    out.block_until_ready()
+    return time.perf_counter() - t0
+
+
+def _result(config, n, iters, wall, **extra):
+    res = {
+        "config": config,
+        "n_particles": n,
+        "n_iters": iters,
+        "wall_s": round(wall, 4),
+        "updates_per_sec": round(n * iters / wall, 1),
+        "vs_reference_best": round(n * iters / wall / REFERENCE_BEST_UPDATES_PER_SEC, 2),
+        "platform": _platform(),
+    }
+    res.update(extra)
+    return res
+
+
+# --------------------------------------------------------------------- #
+# The five BASELINE.json configs
+
+
+def bench_logreg_single(iters):
+    """Config 1: BayesLR banana, 100 particles, single process."""
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import make_logreg_logp
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+
+    fold = load_benchmark("banana", 42)
+    logp = make_logreg_logp(fold.x_train, fold.t_train.reshape(-1))
+    d = 1 + fold.x_train.shape[1]
+    sampler = dt.Sampler(d, logp)
+    wall = _time_sampler_run(sampler, 100, iters, 3e-3)
+    return _result("1:logreg-single-100p", 100, iters, wall, dataset="banana")
+
+
+def bench_gmm(iters):
+    """Config 2: 1-D GMM posterior, 256 particles."""
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+
+    sampler = dt.Sampler(1, gmm_logp)
+    wall = _time_sampler_run(sampler, 256, iters, 1.0)
+    return _result("2:gmm-256p", 256, iters, wall)
+
+
+def bench_logreg_sharded(iters, num_shards=8, n_particles=10_000):
+    """Config 3: BayesLR, 10k particles sharded over 8 shards
+    (``all_particles`` exchange — the BASELINE.json north-star mode)."""
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import logreg_logp
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    fold = load_benchmark("banana", 42)
+    data = (jnp.asarray(fold.x_train), jnp.asarray(fold.t_train.reshape(-1)))
+    d = 1 + fold.x_train.shape[1]
+    particles = init_particles_per_shard(0, n_particles, d, num_shards)
+    sampler = dt.DistSampler(
+        num_shards, logreg_logp, None, particles, data=data,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False,
+    )
+    wall = _time_dist_steps(sampler, iters, 3e-3)
+    return _result(
+        "3:logreg-sharded-10kp", sampler.num_particles, iters, wall,
+        num_shards=num_shards, emulated=_emulated(num_shards), dataset="banana",
+    )
+
+
+def bench_covertype_minibatch(iters, num_shards=8, n_particles=10_000,
+                              n_rows=50_000, batch_size=256):
+    """Config 4: BayesLR, 10k particles, Covertype, minibatched scores,
+    data sharded (not replicated) over the mesh."""
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import logreg_likelihood, logreg_prior
+    from dist_svgd_tpu.utils.datasets import load_covertype
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    x, t = load_covertype(n_rows)
+    data = (jnp.asarray(x), jnp.asarray(t))
+    d = 1 + x.shape[1]
+    particles = init_particles_per_shard(0, n_particles, d, num_shards)
+    sampler = dt.DistSampler(
+        num_shards, logreg_likelihood, None, particles, data=data,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False, shard_data=True,
+        batch_size=batch_size, log_prior=logreg_prior,
+    )
+    wall = _time_dist_steps(sampler, iters, 1e-4)
+    return _result(
+        "4:covertype-minibatch-10kp", sampler.num_particles, iters, wall,
+        num_shards=num_shards, emulated=_emulated(num_shards),
+        n_rows=n_rows, batch_size=batch_size,
+    )
+
+
+def bench_bnn(iters, n_particles=500, dataset="boston", batch_size=100):
+    """Config 5: 2-layer Bayesian NN regression (UCI), 500 particles."""
+    import jax
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models import bnn
+    from dist_svgd_tpu.utils.datasets import load_uci_regression
+
+    split = load_uci_regression(dataset, 0)
+    n_features = split.x_train.shape[1]
+    likelihood, prior = bnn.make_bnn_split(n_features)
+    d = bnn.num_params(n_features)
+    init = bnn.init_particles(jax.random.PRNGKey(0), n_particles, n_features)
+    sampler = dt.Sampler(
+        d, likelihood, data=(split.x_train, split.y_train),
+        batch_size=min(batch_size, split.x_train.shape[0]), log_prior=prior,
+    )
+    sampler.run(n_particles, iters, 1e-3, seed=0, record=False,
+                initial_particles=init)[0].block_until_ready()
+    t0 = time.perf_counter()
+    final, _ = sampler.run(n_particles, iters, 1e-3, seed=0, record=False,
+                           initial_particles=init)
+    final.block_until_ready()
+    wall = time.perf_counter() - t0
+    return _result(
+        "5:bnn-uci-500p", n_particles, iters, wall,
+        dataset=dataset, d=d, batch_size=batch_size,
+    )
+
+
+# --------------------------------------------------------------------- #
+# World-size scaling table (the reference table's shape, notes.md:128-132)
+
+
+def scaling_table(iters, world_sizes=(1, 2, 4, 8), n_particles=50):
+    """Banana logreg, 50 particles — the reference's exact headline workload —
+    at world sizes 1/2/4/8, mirroring reference notes.md:128-132.  The
+    reference's wall-clock at this config: 2007.11 / 538.59 / 157.17 /
+    59.353 s for 500 iterations."""
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import logreg_logp
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    fold = load_benchmark("banana", 42)
+    data = (jnp.asarray(fold.x_train), jnp.asarray(fold.t_train.reshape(-1)))
+    d = 1 + fold.x_train.shape[1]
+    rows = []
+    for ws in world_sizes:
+        # reference drop policy: 50 particles on 4/8 shards truncates
+        # (dsvgd/distsampler.py:42-45)
+        n_used = (n_particles // ws) * ws
+        particles = init_particles_per_shard(0, n_used, d, ws)
+        sampler = dt.DistSampler(
+            ws, logreg_logp, None, particles, data=data,
+            exchange_particles=True, exchange_scores=False,
+            include_wasserstein=False,
+        )
+        wall = _time_dist_steps(sampler, iters, 3e-3)
+        rows.append(_result(
+            f"scaling:ws{ws}", sampler.num_particles, iters, wall,
+            num_shards=ws, emulated=_emulated(ws),
+        ))
+    return rows
+
+
+# --------------------------------------------------------------------- #
+
+
+def _markdown(results, scaling):
+    lines = [
+        "| config | n | iters | wall (s) | updates/sec | × ref best (421/s) |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in results:
+        lines.append(
+            f"| {r['config']} | {r['n_particles']} | {r['n_iters']} "
+            f"| {r['wall_s']} | {r['updates_per_sec']} | {r['vs_reference_best']} |"
+        )
+    if scaling:
+        lines += [
+            "",
+            "| world size | wall (s) | updates/sec | reference wall (s) |",
+            "|---|---|---|---|",
+        ]
+        ref = {1: 2007.11, 2: 538.59, 4: 157.17, 8: 59.353}
+        for r in scaling:
+            ws = r["num_shards"]
+            lines.append(
+                f"| {ws} | {r['wall_s']} | {r['updates_per_sec']} "
+                f"| {ref.get(ws, '—')} |"
+            )
+    return "\n".join(lines)
+
+
+_CONFIGS = {
+    "1": bench_logreg_single,
+    "2": bench_gmm,
+    "3": bench_logreg_sharded,
+    "4": bench_covertype_minibatch,
+    "5": bench_bnn,
+}
+
+
+@click.command()
+@click.option("--configs", default="1,2,3,4,5",
+              help="comma-separated subset of {1..5}, or 'all'")
+@click.option("--iters", default=100, help="timed iterations per config")
+@click.option("--scaling/--no-scaling", default=True,
+              help="also run the world-size scaling table")
+@click.option("--scaling-iters", default=500,
+              help="iterations for the scaling table (reference used 500)")
+@click.option("--table", is_flag=True, help="print markdown tables at the end")
+@click.option("--backend", default="auto",
+              type=click.Choice(["auto", "tpu", "cpu"]))
+def cli(configs, iters, scaling, scaling_iters, table, backend):
+    select_backend(backend)
+    wanted = list(_CONFIGS) if configs == "all" else configs.split(",")
+    results = []
+    for key in wanted:
+        key = key.strip()
+        fn = _CONFIGS.get(key)
+        if fn is None:
+            raise click.BadParameter(f"unknown config {key!r}")
+        res = fn(iters)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+    srows = []
+    if scaling:
+        srows = scaling_table(scaling_iters)
+        for r in srows:
+            print(json.dumps(r), flush=True)
+    if table:
+        print()
+        print(_markdown(results, srows))
+
+
+if __name__ == "__main__":
+    cli()

@@ -116,3 +116,24 @@ def test_gmm_experiment_writes_figure():
     fig = os.path.join(REPO, "experiments", "figures", "gmm_test.png")
     assert os.path.exists(fig)
     os.remove(fig)
+
+
+@pytest.mark.slow
+def test_bench_suite_all_configs():
+    """The five-config BASELINE.json suite runs end-to-end (tiny iteration
+    counts) and reports one JSON line per config plus the scaling table."""
+    import json
+
+    res = run_script([
+        "experiments/bench_suite.py", "--configs", "all", "--iters", "2",
+        "--scaling-iters", "2", "--table",
+    ], timeout=300)
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = [l for l in res.stdout.splitlines() if l.startswith("{")]
+    rows = [json.loads(l) for l in lines]
+    configs = [r["config"] for r in rows]
+    assert [c.split(":")[0] for c in configs[:5]] == ["1", "2", "3", "4", "5"]
+    assert [r["num_shards"] for r in rows[5:]] == [1, 2, 4, 8]
+    for r in rows:
+        assert r["updates_per_sec"] > 0
+    assert "| config |" in res.stdout  # markdown table
