@@ -103,6 +103,9 @@ class DistSampler:
         log_prior: optional separate prior ``log_prior(theta)``; when given,
             ``logp`` is pure likelihood and the prior gradient is added once,
             unscaled (see ``parallel/exchange.py``).
+        phi_impl: φ backend — ``'auto'`` (Pallas fused-tile φ on TPU with an
+            RBF kernel, XLA elsewhere), ``'xla'``, or ``'pallas'`` (force);
+            see :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
         seed: root PRNG seed for the per-step minibatch streams.
     """
 
@@ -126,6 +129,7 @@ class DistSampler:
         shard_data: bool = False,
         batch_size: Optional[int] = None,
         log_prior: Optional[Callable] = None,
+        phi_impl: str = "auto",
         seed=0,
     ):
         assert not (exchange_scores and not exchange_particles), (
@@ -200,6 +204,7 @@ class DistSampler:
             shard_data=shard_data,
             batch_size=batch_size,
             log_prior=log_prior,
+            phi_impl=phi_impl,
         )
         self._step = jax.jit(
             bind_shard_fn(

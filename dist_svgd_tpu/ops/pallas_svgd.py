@@ -177,3 +177,33 @@ def pallas_available() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:  # backend init failure
         return False
+
+
+def resolve_phi_fn(kernel, phi_impl: str):
+    """The framework-wide φ-backend policy, shared by ``Sampler``,
+    ``DistSampler``, and ``parallel/exchange.py``.
+
+    Returns ``phi_fn(updated, interacting, scores)``:
+
+    - ``'auto'``   — this Pallas kernel on TPU with an RBF kernel, the fused
+      XLA program (ops/svgd.py:phi) everywhere else;
+    - ``'xla'``    — always the XLA program;
+    - ``'pallas'`` — force this kernel (requires RBF); off-TPU it runs under
+      the Pallas interpreter — slow but exact, for CPU testing.
+    """
+    from dist_svgd_tpu.ops.kernels import RBF
+
+    if phi_impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown phi_impl {phi_impl!r}")
+    on_tpu = pallas_available()
+    if phi_impl == "auto":
+        phi_impl = "pallas" if on_tpu and isinstance(kernel, RBF) else "xla"
+    if phi_impl == "xla":
+        from dist_svgd_tpu.ops.svgd import phi
+
+        return lambda y, x, s: phi(y, x, s, kernel)
+    if not isinstance(kernel, RBF):
+        raise ValueError("phi_impl='pallas' requires an RBF kernel")
+    bw = kernel.bandwidth
+    interp = not on_tpu
+    return lambda y, x, s: phi_pallas(y, x, s, bandwidth=bw, interpret=interp)

@@ -1,4 +1,4 @@
-"""SPMD parallelism: mesh utilities and particle/score exchange strategies."""
+"""SPMD parallelism: mesh utilities, exchange strategies, multi-host setup."""
 
 from dist_svgd_tpu.parallel.mesh import AXIS, make_mesh, bind_shard_fn
 from dist_svgd_tpu.parallel.exchange import (
@@ -7,6 +7,7 @@ from dist_svgd_tpu.parallel.exchange import (
     PARTITIONS,
     make_shard_step,
 )
+from dist_svgd_tpu.parallel import multihost
 
 __all__ = [
     "AXIS",
@@ -16,4 +17,5 @@ __all__ = [
     "ALL_SCORES",
     "PARTITIONS",
     "make_shard_step",
+    "multihost",
 ]

@@ -59,7 +59,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dist_svgd_tpu.ops.svgd import phi
+from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
 from dist_svgd_tpu.parallel.mesh import AXIS
 from dist_svgd_tpu.utils.rng import draw_minibatch
 
@@ -87,7 +87,7 @@ def _ring_perm(num_shards: int):
     return [(j, (j + 1) % num_shards) for j in range(num_shards)]
 
 
-def _ring_phi_local_scores(y_block, score_of, kernel, num_shards):
+def _ring_phi_local_scores(y_block, score_of, phi_fn, num_shards):
     """Single-pass ring φ with ``all_particles`` semantics: the visiting block
     is scored by *this* device's ``score_of`` (local data, importance-scaled,
     prior included).  Equal block sizes let each hop contribute
@@ -97,7 +97,7 @@ def _ring_phi_local_scores(y_block, score_of, kernel, num_shards):
 
     def body(i, carry):
         visiting, acc = carry
-        acc = acc + phi(y_block, visiting, score_of(visiting), kernel)
+        acc = acc + phi_fn(y_block, visiting, score_of(visiting))
         return lax.ppermute(visiting, AXIS, perm), acc
 
     # S−1 (accumulate, rotate) hops, then the last visiting block needs no
@@ -106,11 +106,11 @@ def _ring_phi_local_scores(y_block, score_of, kernel, num_shards):
     visiting, acc = lax.fori_loop(
         0, num_shards - 1, body, (y_block, jnp.zeros_like(y_block))
     )
-    acc = acc + phi(y_block, visiting, score_of(visiting), kernel)
+    acc = acc + phi_fn(y_block, visiting, score_of(visiting))
     return acc / num_shards
 
 
-def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, kernel, num_shards):
+def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, phi_fn, num_shards):
     """Two-pass ring φ with ``all_scores`` semantics.  Pass 1 carries each
     block once around the ring, summing per-device local-data likelihood
     scores into an accumulator that travels with it — after S hops the block
@@ -135,7 +135,7 @@ def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, kernel, num_sh
 
     def phi_body(i, carry):
         visiting, vscores, acc = carry
-        acc = acc + phi(y_block, visiting, vscores, kernel)
+        acc = acc + phi_fn(y_block, visiting, vscores)
         return (
             lax.ppermute(visiting, AXIS, perm),
             lax.ppermute(vscores, AXIS, perm),
@@ -147,7 +147,7 @@ def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, kernel, num_sh
     visiting, vscores, acc = lax.fori_loop(
         0, num_shards - 1, phi_body, (visiting, vscores, jnp.zeros_like(y_block))
     )
-    acc = acc + phi(y_block, visiting, vscores, kernel)
+    acc = acc + phi_fn(y_block, visiting, vscores)
     return acc / num_shards
 
 
@@ -162,6 +162,7 @@ def make_shard_step(
     shard_data: bool = False,
     batch_size: Optional[int] = None,
     log_prior: Optional[Callable] = None,
+    phi_impl: str = "xla",
 ) -> Callable:
     """Build the per-shard SVGD step for one exchange strategy.
 
@@ -196,6 +197,8 @@ def make_shard_step(
             neither minibatch-amplified nor summed S times — unlike the
             reference, whose in-logp prior is importance-scaled,
             dsvgd/distsampler.py:96-99, and psum-multiplied in all_scores).
+        phi_impl: φ backend — ``'auto'`` / ``'xla'`` / ``'pallas'``; see
+            :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
 
     Returns:
         ``step(block, data, w_grad_block, t, key, step_size, h) -> new_block``
@@ -217,6 +220,7 @@ def make_shard_step(
             f"batch_size {batch_size} not in (0, {n_local_data}] local rows"
         )
 
+    phi_fn = resolve_phi_fn(kernel, phi_impl)
     score_fn = jax.grad(logp, argnums=0)
     batched_score = jax.vmap(score_fn, in_axes=(0, None))
     if log_prior is not None:
@@ -249,15 +253,15 @@ def make_shard_step(
 
         if mode == PARTITIONS:
             scores = score_scale * lik_score_of(block) + batched_prior(block)
-            delta = phi(block, block, scores, kernel)
+            delta = phi_fn(block, block, scores)
         elif ring:
             if mode == ALL_SCORES:
                 delta = _ring_phi_exact_scores(
-                    block, lik_score_of, batched_prior, kernel, num_shards
+                    block, lik_score_of, batched_prior, phi_fn, num_shards
                 )
             else:
                 score_of = lambda th: score_scale * lik_score_of(th) + batched_prior(th)
-                delta = _ring_phi_local_scores(block, score_of, kernel, num_shards)
+                delta = _ring_phi_local_scores(block, score_of, phi_fn, num_shards)
         else:
             interacting = lax.all_gather(block, AXIS, tiled=True)
             local_scores = lik_score_of(interacting)
@@ -266,7 +270,7 @@ def make_shard_step(
             else:
                 scores = score_scale * local_scores
             scores = scores + batched_prior(interacting)
-            delta = phi(block, interacting, scores, kernel)
+            delta = phi_fn(block, interacting, scores)
 
         delta = delta + h * w_grad_block
         return block + step_size * delta

@@ -1,0 +1,205 @@
+"""Multi-host (DCN) execution support.
+
+The reference scales past one machine with ``torch.distributed`` TCP
+rendezvous: env vars ``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE`` plus an
+explicit per-process rank (experiments/logreg.py:94-103,129-140 — SURVEY.md
+§2.4).  The TPU-native counterpart keeps the same operational shape — one
+process per host, one rendezvous — but after :func:`initialize` the SPMD
+program itself is unchanged: ``jax.distributed.initialize`` makes every
+host's chips visible as one global device list, a :class:`~jax.sharding.Mesh`
+spans them, and the very same jitted step (``parallel/exchange.py``) runs
+with XLA routing each collective hop over ICI within a host and DCN between
+hosts.  No rank bookkeeping survives into user code.
+
+Mesh ordering matters for collective cost: :func:`make_particle_mesh` orders
+the 1-D particle axis **host-major** (all of host 0's chips, then host 1's,
+…) via ``mesh_utils.create_hybrid_device_mesh``, so the ``partitions``/ring
+``lax.ppermute`` crosses DCN exactly once per host boundary per hop and all
+other traffic rides ICI — the minimum possible DCN load for a ring.
+
+Array placement: a multi-host global array cannot be built from one host's
+``jnp.asarray`` (each process only holds its addressable shards).
+:func:`make_global_particles` assembles the global ``(n, d)`` particle array
+from each process's local rows via ``jax.make_array_from_process_local_data``;
+:func:`process_local_rows` tells a process which logical block that is.  On a
+single process both degrade to the trivial case, so drivers are written once.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dist_svgd_tpu.parallel.mesh import AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> bool:
+    """Join the multi-host job — the counterpart of the reference's
+    ``dist.init_process_group('tcp', init_method='env://')``
+    (experiments/logreg.py:96).
+
+    With no arguments, JAX auto-detects cluster environments (TPU pods, GKE,
+    SLURM); arguments mirror the reference's explicit
+    ``MASTER_ADDR:PORT`` / world-size / rank rendezvous.  Must be the first
+    JAX call in the process (JAX's own ``jax.distributed`` contract — nothing
+    here may touch a device before the rendezvous).  Idempotent: returns
+    False (no-op) when the runtime is already initialized or when this is a
+    plainly single-process run (no coordinator given, no cluster detected),
+    True when initialization happened.  An explicit ``coordinator_address``
+    that cannot be honored always raises.
+    """
+    if jax.distributed.is_initialized():
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+        return True
+    except ValueError as e:
+        # no cluster to auto-detect (jax: "coordinator_address should be
+        # defined") — a plain single-process run
+        if coordinator_address is not None:
+            raise RuntimeError(
+                f"multi-host initialize({coordinator_address=}) failed: {e}"
+            ) from e
+        return False
+    except RuntimeError as e:
+        # Only the "must be called before any JAX calls …" too-late case may
+        # degrade to single-process; a detected cluster whose rendezvous
+        # *fails* (connection refused, timeout — XlaRuntimeError subclasses)
+        # must abort, or every worker would silently run an independent
+        # exchange-free job with wrong results.
+        if coordinator_address is not None or "before any JAX calls" not in str(e):
+            raise
+        warnings.warn(
+            "jax.distributed could not auto-initialize (the XLA backend is "
+            "already started); continuing single-process. Call "
+            "multihost.initialize() before any other JAX use.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return False
+
+
+def make_particle_mesh(
+    num_shards: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D particle mesh over every chip in the job, **host-major**.
+
+    ``num_shards`` defaults to the global device count (one shard per chip —
+    the normal multi-host configuration).  When several hosts are present the
+    device order comes from ``mesh_utils.create_hybrid_device_mesh`` so that
+    mesh-adjacent shards are ICI-adjacent and each ring hop crosses DCN only
+    at host boundaries; single-host falls back to the natural device order.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_shards is None:
+        num_shards = len(devices)
+    if num_shards > len(devices):
+        raise ValueError(f"need {num_shards} devices, have {len(devices)}")
+
+    n_hosts = len({d.process_index for d in devices})
+    if n_hosts > 1:
+        from jax.experimental import mesh_utils
+
+        per_host = num_shards // n_hosts
+        if per_host * n_hosts != num_shards:
+            raise ValueError(
+                f"num_shards {num_shards} must be a multiple of the "
+                f"{n_hosts} hosts"
+            )
+        by_host: dict = {}
+        for d in devices:
+            by_host.setdefault(d.process_index, []).append(d)
+        short = {p: len(v) for p, v in by_host.items() if len(v) < per_host}
+        if short:
+            raise ValueError(
+                f"need {per_host} devices per host for num_shards "
+                f"{num_shards}, but hosts {short} have fewer"
+            )
+        subset = [d for p in sorted(by_host) for d in by_host[p][:per_host]]
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (per_host,), (n_hosts,), devices=subset
+        )
+        return Mesh(dev_array, (AXIS,))
+    return Mesh(np.asarray(devices[:num_shards]), (AXIS,))
+
+
+def process_local_rows(n_global: int, mesh: Mesh) -> Tuple[int, int]:
+    """(start, count) of the logical particle rows this process's chips own
+    under ``P(AXIS)`` row sharding — what the reference computes per rank as
+    ``rank * particles_per_shard`` ownership ranges (dsvgd/distsampler.py:46-49),
+    derived here from the sharding itself."""
+    sharding = NamedSharding(mesh, P(AXIS))
+    idx_map = sharding.addressable_devices_indices_map((n_global,))
+    spans = sorted(
+        (
+            0 if sl.start is None else sl.start,
+            n_global if sl.stop is None else sl.stop,
+        )
+        for sl, *_ in idx_map.values()
+    )
+    lo, hi = spans[0][0], spans[-1][1]
+    cur = lo
+    for a, b in spans:
+        if a > cur:
+            raise ValueError(
+                "this process's addressable rows are not one contiguous "
+                "block — the mesh interleaves hosts; build it with "
+                "make_particle_mesh (host-major ordering)"
+            )
+        cur = max(cur, b)
+    return lo, hi - lo
+
+
+def make_global_particles(
+    local_rows, mesh: Mesh, n_global: Optional[int] = None
+) -> jax.Array:
+    """Assemble the global row-sharded ``(n, d)`` particle array from this
+    process's block of rows (``process_local_rows`` tells which).
+
+    ``n_global`` is the global row count — pass the same ``n`` given to
+    :func:`process_local_rows` (required when ``n`` does not divide evenly
+    across processes, where per-process counts differ and cannot be inferred
+    from the local block alone).  Defaults to assuming equal blocks.
+
+    Single-process this is just ``device_put`` with the row sharding; multi-
+    host it is the only correct way to build the array — no host holds all
+    rows, so drivers must never ``jnp.asarray`` a global particle set.
+    """
+    local_rows = np.asarray(local_rows)
+    sharding = NamedSharding(mesh, P(AXIS))
+    if jax.process_count() == 1:
+        # same contract as the multi-host path: one process owns all rows
+        if n_global is not None and n_global != local_rows.shape[0]:
+            raise ValueError(
+                f"n_global {n_global} != local rows {local_rows.shape[0]} "
+                "on a single-process run"
+            )
+        return jax.device_put(local_rows, sharding)
+    if n_global is None:
+        n_global = local_rows.shape[0] * jax.process_count()
+    return jax.make_array_from_process_local_data(
+        sharding, local_rows, global_shape=(n_global,) + local_rows.shape[1:]
+    )
+
+
+def replicate(value, mesh: Mesh) -> jax.Array:
+    """Place a host value replicated on every chip of the mesh (the multi-host
+    equivalent of the reference's every-rank-loads-the-full-dataset pattern,
+    experiments/logreg.py:28)."""
+    return jax.device_put(np.asarray(value), NamedSharding(mesh, P()))

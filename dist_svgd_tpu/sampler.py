@@ -23,7 +23,7 @@ import numpy as np
 from jax import lax
 
 from dist_svgd_tpu.ops.kernels import RBF
-from dist_svgd_tpu.ops.svgd import phi, svgd_step_sequential
+from dist_svgd_tpu.ops.svgd import svgd_step_sequential
 from dist_svgd_tpu.utils.history import history_to_dataframe
 from dist_svgd_tpu.utils.rng import as_key, draw_minibatch, init_particles, minibatch_key
 
@@ -75,8 +75,6 @@ class Sampler:
     ):
         if update_rule not in ("jacobi", "gauss_seidel"):
             raise ValueError(f"unknown update_rule {update_rule!r}")
-        if phi_impl not in ("auto", "xla", "pallas"):
-            raise ValueError(f"unknown phi_impl {phi_impl!r}")
         if batch_size is not None and data is None:
             raise ValueError("batch_size requires data")
         if batch_size is not None and update_rule != "jacobi":
@@ -98,31 +96,13 @@ class Sampler:
             )
         self._log_prior = log_prior
 
-        from dist_svgd_tpu.ops.pallas_svgd import pallas_available, phi_pallas
+        from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
 
-        on_tpu = pallas_available()
-        if phi_impl == "pallas":
-            if not isinstance(self._kernel, RBF):
-                raise ValueError("phi_impl='pallas' requires an RBF kernel")
-            if update_rule != "jacobi":
-                # the gauss_seidel sweep never calls φ through self._phi, so a
-                # forced pallas choice would silently no-op
-                raise ValueError("phi_impl='pallas' requires update_rule='jacobi'")
-            use_pallas = True
-        else:
-            use_pallas = (
-                phi_impl == "auto" and on_tpu and isinstance(self._kernel, RBF)
-            )
-        if use_pallas:
-            bw = self._kernel.bandwidth
-            # forced 'pallas' off-TPU runs under the interpreter (slow but
-            # exact — how the CPU tests exercise this path)
-            interp = not on_tpu
-            self._phi = lambda y, x, s: phi_pallas(
-                y, x, s, bandwidth=bw, interpret=interp
-            )
-        else:
-            self._phi = lambda y, x, s: phi(y, x, s, self._kernel)
+        if phi_impl == "pallas" and update_rule != "jacobi":
+            # the gauss_seidel sweep never calls φ through self._phi, so a
+            # forced pallas choice would silently no-op
+            raise ValueError("phi_impl='pallas' requires update_rule='jacobi'")
+        self._phi = resolve_phi_fn(self._kernel, phi_impl)
         if data is None:
             if log_prior is not None:
                 full = lambda theta: logp(theta) + log_prior(theta)

@@ -1,0 +1,101 @@
+"""Multi-host support (parallel/multihost.py), exercised single-process on
+the 8-virtual-CPU-device fixture (SURVEY.md §4's distributed-without-hardware
+stance: the mesh/sharding code paths are identical multi-host; only the
+rendezvous differs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dist_svgd_tpu as dt
+from dist_svgd_tpu.models.gmm import gmm_logp
+from dist_svgd_tpu.parallel.mesh import AXIS
+from dist_svgd_tpu.parallel import multihost
+
+
+def test_initialize_is_noop_single_process():
+    # The test process has long since started the XLA backend, so auto-detect
+    # cannot rendezvous any more: initialize() must degrade to single-process
+    # loudly (RuntimeWarning), not crash.
+    with pytest.warns(RuntimeWarning, match="continuing single-process"):
+        assert multihost.initialize() is False
+    assert jax.process_count() == 1
+
+
+def test_initialize_explicit_coordinator_raises_when_too_late():
+    # An explicit multi-host request that cannot be honored must never be
+    # silently downgraded.
+    with pytest.raises(RuntimeError):
+        multihost.initialize(
+            coordinator_address="definitely-not-a-host:1",
+            num_processes=2,
+            process_id=0,
+        )
+
+
+def test_make_particle_mesh_defaults_to_all_devices():
+    mesh = multihost.make_particle_mesh()
+    assert mesh.axis_names == (AXIS,)
+    assert mesh.shape[AXIS] == len(jax.devices())
+
+
+def test_make_particle_mesh_subset_and_overflow():
+    mesh = multihost.make_particle_mesh(4)
+    assert mesh.shape[AXIS] == 4
+    with pytest.raises(ValueError, match="need"):
+        multihost.make_particle_mesh(len(jax.devices()) + 1)
+
+
+def test_process_local_rows_covers_everything_single_process():
+    mesh = multihost.make_particle_mesh(8)
+    start, count = multihost.process_local_rows(64, mesh)
+    assert (start, count) == (0, 64)
+
+
+def test_make_global_particles_row_sharded():
+    mesh = multihost.make_particle_mesh(8)
+    rows = np.arange(16 * 3, dtype=np.float64).reshape(16, 3)
+    arr = multihost.make_global_particles(rows, mesh, n_global=16)
+    assert arr.shape == (16, 3)
+    np.testing.assert_array_equal(np.asarray(arr), rows)
+    # rows are actually split over the mesh devices
+    assert len(arr.sharding.device_set) == 8
+
+
+def test_replicate_places_full_value_everywhere():
+    mesh = multihost.make_particle_mesh(8)
+    val = np.arange(10.0)
+    arr = multihost.replicate(val, mesh)
+    np.testing.assert_array_equal(np.asarray(arr), val)
+    assert arr.sharding.is_fully_replicated
+
+
+def test_distsampler_runs_on_multihost_mesh():
+    """The full driver recipe: build the host-major mesh, assemble the global
+    particle array from (this process's) local rows, run sharded steps."""
+    mesh = multihost.make_particle_mesh(8)
+    rng = np.random.default_rng(7)
+    n, d = 32, 2
+    start, count = multihost.process_local_rows(n, mesh)
+    local = rng.normal(size=(count, d))
+    particles = multihost.make_global_particles(local, mesh, n_global=n)
+
+    sampler = dt.DistSampler(
+        8, lambda th, _: gmm_logp(th), None, particles,
+        exchange_particles=True, exchange_scores=True,
+        include_wasserstein=False, mesh=mesh,
+    )
+    out = sampler.make_step(0.1)
+    assert out.shape == (n, d)
+    assert np.isfinite(np.asarray(out)).all()
+
+    # equals the emulated (mesh=None) path on the same inputs
+    ref = dt.DistSampler(
+        8, lambda th, _: gmm_logp(th), None, local,
+        exchange_particles=True, exchange_scores=True,
+        include_wasserstein=False, mesh=None,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref.make_step(0.1)), rtol=1e-12, atol=1e-12
+    )

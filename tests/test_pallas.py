@@ -88,6 +88,53 @@ def test_sampler_phi_impl_pallas_matches_xla(rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize(
+    "exch_p,exch_s,impl",
+    [
+        (True, True, "gather"),
+        (True, False, "gather"),
+        (False, False, "gather"),  # partitions
+        (True, True, "ring"),
+        (True, False, "ring"),
+    ],
+)
+def test_distsampler_phi_impl_pallas_matches_xla(rng, exch_p, exch_s, impl):
+    """Every exchange mode × gather/ring produces the same step with the
+    pallas φ (interpreter on CPU) as with the XLA φ."""
+    from dist_svgd_tpu import DistSampler
+    from dist_svgd_tpu.models.gmm import gmm_logp
+
+    S, n, d = 4, 16, 2
+    particles = jnp.asarray(rng.normal(size=(n, d)), dtype=jnp.float32)
+    logp = lambda th, _: gmm_logp(th)
+
+    def run(phi_impl):
+        ds = DistSampler(
+            S, logp, None, particles,
+            exchange_particles=exch_p, exchange_scores=exch_s,
+            include_wasserstein=False, exchange_impl=impl, phi_impl=phi_impl,
+        )
+        ds.make_step(0.1)
+        return np.asarray(ds.make_step(0.1))
+
+    np.testing.assert_allclose(run("pallas"), run("xla"), rtol=2e-5, atol=2e-6)
+
+
+def test_distsampler_phi_impl_validation(rng):
+    from dist_svgd_tpu import DistSampler
+    from dist_svgd_tpu.models.gmm import gmm_logp
+
+    particles = jnp.asarray(rng.normal(size=(8, 2)), dtype=jnp.float32)
+    logp = lambda th, _: gmm_logp(th)
+    with pytest.raises(ValueError, match="unknown phi_impl"):
+        DistSampler(4, logp, None, particles, phi_impl="cuda")
+    with pytest.raises(ValueError, match="requires an RBF kernel"):
+        DistSampler(
+            4, logp, lambda a, b: jnp.exp(-jnp.sum((a - b) ** 2)), particles,
+            include_wasserstein=False, phi_impl="pallas",
+        )
+
+
 def test_sampler_phi_impl_validation():
     from dist_svgd_tpu import Sampler
     from dist_svgd_tpu.models.gmm import gmm_logp
