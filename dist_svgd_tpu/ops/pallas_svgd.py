@@ -17,6 +17,11 @@ writeup/writeup.tex:106-124):
 using ``drive + repulse = Kᵗ(s − (2/h)x) + (2/h)·y⊙ksum`` — one fewer MXU
 pass than computing ``Kᵗs`` and ``Kᵗx`` separately.
 
+Two distance variants, chosen statically on the feature dim: d ≤
+:data:`SMALL_D` computes ``Σ_c (y_c − x_c)²`` with one rank-1 VPU broadcast
+per dim (exact, no 128-lane-padded matmul — the win for the d=3/d=1
+reference models); larger d uses the classic ``y²+x²−2·y·x`` MXU form.
+
 The grid is ``(k/bk, m/bm)`` with the m-axis innermost; per output tile the
 two accumulators (φ partial sum and Gram row-sum) live in VMEM scratch, which
 persists across the sequentially-executed grid steps (standard TPU
@@ -31,6 +36,7 @@ the XLA path.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,14 +50,37 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-def _phi_kernel(y_ref, x_ref, s_ref, o_ref, acc_ref, ksum_ref, *,
+#: Feature dims up to this use the broadcast-distance kernel (one (bk, bm)
+#: subtract/square per dim on the VPU) instead of the y²+x²−2·y·x matmul.
+SMALL_D = 8
+
+
+def _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref, *,
+              inv_h: float, m_true: int, nm: int):
+    """Shared accumulator epilogue of both kernel variants."""
+    rowsum = jnp.sum(kt, axis=1, keepdims=True)  # (bk, 1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        ksum_ref[:] = jnp.zeros_like(ksum_ref)
+
+    acc_ref[:] = acc_ref[:] + contrib
+    ksum_ref[:] = ksum_ref[:] + rowsum  # broadcast across the lane dim
+
+    @pl.when(j == nm - 1)
+    def _():
+        o_ref[:] = (acc_ref[:] + (2.0 * inv_h) * y * ksum_ref[:, :1]) / m_true
+
+
+def _phi_kernel(y_ref, x_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
                 inv_h: float, m_true: int, block_m: int, nm: int):
     """One (i, j) grid step: accumulate tile j's contribution to output tile i."""
     j = pl.program_id(1)
 
-    y = y_ref[:]  # (bk, dp)
-    x = x_ref[:]  # (bm, dp)
-    s = s_ref[:]  # (bm, dp)
+    y = y_ref[:]   # (bk, dp)
+    x = x_ref[:]   # (bm, dp)
+    xs = xs_ref[:]  # (bm, dp)  == s − (2/h)·x, precomputed once outside
 
     # pairwise squared distances, clamped like ops/kernels.py:squared_distances.
     # HIGHEST precision: the TPU MXU's default bf16 passes put ~1e-2 absolute
@@ -68,22 +97,39 @@ def _phi_kernel(y_ref, x_ref, s_ref, o_ref, acc_ref, ksum_ref, *,
     col = jax.lax.broadcasted_iota(jnp.int32, kt.shape, dimension=1)
     kt = jnp.where(col + j * block_m < m_true, kt, 0.0)
 
-    contrib = jnp.dot(kt, s - (2.0 * inv_h) * x,
-                      preferred_element_type=jnp.float32,
+    contrib = jnp.dot(kt, xs, preferred_element_type=jnp.float32,
                       precision=jax.lax.Precision.HIGHEST)  # (bk, dp) MXU
-    rowsum = jnp.sum(kt, axis=1, keepdims=True)            # (bk, 1)
+    _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref,
+              inv_h=inv_h, m_true=m_true, nm=nm)
 
-    @pl.when(j == 0)
-    def _():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-        ksum_ref[:] = jnp.zeros_like(ksum_ref)
 
-    acc_ref[:] = acc_ref[:] + contrib
-    ksum_ref[:] = ksum_ref[:] + rowsum  # broadcast across the lane dim
+def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
+                        inv_h: float, m_true: int, d_true: int, block_m: int,
+                        nm: int):
+    """Small-d variant: distances as Σ_c (y_c − x_c)² via rank-1 VPU
+    broadcasts (one ``(bk,1) − (1,bm)`` per feature dim, d ≤ :data:`SMALL_D`).
+    Skips the 128-lane-padded distance matmul entirely — ~30% faster at the
+    10k-particle d=3 north star on a v5e — and is *exact* f32: no
+    y²+x²−2·y·x cancellation, so no clamp is needed."""
+    j = pl.program_id(1)
 
-    @pl.when(j == nm - 1)
-    def _():
-        o_ref[:] = (acc_ref[:] + (2.0 * inv_h) * y * ksum_ref[:, :1]) / m_true
+    y = y_ref[:]    # (bk, dp)
+    xT = xT_ref[:]  # (SMALL_D, bm)  — interaction block, transposed
+    xs = xs_ref[:]  # (bm, dp)       == s − (2/h)·x
+
+    d2 = None
+    for c in range(d_true):  # static unroll
+        diff = y[:, c:c + 1] - xT[c:c + 1, :]  # (bk, bm)
+        d2 = diff * diff if d2 is None else d2 + diff * diff
+    kt = jnp.exp(-d2 * inv_h)
+
+    col = jax.lax.broadcasted_iota(jnp.int32, kt.shape, dimension=1)
+    kt = jnp.where(col + j * block_m < m_true, kt, 0.0)
+
+    contrib = jnp.dot(kt, xs, preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)  # (bk, dp) MXU
+    _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref,
+              inv_h=inv_h, m_true=m_true, nm=nm)
 
 
 def _pad_to(a: jax.Array, rows: int, cols: int) -> jax.Array:
@@ -98,8 +144,8 @@ def phi_pallas(
     interacting: jax.Array,
     scores: jax.Array,
     bandwidth: float = 1.0,
-    block_k: int = 256,
-    block_m: int = 256,
+    block_k: Optional[int] = None,
+    block_m: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Fused-tile φ̂* — drop-in for ``ops.svgd.phi(..., RBF(bandwidth))``.
@@ -109,8 +155,11 @@ def phi_pallas(
         interacting: ``(m, d)`` interaction set.
         scores: ``(m, d)`` scores for the interaction set.
         bandwidth: RBF bandwidth ``h`` (static).
-        block_k / block_m: output/interaction tile sizes (static; multiples of
-            the f32 tile constraints are best — 128/256).
+        block_k / block_m: output/interaction tile sizes (static).  Default:
+            512×512 in the small-d variant (measured fastest at the
+            10k-particle config on a v5e), 256×256 in the big-d variant
+            (512-tiles of three (512, dp) f32 blocks plus scratch overflow
+            VMEM for large dp, where 256 fits).
         interpret: run under the Pallas interpreter (CPU testing).
 
     Note: computation is float32 internally regardless of input dtype (the
@@ -122,25 +171,37 @@ def phi_pallas(
     m = interacting.shape[0]
     in_dtype = updated.dtype
 
-    bk = min(block_k, _round_up(k, 8))
-    bm = min(block_m, _round_up(m, 8))
+    default_block = 512 if d <= SMALL_D else 256
+    bk = min(block_k or default_block, _round_up(k, 8))
+    bm = min(block_m or default_block, _round_up(m, 8))
     kp, mp = _round_up(k, bk), _round_up(m, bm)
     dp = _round_up(d, 128)
+    inv_h = 1.0 / float(bandwidth)
 
     f32 = jnp.float32
     y = _pad_to(updated.astype(f32), kp, dp)
-    x = _pad_to(interacting.astype(f32), mp, dp)
-    s = _pad_to(scores.astype(f32), mp, dp)
+    # s − (2/h)·x, computed once instead of per output tile — in f32, so
+    # low-precision inputs keep the "float32 internally" contract below
+    xs = _pad_to(
+        scores.astype(f32) - (2.0 * inv_h) * interacting.astype(f32), mp, dp
+    )
 
     nk, nm = kp // bk, mp // bm
-    kern = functools.partial(
-        _phi_kernel,
-        inv_h=1.0 / float(bandwidth),
-        m_true=m,
-        block_m=bm,
-        nm=nm,
-    )
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    small_d = d <= SMALL_D
+    if small_d:
+        kern = functools.partial(
+            _phi_kernel_small_d,
+            inv_h=inv_h, m_true=m, d_true=d, block_m=bm, nm=nm,
+        )
+        x_in = _pad_to(interacting.T.astype(f32), SMALL_D, mp)
+        x_spec = pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem)
+    else:
+        kern = functools.partial(
+            _phi_kernel, inv_h=inv_h, m_true=m, block_m=bm, nm=nm,
+        )
+        x_in = _pad_to(interacting.astype(f32), mp, dp)
+        x_spec = pl.BlockSpec((bm, dp), lambda i, j: (j, 0), **vmem)
     scratch = (
         [pltpu.VMEM((bk, dp), f32), pltpu.VMEM((bk, 128), f32)]
         if pltpu is not None
@@ -156,13 +217,13 @@ def phi_pallas(
         grid=(nk, nm),
         in_specs=[
             pl.BlockSpec((bk, dp), lambda i, j: (i, 0), **vmem),
-            pl.BlockSpec((bm, dp), lambda i, j: (j, 0), **vmem),
+            x_spec,
             pl.BlockSpec((bm, dp), lambda i, j: (j, 0), **vmem),
         ],
         out_specs=pl.BlockSpec((bk, dp), lambda i, j: (i, 0), **vmem),
         scratch_shapes=scratch,
         interpret=interpret,
-    )(y, x, s)
+    )(y, x_in, xs)
     return out[:k, :d].astype(in_dtype)
 
 
