@@ -56,13 +56,13 @@ def _time_sampler_run(sampler, n, iters, step_size):
     return time.perf_counter() - t0
 
 
-def _time_dist_steps(sampler, iters, step_size, warmup=3):
-    for _ in range(warmup):
-        sampler.make_step(step_size)
-    np.asarray(sampler.particles)  # fence the warmup
+def _time_dist_steps(sampler, iters, step_size):
+    """Time the scanned K-step path (one dispatch — how the framework is
+    meant to be driven for throughput; ``DistSampler.run_steps``).  The
+    untimed first call compiles the length-``iters`` scan."""
+    sampler.run_steps(iters, step_size).block_until_ready()
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = sampler.make_step(step_size)
+    out = sampler.run_steps(iters, step_size)
     out.block_until_ready()
     return time.perf_counter() - t0
 

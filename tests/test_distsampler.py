@@ -252,3 +252,60 @@ def test_datafree_target_all_modes_run():
         )
         out = ds.make_step(0.1)
         assert bool(jnp.isfinite(out).all())
+
+
+@pytest.mark.parametrize("name,exch_p,exch_s", MODES)
+@pytest.mark.parametrize(
+    "batch_size,exchange_impl,shard_data",
+    [
+        (None, "gather", False),
+        (3, "gather", False),
+        (None, "ring", False),     # ppermute rotation under the scan
+        (None, "gather", True),    # sharded data arg through the scan
+    ],
+)
+def test_run_steps_equals_eager_make_step(
+    name, exch_p, exch_s, batch_size, exchange_impl, shard_data
+):
+    """One scanned run_steps(K) dispatch reproduces K make_step calls exactly
+    (same step-counter rotation and per-step minibatch key stream)."""
+    if shard_data and name == "partitions":
+        pytest.skip("shard_data is rejected in partitions mode")
+    if exchange_impl == "ring" and name == "partitions":
+        pytest.skip("ring impl only affects the all_* modes")
+    rng = np.random.default_rng(17)
+    S = 4
+    particles, data, _ = make_gaussian_problem(rng, num_shards=S)
+
+    def build():
+        return DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=exch_p, exchange_scores=exch_s,
+            include_wasserstein=False, batch_size=batch_size, seed=5,
+            exchange_impl=exchange_impl, shard_data=shard_data,
+        )
+
+    eager = build()
+    for _ in range(4):
+        want = eager.make_step(0.05)
+    scanned = build()
+    got = scanned.run_steps(4, 0.05)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-12)
+    assert scanned._t == eager._t
+    # mixing afterwards stays on the same trajectory
+    np.testing.assert_allclose(
+        np.asarray(scanned.make_step(0.05)),
+        np.asarray(eager.make_step(0.05)),
+        rtol=1e-12,
+    )
+
+
+def test_run_steps_rejects_wasserstein():
+    rng = np.random.default_rng(2)
+    particles, data, _ = make_gaussian_problem(rng, num_shards=2)
+    ds = DistSampler(
+        2, logreg_logp, None, jnp.asarray(particles), data=data,
+        include_wasserstein=True,
+    )
+    with pytest.raises(ValueError, match="include_wasserstein"):
+        ds.run_steps(3, 0.05)
