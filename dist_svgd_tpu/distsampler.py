@@ -371,13 +371,12 @@ class DistSampler:
         run = self._scan_cache.get(num_steps)
         if run is None:
             bound = self._bound_step
-            zeros = jnp.zeros_like(self._particles)
 
             @jax.jit
             def run(particles, data, t0, batch_key, eps, h):
                 def body(parts, t):
                     return (
-                        bound(parts, data, zeros, t,
+                        bound(parts, data, jnp.zeros_like(parts), t,
                               jax.random.fold_in(batch_key, t), eps, h),
                         None,
                     )
