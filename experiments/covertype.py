@@ -146,28 +146,50 @@ def run(
             profiler_trace,
         )
 
+        def next_after(i, every):
+            """First multiple of ``every`` strictly past step index ``i``."""
+            return (i // every + 1) * every if every else niter
+
         timer = StepTimer()
         last_logged = start  # first lap after a resume may span < log_every steps
         with JsonlLogger(
             path=metrics_path,
             stream=None if metrics_path or not log_every else sys.stdout,
         ) as logger, profiler_trace(profile_dir):
-            for i in range(start, niter):
+            i = start
+            while i < niter:
+                # batch everything up to the next log/checkpoint event into
+                # scanned dispatches; the event step itself stays eager so
+                # `prev` (the pre-step snapshot particle_stats drifts against)
+                # keeps its exact per-step meaning.  Chunks are powers of two:
+                # run_steps compiles one scan program per distinct length, so
+                # coprime cadences (e.g. --log-every 10 --checkpoint-every 7)
+                # would otherwise compile a fresh multi-second scan for every
+                # gap length; this bounds it at log2(niter) programs total.
+                event = min(niter, next_after(i, log_every),
+                            next_after(i, checkpoint_every))
+                gap = event - i - 1
+                while gap > 0:
+                    chunk = 1 << (gap.bit_length() - 1)
+                    sampler.run_steps(chunk, stepsize)
+                    i += chunk
+                    gap -= chunk
                 log_now = log_every and (i + 1) % log_every == 0
                 prev = sampler.particles if log_now else None
                 out = sampler.make_step(stepsize)
+                i += 1
                 if log_now:
                     lap = timer.mark(out)
-                    steps_in_lap = (i + 1) - last_logged
-                    last_logged = i + 1
+                    steps_in_lap = i - last_logged
+                    last_logged = i
                     logger.log(
-                        step=i + 1,
+                        step=i,
                         wall_s=round(lap, 4),
                         updates_per_sec=round(n_used * steps_in_lap / lap, 1),
                         **particle_stats(out, prev),
                     )
-                if checkpoint_every and mgr.should_save(i + 1):
-                    mgr.save(i + 1, sampler.state_dict())
+                if checkpoint_every and mgr.should_save(i):
+                    mgr.save(i, sampler.state_dict())
         final = sampler.particles
     final = jax.block_until_ready(final)
     wall = time.perf_counter() - t0
