@@ -35,12 +35,18 @@ def make_gmm_logp(
     ``log(Σ_i w_i exp(logpdf_i))`` (experiments/gmm.py:19-21) is computed in
     the numerically-stable logsumexp form — identical in exact arithmetic.
     """
-    means_a = jnp.asarray(means)
-    scales_a = jnp.asarray(scales)
-    log_w = jnp.log(jnp.asarray(weights))
+    # keep plain tuples here and convert inside logp: building device arrays
+    # at closure-construction time would initialise the XLA backend on
+    # module import (the parity instance below), which breaks the multi-host
+    # contract that jax.distributed.initialize() is the first JAX call.
+    # Under jit the conversions are trace-time constants — zero runtime cost.
+    means_t, scales_t, weights_t = tuple(means), tuple(scales), tuple(weights)
 
     def logp(theta, data=None):
         del data  # no dataset — the target density is the model
+        means_a = jnp.asarray(means_t)
+        scales_a = jnp.asarray(scales_t)
+        log_w = jnp.log(jnp.asarray(weights_t))
         comp = log_w[:, None] + _normal_logpdf(theta[None, :], means_a[:, None], scales_a[:, None])
         return jnp.sum(logsumexp(comp, axis=0))
 

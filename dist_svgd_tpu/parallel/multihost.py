@@ -12,10 +12,13 @@ with XLA routing each collective hop over ICI within a host and DCN between
 hosts.  No rank bookkeeping survives into user code.
 
 Mesh ordering matters for collective cost: :func:`make_particle_mesh` orders
-the 1-D particle axis **host-major** (all of host 0's chips, then host 1's,
-…) via ``mesh_utils.create_hybrid_device_mesh``, so the ``partitions``/ring
-``lax.ppermute`` crosses DCN exactly once per host boundary per hop and all
-other traffic rides ICI — the minimum possible DCN load for a ring.
+the 1-D particle axis **granule-major** — all chips of one DCN granule (a
+TPU slice on multi-slice jobs; a process on CPU federations), then the next
+— via ``mesh_utils.create_hybrid_device_mesh``, so the ``partitions``/ring
+``lax.ppermute`` crosses DCN exactly once per granule boundary per hop and
+all other traffic rides ICI — the minimum possible DCN load for a ring.
+Within a single ICI domain (one slice, however many hosts) there is no DCN
+and the natural device order is used.
 
 Array placement: a multi-host global array cannot be built from one host's
 ``jnp.asarray`` (each process only holds its addressable shards).
@@ -97,13 +100,14 @@ def make_particle_mesh(
     num_shards: Optional[int] = None,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """1-D particle mesh over every chip in the job, **host-major**.
+    """1-D particle mesh over every chip in the job, **granule-major**
+    (module docstring: slice-major on TPU multi-slice jobs, process-major on
+    CPU federations, natural order within one ICI domain).
 
     ``num_shards`` defaults to the global device count (one shard per chip —
-    the normal multi-host configuration).  When several hosts are present the
-    device order comes from ``mesh_utils.create_hybrid_device_mesh`` so that
-    mesh-adjacent shards are ICI-adjacent and each ring hop crosses DCN only
-    at host boundaries; single-host falls back to the natural device order.
+    the normal multi-host configuration).  The ordering makes mesh-adjacent
+    shards ICI-adjacent, so each ring hop crosses DCN only at granule
+    boundaries.
     """
     if devices is None:
         devices = jax.devices()
@@ -112,31 +116,66 @@ def make_particle_mesh(
     if num_shards > len(devices):
         raise ValueError(f"need {num_shards} devices, have {len(devices)}")
 
-    n_hosts = len({d.process_index for d in devices})
-    if n_hosts > 1:
-        from jax.experimental import mesh_utils
-
-        per_host = num_shards // n_hosts
-        if per_host * n_hosts != num_shards:
-            raise ValueError(
-                f"num_shards {num_shards} must be a multiple of the "
-                f"{n_hosts} hosts"
-            )
-        by_host: dict = {}
+    # Where is the DCN boundary?  On TPU multi-slice jobs it is the slice
+    # (hosts *within* a slice are still ICI-connected, so they need no
+    # special ordering); CPU federations expose no real slices (every
+    # process reports slice_index 0), so there the process boundary is the
+    # slow network.  A single granule means a single fast domain — plain
+    # device order, no hybrid mesh needed.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    n_procs = len({d.process_index for d in devices})
+    if len(slice_ids) > 1:
+        granule_of = lambda d: d.slice_index
+        process_is_granule = False
+    elif n_procs > 1 and devices[0].platform == "cpu":
+        granule_of = lambda d: d.process_index
+        process_is_granule = True
+    elif n_procs > 1:
+        # one ICI domain spanning several processes (single-slice multi-host
+        # TPU): no DCN to order around, but a subset must still take an equal
+        # block from every process — devices[:num_shards] could exclude whole
+        # processes, which would own zero shards and fail far from here
+        per_p = num_shards // n_procs
+        by_p: dict = {}
         for d in devices:
-            by_host.setdefault(d.process_index, []).append(d)
-        short = {p: len(v) for p, v in by_host.items() if len(v) < per_host}
-        if short:
+            by_p.setdefault(d.process_index, []).append(d)
+        if per_p * n_procs != num_shards or any(
+            len(v) < per_p for v in by_p.values()
+        ):
             raise ValueError(
-                f"need {per_host} devices per host for num_shards "
-                f"{num_shards}, but hosts {short} have fewer"
+                f"num_shards {num_shards} cannot take an equal share of the "
+                f"{n_procs} processes' devices "
+                f"({ {p: len(v) for p, v in by_p.items()} })"
             )
-        subset = [d for p in sorted(by_host) for d in by_host[p][:per_host]]
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            (per_host,), (n_hosts,), devices=subset
+        subset = [d for p in sorted(by_p) for d in by_p[p][:per_p]]
+        return Mesh(np.asarray(subset), (AXIS,))
+    else:
+        return Mesh(np.asarray(devices[:num_shards]), (AXIS,))
+
+    from jax.experimental import mesh_utils
+
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(granule_of(d), []).append(d)
+    n_g = len(groups)
+    per_g = num_shards // n_g
+    if per_g * n_g != num_shards:
+        raise ValueError(
+            f"num_shards {num_shards} must be a multiple of the {n_g} "
+            "DCN granules (slices/processes)"
         )
-        return Mesh(dev_array, (AXIS,))
-    return Mesh(np.asarray(devices[:num_shards]), (AXIS,))
+    short = {g: len(v) for g, v in groups.items() if len(v) < per_g}
+    if short:
+        raise ValueError(
+            f"need {per_g} devices per granule for num_shards {num_shards}, "
+            f"but granules {short} have fewer"
+        )
+    subset = [d for g in sorted(groups) for d in groups[g][:per_g]]
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        (per_g,), (n_g,), devices=subset,
+        process_is_granule=process_is_granule,
+    )
+    return Mesh(dev_array, (AXIS,))
 
 
 def process_local_rows(n_global: int, mesh: Mesh) -> Tuple[int, int]:
@@ -159,8 +198,8 @@ def process_local_rows(n_global: int, mesh: Mesh) -> Tuple[int, int]:
         if a > cur:
             raise ValueError(
                 "this process's addressable rows are not one contiguous "
-                "block — the mesh interleaves hosts; build it with "
-                "make_particle_mesh (host-major ordering)"
+                "block — the mesh interleaves processes; build it with "
+                "make_particle_mesh (granule-major ordering)"
             )
         cur = max(cur, b)
     return lo, hi - lo

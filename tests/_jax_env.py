@@ -1,0 +1,36 @@
+"""Shared CPU-environment plumbing for the test harness.
+
+This image boots every interpreter with an `axon` TPU PJRT plugin
+pre-registered via sitecustomize and `JAX_PLATFORMS=axon` exported.  CPU-only
+test processes must (a) force the platform to cpu through jax.config (the env
+var may be pre-set to axon) and (b) drop the axon backend factory before any
+client initialises — leaving it registered makes CPU-only init block on the
+TPU tunnel.  Used by conftest.py (the pytest process) and mh_worker.py
+(federation subprocesses) so the workaround lives in one place.
+"""
+
+import os
+
+
+def setup_cpu(device_count: int = 8, enable_x64: bool = True) -> None:
+    """Force this process onto ``device_count`` virtual CPU devices.
+
+    Must be called before any other JAX use.  Safe to call before
+    ``jax.distributed.initialize`` — nothing here touches a device.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={device_count}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)

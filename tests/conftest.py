@@ -15,20 +15,10 @@ float64 is enabled so vectorised implementations can be compared against the
 numpy oracle at tight tolerances.
 """
 
-import os
+import _jax_env
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+_jax_env.setup_cpu(device_count=8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
-
-from jax._src import xla_bridge  # noqa: E402
-
-xla_bridge._backend_factories.pop("axon", None)
 
 assert len(jax.devices("cpu")) >= 8, "expected 8 virtual CPU devices for mesh tests"

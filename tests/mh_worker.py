@@ -1,0 +1,65 @@
+"""Worker process for the two-process multi-host federation test
+(test_multihost.py::test_two_process_federation_matches_oracle).
+
+Not a test module.  Invoked as:
+    python mh_worker.py <rank> <nprocs> <coordinator> <outdir>
+Each process owns 4 virtual CPU devices; the federation forms one 8-device
+mesh.  Runs 5 scanned DistSampler steps on a deterministically-initialised
+global particle array and saves this process's resulting rows.
+"""
+
+import os
+import sys
+
+
+def main():
+    rank, nprocs, coordinator, outdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ["XLA_FLAGS"] = ""  # drop any inherited device-count flag
+    import _jax_env
+
+    # x64 on, matching conftest: the oracle in the pytest process runs under
+    # x64, and the comparison must not straddle two precision regimes
+    _jax_env.setup_cpu(device_count=4)
+
+    import jax
+    import numpy as np
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+    from dist_svgd_tpu.parallel import multihost
+
+    assert multihost.initialize(
+        coordinator_address=coordinator, num_processes=nprocs, process_id=rank
+    )
+    assert jax.process_count() == nprocs
+
+    mesh = multihost.make_particle_mesh()
+    n, d = 32, 2
+    start, count = multihost.process_local_rows(n, mesh)
+    # same seed in every process ⇒ a well-defined global init to slice from
+    full = np.random.default_rng(0).normal(size=(n, d)).astype(np.float32)
+    particles = multihost.make_global_particles(
+        full[start : start + count], mesh, n_global=n
+    )
+
+    ds = dt.DistSampler(
+        mesh.size, lambda th, _: gmm_logp(th), None, particles,
+        exchange_particles=True, exchange_scores=True,
+        include_wasserstein=False, mesh=mesh,
+    )
+    out = ds.run_steps(5, 0.1)
+
+    rows = np.concatenate(
+        [np.asarray(s.data) for s in sorted(
+            out.addressable_shards, key=lambda s: s.index[0].start or 0
+        )]
+    )
+    np.save(os.path.join(outdir, f"rows_{rank}.npy"), rows)
+    np.save(os.path.join(outdir, f"range_{rank}.npy"), np.array([start, count]))
+
+
+if __name__ == "__main__":
+    main()

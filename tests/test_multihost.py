@@ -3,6 +3,9 @@ the 8-virtual-CPU-device fixture (SURVEY.md §4's distributed-without-hardware
 stance: the mesh/sharding code paths are identical multi-host; only the
 rendezvous differs)."""
 
+import os
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -71,8 +74,87 @@ def test_replicate_places_full_value_everywhere():
     assert arr.sharding.is_fully_replicated
 
 
+def test_importing_framework_does_not_start_backend():
+    """Multi-host contract: ``jax.distributed.initialize()`` must be the
+    first JAX call, so importing any part of the framework (including the
+    module-level ``gmm_logp`` parity instance) must not initialise the XLA
+    backend.  Checked in a subprocess — this pytest process started its
+    backend long ago."""
+    import subprocess
+
+    code = (
+        "import jax\n"
+        "from jax._src import xla_bridge as xb\n"
+        "import dist_svgd_tpu\n"
+        "from dist_svgd_tpu.models.gmm import gmm_logp\n"
+        "from dist_svgd_tpu.models.logreg import logreg_logp\n"
+        "import dist_svgd_tpu.models.bnn\n"
+        "import dist_svgd_tpu.utils.datasets, dist_svgd_tpu.utils.checkpoint\n"
+        "import dist_svgd_tpu.utils.metrics\n"
+        "from dist_svgd_tpu.parallel import multihost\n"
+        "assert not xb.backends_are_initialized(), 'import started the backend'\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=repo,
+        capture_output=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+
+
+def test_two_process_federation_matches_oracle(tmp_path):
+    """REAL multi-process coverage: two OS processes, 4 virtual CPU devices
+    each, federated by ``jax.distributed`` into one 8-shard mesh.  Exercises
+    the branches a single process cannot — cross-process rendezvous,
+    ``make_array_from_process_local_data``, per-process ``process_local_rows``
+    — and checks the distributed trajectory against a single-process oracle.
+    """
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = os.path.join(os.path.dirname(__file__), "mh_worker.py")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(r), "2", f"127.0.0.1:{port}", str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(2)
+    ]
+    try:
+        logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    finally:
+        # a worker that crashed pre-rendezvous leaves its peer blocked in
+        # initialize(); never leak it past the test
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker failed:\n{log[-2000:]}"
+
+    n, d = 32, 2
+    got = np.empty((n, d), dtype=np.float32)
+    for r in range(2):
+        start, count = np.load(tmp_path / f"range_{r}.npy")
+        got[start : start + count] = np.load(tmp_path / f"rows_{r}.npy")
+
+    full = np.random.default_rng(0).normal(size=(n, d)).astype(np.float32)
+    ref = dt.DistSampler(
+        8, lambda th, _: gmm_logp(th), None, full,
+        exchange_particles=True, exchange_scores=True,
+        include_wasserstein=False, mesh=multihost.make_particle_mesh(8),
+    )
+    want = np.asarray(ref.run_steps(5, 0.1))
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-7)
+
+
 def test_distsampler_runs_on_multihost_mesh():
-    """The full driver recipe: build the host-major mesh, assemble the global
+    """The full driver recipe: build the granule-major mesh, assemble the global
     particle array from (this process's) local rows, run sharded steps."""
     mesh = multihost.make_particle_mesh(8)
     rng = np.random.default_rng(7)
