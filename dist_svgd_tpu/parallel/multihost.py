@@ -170,7 +170,26 @@ def make_particle_mesh(
             f"need {per_g} devices per granule for num_shards {num_shards}, "
             f"but granules {short} have fewer"
         )
-    subset = [d for g in sorted(groups) for d in groups[g][:per_g]]
+
+    def take(group):
+        """Equal per-process share of a granule's subset — ``group[:per_g]``
+        could take all of one host's chips and none of another's, leaving
+        processes that own zero shards (they would fail far away, in
+        ``process_local_rows``, with an empty indices map)."""
+        by_p: dict = {}
+        for d in group:
+            by_p.setdefault(d.process_index, []).append(d)
+        per_p = per_g // len(by_p)
+        if per_p * len(by_p) != per_g or any(
+            len(v) < per_p for v in by_p.values()
+        ):
+            raise ValueError(
+                f"cannot take an equal {per_g}-device share of a granule's "
+                f"processes ({ {p: len(v) for p, v in by_p.items()} })"
+            )
+        return [d for p in sorted(by_p) for d in by_p[p][:per_p]]
+
+    subset = [d for g in sorted(groups) for d in take(groups[g])]
     dev_array = mesh_utils.create_hybrid_device_mesh(
         (per_g,), (n_g,), devices=subset,
         process_is_granule=process_is_granule,

@@ -94,8 +94,7 @@ def run(
             log_prior=prior,
             seed=seed,
         )
-        for _ in range(niter):
-            sampler.make_step(stepsize)
+        sampler.run_steps(niter, stepsize)  # one scanned dispatch
         final = sampler.particles
     final = jax.block_until_ready(final)
     wall = time.perf_counter() - t0

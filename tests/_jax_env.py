@@ -10,6 +10,7 @@ TPU tunnel.  Used by conftest.py (the pytest process) and mh_worker.py
 """
 
 import os
+import re
 
 
 def setup_cpu(device_count: int = 8, enable_x64: bool = True) -> None:
@@ -17,13 +18,19 @@ def setup_cpu(device_count: int = 8, enable_x64: bool = True) -> None:
 
     Must be called before any other JAX use.  Safe to call before
     ``jax.distributed.initialize`` — nothing here touches a device.
+    Any inherited ``--xla_force_host_platform_device_count`` is replaced
+    (not skipped), so the requested count always wins while unrelated
+    inherited XLA flags are preserved.
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={device_count}"
-        ).strip()
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={device_count}"
+    ).strip()
 
     import jax
 

@@ -17,7 +17,6 @@ def main():
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     )
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    os.environ["XLA_FLAGS"] = ""  # drop any inherited device-count flag
     import _jax_env
 
     # x64 on, matching conftest: the oracle in the pytest process runs under
