@@ -242,12 +242,14 @@ class DistSampler:
     def mode(self) -> str:
         return self._mode
 
-    def owned_block_index(self, rank: int) -> int:
-        """Logical block index currently owned by (= updated against the data
-        slice of) shard ``rank``: ``(rank − t) mod S`` under the ring rotation
-        (dsvgd/distsampler.py:148-150), ``rank`` otherwise."""
+    def owned_block_index(self, rank: int, t: Optional[int] = None) -> int:
+        """Logical block index owned by (= updated against the data slice of)
+        shard ``rank`` at step counter ``t`` (default: now): ``(rank − t) mod
+        S`` under the ring rotation (dsvgd/distsampler.py:148-150), ``rank``
+        otherwise.  Pass an explicit ``t`` to interpret recorded history
+        snapshots (``run_steps(record=True)``)."""
         if self._mode == PARTITIONS:
-            return (rank - self._t) % self._num_shards
+            return (rank - (self._t if t is None else t)) % self._num_shards
         return rank
 
     def owned_block(self, rank: int) -> jax.Array:
@@ -349,7 +351,7 @@ class DistSampler:
 
     # ------------------------------------------------------------------ #
 
-    def run_steps(self, num_steps: int, step_size: float) -> jax.Array:
+    def run_steps(self, num_steps: int, step_size: float, record: bool = False):
         """``num_steps`` distributed SVGD steps as ONE device dispatch — a
         jitted ``lax.scan`` over the per-shard step, so per-step host→device
         latency (~15 ms through a TPU tunnel, docs/notes.md) is paid once per
@@ -357,6 +359,12 @@ class DistSampler:
         calls of :meth:`make_step` without the Wasserstein term: the step
         counter (``partitions`` rotation) and the per-step minibatch key fold
         advance exactly as the eager path does.
+
+        With ``record=True`` returns ``(final, history)`` where ``history`` is
+        the ``(num_steps, n, d)`` device array of pre-update snapshots (the
+        reference's history convention: the state *before* each step,
+        experiments/logreg.py:78-87 — append ``final`` for the trailing
+        post-update snapshot); otherwise returns the final particle array.
 
         The Wasserstein/JKO term requires the host-side ``previous`` snapshot
         bookkeeping (module docstring) and is only available through
@@ -368,25 +376,23 @@ class DistSampler:
                 "'previous' snapshot is host-side bookkeeping — use make_step"
             )
         dtype = self._particles.dtype
-        run = self._scan_cache.get(num_steps)
+        run = self._scan_cache.get((num_steps, record))
         if run is None:
             bound = self._bound_step
 
             @jax.jit
             def run(particles, data, t0, batch_key, eps, h):
                 def body(parts, t):
-                    return (
-                        bound(parts, data, jnp.zeros_like(parts), t,
-                              jax.random.fold_in(batch_key, t), eps, h),
-                        None,
-                    )
+                    new = bound(parts, data, jnp.zeros_like(parts), t,
+                                jax.random.fold_in(batch_key, t), eps, h)
+                    return new, (parts if record else None)
 
                 ts = t0 + 1 + jnp.arange(num_steps, dtype=jnp.int32)
-                out, _ = jax.lax.scan(body, particles, ts)
-                return out
+                out, hist = jax.lax.scan(body, particles, ts)
+                return (out, hist) if record else out
 
-            self._scan_cache[num_steps] = run
-        self._particles = run(
+            self._scan_cache[(num_steps, record)] = run
+        out = run(
             self._particles,
             self._data,
             jnp.asarray(self._t, dtype=jnp.int32),
@@ -395,6 +401,10 @@ class DistSampler:
             jnp.asarray(0.0, dtype=dtype),
         )
         self._t += num_steps
+        if record:
+            self._particles, history = out
+            return self._particles, history
+        self._particles = out
         return self._particles
 
     def make_step(self, step_size: float, h: float = 1.0) -> jax.Array:

@@ -70,21 +70,29 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, w
 
     # history: reference records each rank's owned block before every step
     # plus a final post-update snapshot (experiments/logreg.py:78-87).
-    # Blocks are accumulated as numpy snapshots and turned into the pickle
-    # schema once at the end, so the hot loop does one device sync per step.
     shard_blocks = [[] for _ in range(num_shards)]
+    per = n_used // num_shards
 
-    def record():
-        global_now = np.asarray(sampler.particles)
-        per = global_now.shape[0] // num_shards
+    def slice_snapshot(global_now, t=None):
+        """Append each rank's owned block at step counter ``t`` (default: the
+        sampler's current counter) — ownership per
+        DistSampler.owned_block_index."""
         for r in range(num_shards):
-            b = sampler.owned_block_index(r)
+            b = sampler.owned_block_index(r, t)
             shard_blocks[r].append(global_now[b * per : (b + 1) * per])
 
-    for _ in range(niter):
-        record()
-        sampler.make_step(stepsize, h=10.0)  # h=10 matches logreg.py:83
-    record()
+    if wasserstein:
+        # W2 snapshots are host-side bookkeeping — eager reference loop
+        for _ in range(niter):
+            slice_snapshot(np.asarray(sampler.particles))
+            sampler.make_step(stepsize, h=10.0)  # h=10 matches logreg.py:83
+        slice_snapshot(np.asarray(sampler.particles))
+    else:
+        # whole trajectory (with pre-update history) in one scanned dispatch
+        final, hist = sampler.run_steps(niter, stepsize, record=True)
+        snaps = np.concatenate([np.asarray(hist), np.asarray(final)[None]])
+        for t in range(niter + 1):
+            slice_snapshot(snaps[t], t)
 
     results_dir = get_results_dir(
         dataset_name, fold, num_shards, nparticles, stepsize, exchange, wasserstein

@@ -312,3 +312,28 @@ def test_run_steps_rejects_wasserstein():
     )
     with pytest.raises(ValueError, match="include_wasserstein"):
         ds.run_steps(3, 0.05)
+
+
+def test_run_steps_record_matches_eager_history():
+    """record=True returns the reference-convention pre-update snapshots —
+    exactly the per-step particle states the eager loop observes."""
+    rng = np.random.default_rng(23)
+    S = 4
+    particles, data, _ = make_gaussian_problem(rng, num_shards=S)
+
+    def build():
+        return DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=False, exchange_scores=False,  # partitions
+            include_wasserstein=False, seed=9,
+        )
+
+    eager = build()
+    want = [np.asarray(eager.particles)]
+    for _ in range(5):
+        want.append(np.asarray(eager.make_step(0.05)))
+
+    scanned = build()
+    final, hist = scanned.run_steps(5, 0.05, record=True)
+    got = np.concatenate([np.asarray(hist), np.asarray(final)[None]])
+    np.testing.assert_allclose(got, np.stack(want), rtol=2e-6)
