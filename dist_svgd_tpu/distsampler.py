@@ -44,6 +44,7 @@ from dist_svgd_tpu.parallel.exchange import (
     ALL_SCORES,
     PARTITIONS,
     make_shard_step,
+    make_shard_step_sinkhorn_w2,
 )
 from dist_svgd_tpu.parallel.mesh import AXIS, bind_shard_fn, make_mesh
 from dist_svgd_tpu.utils.rng import minibatch_key
@@ -163,6 +164,9 @@ class DistSampler:
 
         self._exchange_impl = exchange_impl
         self._shard_data = shard_data
+        self._batch_size = batch_size
+        self._log_prior = log_prior
+        self._phi_impl = phi_impl
         self._data = None if data is None else jax.tree_util.tree_map(jnp.asarray, data)
         # Physical slice size per shard is always rows // S (reference drop
         # policy); N_local/N_global are pure importance-scale factors like the
@@ -215,14 +219,16 @@ class DistSampler:
         )
         self._step = jax.jit(self._bound_step)
         self._scan_cache = {}
+        self._bound_w2_step = None  # lazily built by _run_steps_w2
         self._batch_key = minibatch_key(seed)
 
         # Wasserstein "previous particles" state.  In exchanged modes this is
         # a per-shard (S, n, d) stack (each shard's own warty mixed snapshot);
         # in partitions mode a (S, n_loc, d) stack of owned-block snapshots;
         # None until the first step, like the reference
-        # (dsvgd/distsampler.py:50, :186-188).
-        self._previous: Optional[np.ndarray] = None
+        # (dsvgd/distsampler.py:50, :186-188).  numpy when written by the
+        # eager path, a device array when written by the scanned path.
+        self._previous = None
         self._t = 0  # make_step call counter (drives the partitions rotation)
         self._sinkhorn_batched = None  # lazily-built jitted vmap solver
 
@@ -351,14 +357,20 @@ class DistSampler:
 
     # ------------------------------------------------------------------ #
 
-    def run_steps(self, num_steps: int, step_size: float, record: bool = False):
+    def run_steps(
+        self,
+        num_steps: int,
+        step_size: float,
+        record: bool = False,
+        h: float = 1.0,
+    ):
         """``num_steps`` distributed SVGD steps as ONE device dispatch — a
         jitted ``lax.scan`` over the per-shard step, so per-step host→device
         latency (~15 ms through a TPU tunnel, docs/notes.md) is paid once per
         call instead of once per step.  Semantically identical to ``num_steps``
-        calls of :meth:`make_step` without the Wasserstein term: the step
-        counter (``partitions`` rotation) and the per-step minibatch key fold
-        advance exactly as the eager path does.
+        calls of :meth:`make_step`: the step counter (``partitions`` rotation)
+        and the per-step minibatch key fold advance exactly as the eager path
+        does.
 
         With ``record=True`` returns ``(final, history)`` where ``history`` is
         the ``(num_steps, n, d)`` device array of pre-update snapshots (the
@@ -366,15 +378,25 @@ class DistSampler:
         experiments/logreg.py:78-87 — append ``final`` for the trailing
         post-update snapshot); otherwise returns the final particle array.
 
-        The Wasserstein/JKO term requires the host-side ``previous`` snapshot
-        bookkeeping (module docstring) and is only available through
-        :meth:`make_step`.
+        With the Wasserstein/JKO term enabled the ``previous`` snapshots ride
+        the scan carry on device (``parallel/exchange.py:
+        make_shard_step_sinkhorn_w2`` — same warty snapshot semantics as the
+        eager path); this requires ``wasserstein_solver='sinkhorn'`` and the
+        gather exchange implementation.  The host-LP solver stays
+        :meth:`make_step`-only.  ``h`` is the W2 weight (reference
+        ``delta += h·w_grad``); it is inert when the term is disabled.
         """
         if self._include_wasserstein:
-            raise ValueError(
-                "run_steps requires include_wasserstein=False; the W2 "
-                "'previous' snapshot is host-side bookkeeping — use make_step"
-            )
+            # ring is a no-op in partitions mode (constructor docstring), so
+            # only the all_* modes genuinely need the gather implementation
+            needs_gather = self._mode != PARTITIONS and self._exchange_impl != "gather"
+            if self._wasserstein_solver != "sinkhorn" or needs_gather:
+                raise ValueError(
+                    "run_steps with the Wasserstein term requires "
+                    "wasserstein_solver='sinkhorn' and exchange_impl='gather' "
+                    "(the host-LP snapshot path is make_step-only)"
+                )
+            return self._run_steps_w2(num_steps, step_size, h, record)
         dtype = self._particles.dtype
         run = self._scan_cache.get((num_steps, record))
         if run is None:
@@ -405,6 +427,95 @@ class DistSampler:
             self._particles, history = out
             return self._particles, history
         self._particles = out
+        return self._particles
+
+    def _run_steps_w2(self, num_steps: int, step_size, h, record: bool):
+        """Scanned trajectory with the Sinkhorn W2 term: the per-shard
+        ``previous`` snapshot stack rides the scan carry (device-side form of
+        the host bookkeeping in :meth:`_snapshot_previous`)."""
+        dtype = self._particles.dtype
+        if self._bound_w2_step is None:
+            step = make_shard_step_sinkhorn_w2(
+                logp=self._logp,
+                kernel=self._kernel,
+                mode=self._mode,
+                num_shards=self._num_shards,
+                n_local_data=self._rows_per_shard,
+                score_scale=self._score_scale,
+                shard_data=self._shard_data,
+                batch_size=self._batch_size,
+                log_prior=self._log_prior,
+                phi_impl=self._phi_impl,
+                sinkhorn_eps=self._sinkhorn_eps,
+                sinkhorn_iters=self._sinkhorn_iters,
+            )
+            self._bound_w2_step = bind_shard_fn(
+                step,
+                self._num_shards,
+                self._mesh,
+                in_specs=(0, 0, 0 if self._shard_data else None,
+                          None, None, None, None, None),
+                out_specs=(0, 0),
+            )
+
+        run = self._scan_cache.get(("w2", num_steps, record))
+        if run is None:
+            bound = self._bound_w2_step
+
+            @jax.jit
+            def run(particles, prev, w0, data, t0, batch_key, eps, h):
+                def body(carry, ti):
+                    parts, prv = carry
+                    t, i = ti
+                    # no W2 on a first-ever step (reference: the term waits
+                    # for a previous snapshot, dsvgd/distsampler.py:186-188);
+                    # every later scan iteration has one from the carry
+                    w_on = jnp.where((i == 0) & (w0 == 0.0), 0.0, 1.0).astype(
+                        parts.dtype
+                    )
+                    new, new_prev = bound(
+                        parts, prv, data, t,
+                        jax.random.fold_in(batch_key, t), eps, h, w_on,
+                    )
+                    return (new, new_prev), (parts if record else None)
+
+                ts = t0 + 1 + jnp.arange(num_steps, dtype=jnp.int32)
+                (out, prev_out), hist = jax.lax.scan(
+                    body, (particles, prev),
+                    (ts, jnp.arange(num_steps, dtype=jnp.int32)),
+                )
+                return out, prev_out, hist
+
+            self._scan_cache[("w2", num_steps, record)] = run
+
+        if self._mode == PARTITIONS and self._num_shards > 1:
+            prev_shape = (self._num_shards, self._particles_per_shard, self._d)
+        else:
+            prev_shape = (self._num_shards, self._num_particles, self._d)
+        have_prev = self._previous is not None
+        prev0 = (
+            jnp.asarray(self._previous, dtype=dtype)
+            if have_prev
+            else jnp.zeros(prev_shape, dtype=dtype)
+        )
+        out, prev_out, hist = run(
+            self._particles,
+            prev0,
+            jnp.asarray(1.0 if have_prev else 0.0, dtype=dtype),
+            self._data,
+            jnp.asarray(self._t, dtype=jnp.int32),
+            self._batch_key,
+            jnp.asarray(step_size, dtype=dtype),
+            jnp.asarray(h, dtype=dtype),
+        )
+        self._t += num_steps
+        self._particles = out
+        # keep the snapshot stack on device — the next run_steps consumes it
+        # there, and a forced D2H sync per call would defeat the one-dispatch
+        # goal; host consumers (state_dict, the eager LP path) np.asarray it
+        self._previous = prev_out
+        if record:
+            return self._particles, hist
         return self._particles
 
     def make_step(self, step_size: float, h: float = 1.0) -> jax.Array:

@@ -211,6 +211,28 @@ def make_shard_step(
         the reference does (dsvgd/distsampler.py:194-200).  ``t`` is the
         1-based step counter driving the ``partitions`` rotation.
     """
+    core = _build_core(
+        logp, kernel, mode, num_shards, n_local_data, score_scale,
+        ring, shard_data, batch_size, log_prior, phi_impl,
+    )
+
+    def step(block, data, w_grad_block, t, key, step_size, h):
+        delta, _ = core(block, data, t, key)
+        delta = delta + h * w_grad_block
+        return block + step_size * delta
+
+    return step
+
+
+def _build_core(
+    logp, kernel, mode, num_shards, n_local_data, score_scale,
+    ring, shard_data, batch_size, log_prior, phi_impl,
+):
+    """Shared exchange+φ computation: ``core(block, data, t, key) ->
+    (delta, interacting)`` where ``interacting`` is the pre-update all-gather
+    of the particle set in the gather-impl ``all_*`` modes (the array the
+    reference's Wasserstein snapshot is built from, dsvgd/distsampler.py:202-
+    203) and ``None`` otherwise."""
     if mode not in MODES:
         raise ValueError(f"unknown exchange mode {mode!r}")
     if shard_data and mode == PARTITIONS:
@@ -228,7 +250,7 @@ def make_shard_step(
     else:
         batched_prior = lambda thetas: jnp.zeros_like(thetas)
 
-    def step(block, data, w_grad_block, t, key, step_size, h):
+    def core(block, data, t, key):
         r = lax.axis_index(AXIS)
         if shard_data:
             data_local = data
@@ -251,6 +273,7 @@ def make_shard_step(
         def lik_score_of(thetas):
             return mb_scale * batched_score(thetas, data_local)
 
+        interacting = None
         if mode == PARTITIONS:
             scores = score_scale * lik_score_of(block) + batched_prior(block)
             delta = phi_fn(block, block, scores)
@@ -272,7 +295,76 @@ def make_shard_step(
             scores = scores + batched_prior(interacting)
             delta = phi_fn(block, interacting, scores)
 
-        delta = delta + h * w_grad_block
-        return block + step_size * delta
+        return delta, interacting
+
+    return core
+
+
+def make_shard_step_sinkhorn_w2(
+    logp: Callable,
+    kernel,
+    mode: str,
+    num_shards: int,
+    n_local_data: int,
+    score_scale: float,
+    shard_data: bool = False,
+    batch_size: Optional[int] = None,
+    log_prior: Optional[Callable] = None,
+    phi_impl: str = "xla",
+    sinkhorn_eps: float = 0.05,
+    sinkhorn_iters: int = 200,
+) -> Callable:
+    """Per-shard SVGD step with the Wasserstein/JKO term computed **inside
+    the step** from carried previous-snapshot state, so whole W2 trajectories
+    can run under one ``lax.scan`` (``DistSampler.run_steps``).
+
+    Replicates the reference's exact (warty) snapshot semantics
+    (dsvgd/distsampler.py:103-129,186-205; distsampler.py module docstring):
+
+    - exchanged modes: each shard's ``previous`` is the pre-update all-gather
+      with only its *own* block post-update; the W2 gradient pairs the
+      shard's pre-update block against that full snapshot;
+    - ``partitions``: each shard snapshots the block it just updated, and the
+      next step pairs device ``b``'s block against the snapshot of block
+      ``(b+1) mod S`` (a ``lax.ppermute`` of the carried snapshots — the
+      device-side form of the host path's ``np.roll(previous, -1)``).
+
+    Gather implementation only: the exchanged-mode snapshot *is* the gathered
+    set, which the ring implementation exists to avoid materialising.
+
+    Returns ``step(block, prev, data, t, key, step_size, h, w_on) ->
+    (new_block, new_prev)`` where ``prev``/``new_prev`` carry a leading
+    length-1 axis (the per-shard slice of the global ``(S, ., d)`` snapshot
+    stack) and ``w_on`` is 0.0 on a first-ever step (reference: no W2 until a
+    previous snapshot exists, dsvgd/distsampler.py:186-188) and 1.0 after.
+    """
+    from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
+
+    core = _build_core(
+        logp, kernel, mode, num_shards, n_local_data, score_scale,
+        False, shard_data, batch_size, log_prior, phi_impl,
+    )
+    # prev_for[b] = previous[(b+1) % S]  (np.roll(prev, -1) device-side)
+    roll_perm = [(j, (j - 1) % num_shards) for j in range(num_shards)]
+
+    def step(block, prev, data, t, key, step_size, h, w_on):
+        prev = prev[0]
+        if mode == PARTITIONS and num_shards > 1:
+            prev_for = lax.ppermute(prev, AXIS, roll_perm)
+        else:
+            prev_for = prev
+        w_grad = w_on * wasserstein_grad_sinkhorn(
+            block, prev_for, eps=sinkhorn_eps, iters=sinkhorn_iters
+        )
+        delta, interacting = core(block, data, t, key)
+        new = block + step_size * (delta + h * w_grad)
+        if mode == PARTITIONS:
+            new_prev = new
+        else:
+            r = lax.axis_index(AXIS)
+            new_prev = lax.dynamic_update_slice_in_dim(
+                interacting, new, r * block.shape[0], axis=0
+            )
+        return new, new_prev[None]
 
     return step

@@ -303,15 +303,65 @@ def test_run_steps_equals_eager_make_step(
     )
 
 
-def test_run_steps_rejects_wasserstein():
+def test_run_steps_rejects_lp_wasserstein():
+    """The host-LP W2 path stays make_step-only."""
     rng = np.random.default_rng(2)
     particles, data, _ = make_gaussian_problem(rng, num_shards=2)
     ds = DistSampler(
         2, logreg_logp, None, jnp.asarray(particles), data=data,
-        include_wasserstein=True,
+        include_wasserstein=True, wasserstein_solver="lp",
     )
-    with pytest.raises(ValueError, match="include_wasserstein"):
+    with pytest.raises(ValueError, match="sinkhorn"):
         ds.run_steps(3, 0.05)
+    # ring impl is a no-op in partitions mode, so scanned W2 must accept it
+    ds2 = DistSampler(
+        2, logreg_logp, None, jnp.asarray(particles), data=data,
+        exchange_particles=False, exchange_scores=False,
+        include_wasserstein=True, wasserstein_solver="sinkhorn",
+        sinkhorn_iters=20, exchange_impl="ring",
+    )
+    out = ds2.run_steps(3, 0.05, h=0.5)
+    assert bool(jnp.isfinite(out).all())
+
+
+@pytest.mark.parametrize("name,exch_p,exch_s", MODES)
+def test_run_steps_wasserstein_matches_eager(name, exch_p, exch_s):
+    """Scanned Sinkhorn-W2 trajectories (previous snapshots carried on
+    device) equal the eager make_step path, including the no-W2 first step
+    and the per-mode snapshot warts."""
+    rng = np.random.default_rng(31)
+    S = 2
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, n_rows=8, num_shards=S)
+
+    def build():
+        return DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=exch_p, exchange_scores=exch_s,
+            include_wasserstein=True, wasserstein_solver="sinkhorn",
+            sinkhorn_eps=0.05, sinkhorn_iters=50,
+        )
+
+    eager = build()
+    for _ in range(4):
+        want = eager.make_step(0.05, h=0.5)
+    scanned = build()
+    got = scanned.run_steps(4, 0.05, h=0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-6)
+    np.testing.assert_allclose(
+        scanned._previous, eager._previous, rtol=2e-6, atol=1e-12
+    )
+    # mixing afterwards (scan → eager vs eager → eager) stays on-trajectory
+    np.testing.assert_allclose(
+        np.asarray(scanned.make_step(0.05, h=0.5)),
+        np.asarray(eager.make_step(0.05, h=0.5)),
+        rtol=2e-6,
+    )
+    # and eager → scan continues identically too
+    np.testing.assert_allclose(
+        np.asarray(scanned.run_steps(2, 0.05, h=0.5)),
+        np.asarray([eager.make_step(0.05, h=0.5) for _ in range(2)][-1]),
+        rtol=2e-6,
+    )
 
 
 def test_run_steps_record_matches_eager_history():
