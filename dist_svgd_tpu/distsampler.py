@@ -105,8 +105,9 @@ class DistSampler:
             ``logp`` is pure likelihood and the prior gradient is added once,
             unscaled (see ``parallel/exchange.py``).
         phi_impl: φ backend — ``'auto'`` (Pallas fused-tile φ on TPU with an
-            RBF kernel, XLA elsewhere), ``'xla'``, or ``'pallas'`` (force);
-            see :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
+            RBF kernel at Gram-bound sizes, XLA otherwise), ``'xla'``, or
+            ``'pallas'`` (force); see
+            :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
         seed: root PRNG seed for the per-step minibatch streams.
     """
 
@@ -274,6 +275,13 @@ class DistSampler:
     def _blocks(self, arr) -> np.ndarray:
         return np.asarray(arr).reshape(self._num_shards, self._particles_per_shard, self._d)
 
+    def _prev_shape(self) -> tuple:
+        """Shape of the Wasserstein ``previous`` snapshot stack (see the
+        state comment in ``__init__``)."""
+        if self._mode == PARTITIONS and self._num_shards > 1:
+            return (self._num_shards, self._particles_per_shard, self._d)
+        return (self._num_shards, self._num_particles, self._d)
+
     def _wasserstein_grad(self) -> jnp.ndarray:
         """Per-shard W2 gradient, stacked to global ``(n, d)``."""
         cur = self._blocks(self._particles)
@@ -343,10 +351,7 @@ class DistSampler:
         prev = state.get("previous")
         if prev is not None:
             prev = np.asarray(prev)
-            if self._mode == PARTITIONS and self._num_shards > 1:
-                want = (self._num_shards, self._particles_per_shard, self._d)
-            else:
-                want = (self._num_shards, self._num_particles, self._d)
+            want = self._prev_shape()
             if prev.shape != want:
                 raise ValueError(
                     f"checkpoint 'previous' snapshot {prev.shape} != expected "
@@ -488,15 +493,11 @@ class DistSampler:
 
             self._scan_cache[("w2", num_steps, record)] = run
 
-        if self._mode == PARTITIONS and self._num_shards > 1:
-            prev_shape = (self._num_shards, self._particles_per_shard, self._d)
-        else:
-            prev_shape = (self._num_shards, self._num_particles, self._d)
         have_prev = self._previous is not None
         prev0 = (
             jnp.asarray(self._previous, dtype=dtype)
             if have_prev
-            else jnp.zeros(prev_shape, dtype=dtype)
+            else jnp.zeros(self._prev_shape(), dtype=dtype)
         )
         out, prev_out, hist = run(
             self._particles,

@@ -240,14 +240,24 @@ def pallas_available() -> bool:
         return False
 
 
+#: In 'auto' mode, use the Pallas kernel only at/above this many pairwise
+#: interactions (k·m).  Below it the Gram tile pressure the kernel exists to
+#: relieve isn't the bottleneck and XLA's fusion wins (measured on a v5e:
+#: XLA 1.7 ms vs Pallas 2.4 ms at (500, 500, 753); Pallas ahead from ~2048²
+#: up — docs/notes.md).
+PALLAS_MIN_PAIRS = 1 << 22
+
+
 def resolve_phi_fn(kernel, phi_impl: str):
     """The framework-wide φ-backend policy, shared by ``Sampler``,
     ``DistSampler``, and ``parallel/exchange.py``.
 
     Returns ``phi_fn(updated, interacting, scores)``:
 
-    - ``'auto'``   — this Pallas kernel on TPU with an RBF kernel, the fused
-      XLA program (ops/svgd.py:phi) everywhere else;
+    - ``'auto'``   — on TPU with an RBF kernel, this Pallas kernel for
+      Gram-bound problem sizes (``k·m ≥ PALLAS_MIN_PAIRS``, a static
+      trace-time shape test) and the fused XLA program (ops/svgd.py:phi) for
+      small ones; plain XLA everywhere else;
     - ``'xla'``    — always the XLA program;
     - ``'pallas'`` — force this kernel (requires RBF); off-TPU it runs under
       the Pallas interpreter — slow but exact, for CPU testing.
@@ -258,7 +268,18 @@ def resolve_phi_fn(kernel, phi_impl: str):
         raise ValueError(f"unknown phi_impl {phi_impl!r}")
     on_tpu = pallas_available()
     if phi_impl == "auto":
-        phi_impl = "pallas" if on_tpu and isinstance(kernel, RBF) else "xla"
+        if on_tpu and isinstance(kernel, RBF):
+            from dist_svgd_tpu.ops.svgd import phi
+
+            bw = kernel.bandwidth
+
+            def auto_fn(y, x, s):
+                if y.shape[0] * x.shape[0] >= PALLAS_MIN_PAIRS:
+                    return phi_pallas(y, x, s, bandwidth=bw)
+                return phi(y, x, s, kernel)
+
+            return auto_fn
+        phi_impl = "xla"
     if phi_impl == "xla":
         from dist_svgd_tpu.ops.svgd import phi
 

@@ -57,9 +57,10 @@ class Sampler:
             factor scales the whole ``logp`` gradient — the reference's
             importance-scaling convention, which scales its prior term too
             (dsvgd/distsampler.py:96-99).
-        phi_impl: ``'auto'`` (Pallas fused-tile φ on TPU with an RBF kernel,
-            XLA elsewhere), ``'xla'``, or ``'pallas'`` (force; requires an
-            RBF kernel — see ops/pallas_svgd.py).
+        phi_impl: ``'auto'`` (Pallas fused-tile φ on TPU with an RBF kernel
+            at Gram-bound sizes, XLA otherwise — see
+            ``ops.pallas_svgd.resolve_phi_fn``), ``'xla'``, or ``'pallas'``
+            (force; requires an RBF kernel).
     """
 
     def __init__(
