@@ -1,0 +1,187 @@
+"""Isolate the single-chip vmap-emulation penalty of the sharded step.
+
+Round-1 measurement (docs/notes.md): the vmap-emulated 8-shard
+``all_particles`` config runs at ~3.7M up/s on the one real chip while the
+unsharded step runs ~7M up/s — same total FLOPs (each lane scores all n
+particles on 1/S of the data rows; the Gram work tiles to the same n² pairs).
+This script times hand-built variants of the step to find where the factor
+of ~2 goes.  Usage: ``python tools/profile_emulation.py [--iters 100]``.
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "experiments"))
+from paths import DATA_DIR  # noqa: F401  (bootstraps sys.path)
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dist_svgd_tpu.models.logreg import logreg_logp
+from dist_svgd_tpu.ops.kernels import RBF
+from dist_svgd_tpu.ops.pallas_svgd import phi_pallas, resolve_phi_fn
+from dist_svgd_tpu.ops.svgd import phi
+from dist_svgd_tpu.utils.datasets import load_benchmark
+from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+N = 10_000
+S = 8
+
+
+def timed_scan(step, particles, iters):
+    """One-dispatch scan timing: warm (compile) then time, fenced."""
+
+    @jax.jit
+    def run(p):
+        def body(parts, i):
+            return step(parts, i), None
+
+        out, _ = lax.scan(body, p, jnp.arange(iters))
+        return out
+
+    import numpy as np
+
+    np.asarray(run(particles))  # warm/compile; scalar-less but full fetch
+    t0 = time.perf_counter()
+    out = run(particles)
+    np.asarray(out)[0, 0]  # block_until_ready alone is not a reliable fence
+    wall = time.perf_counter() - t0
+    return N * iters / wall, wall
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=100)
+    args = ap.parse_args()
+
+    fold = load_benchmark("banana", 42)
+    x = jnp.asarray(fold.x_train)
+    t = jnp.asarray(fold.t_train.reshape(-1))
+    rows = (x.shape[0] // S) * S
+    x, t = x[:rows], t[:rows]
+    d = 1 + x.shape[1]
+    rows_per = rows // S
+    scale = float(S)  # N_global / N_local
+
+    P0 = init_particles_per_shard(0, N, d, S)
+    eps = jnp.float32(3e-3)
+    kernel = RBF(1.0)
+    phi_auto = resolve_phi_fn(kernel, "auto")
+
+    score_fn = jax.grad(logreg_logp, argnums=0)
+    batched_score = jax.vmap(score_fn, in_axes=(0, None))
+
+    # stacked per-lane data (S, rows_per, ...)
+    xs_stack = x.reshape(S, rows_per, -1)
+    ts_stack = t.reshape(S, rows_per)
+
+    results = {}
+
+    # A. unsharded global step (the 7M up/s reference point)
+    def step_unsharded(P, i):
+        scores = batched_score(P, (x, t))
+        return P + eps * phi_auto(P, P, scores)
+
+    results["A:unsharded"] = timed_scan(step_unsharded, P0, args.iters)
+    print("A:unsharded", results["A:unsharded"], flush=True)
+
+    # B. vmap-emulated all_particles (what DistSampler does today)
+    def lane_step(block, lane_data):
+        interacting = lax.all_gather(block, "sh", tiled=True)
+        scores = scale * batched_score(interacting, lane_data)
+        return block + eps * phi_auto(block, interacting, scores)
+
+    vstep = jax.vmap(lane_step, in_axes=(0, 0), axis_name="sh", axis_size=S)
+
+    def step_vmap(P, i):
+        blocks = P.reshape(S, N // S, d)
+        new = vstep(blocks, (xs_stack, ts_stack))
+        return new.reshape(N, d)
+
+    results["B:vmap_all_particles"] = timed_scan(step_vmap, P0, args.iters)
+    print("B:vmap_all_particles", results["B:vmap_all_particles"], flush=True)
+
+    # B2. same but force the XLA phi
+    phi_xla = lambda y, xx, s: phi(y, xx, s, kernel)
+
+    def lane_step_xla(block, lane_data):
+        interacting = lax.all_gather(block, "sh", tiled=True)
+        scores = scale * batched_score(interacting, lane_data)
+        return block + eps * phi_xla(block, interacting, scores)
+
+    vstep_xla = jax.vmap(lane_step_xla, in_axes=(0, 0), axis_name="sh", axis_size=S)
+
+    def step_vmap_xla(P, i):
+        return vstep_xla(P.reshape(S, N // S, d), (xs_stack, ts_stack)).reshape(N, d)
+
+    results["B2:vmap_xla_phi"] = timed_scan(step_vmap_xla, P0, args.iters)
+    print("B2:vmap_xla_phi", results["B2:vmap_xla_phi"], flush=True)
+
+    # C. specialized emulation: stacked scores + ONE phi_pallas over rows with
+    # per-lane score stacking folded into a single (n, d) xs per lane... not
+    # expressible as one call; instead unroll S phi calls (no vmap).
+    def step_unrolled(P, i):
+        scores_stack = jax.vmap(lambda dl: scale * batched_score(P, dl))(
+            (xs_stack, ts_stack)
+        )  # (S, N, d)
+        blocks = P.reshape(S, N // S, d)
+        outs = [
+            blocks[r] + eps * phi_auto(blocks[r], P, scores_stack[r])
+            for r in range(S)
+        ]
+        return jnp.concatenate(outs, axis=0)
+
+    results["C:unrolled_phi"] = timed_scan(step_unrolled, P0, args.iters)
+    print("C:unrolled_phi", results["C:unrolled_phi"], flush=True)
+
+    # D. vmap over lanes but scores computed once outside the vmap
+    def lane_phi(block, lane_scores, P):
+        return block + eps * phi_auto(block, P, lane_scores)
+
+    vphi = jax.vmap(lane_phi, in_axes=(0, 0, None))
+
+    def step_scores_outside(P, i):
+        scores_stack = jax.vmap(lambda dl: scale * batched_score(P, dl))(
+            (xs_stack, ts_stack)
+        )
+        blocks = P.reshape(S, N // S, d)
+        return vphi(blocks, scores_stack, P).reshape(N, d)
+
+    results["D:vmap_scores_outside"] = timed_scan(step_scores_outside, P0, args.iters)
+    print("D:vmap_scores_outside", results["D:vmap_scores_outside"], flush=True)
+
+    # E. all_scores emulation, specialized: psum == sum over lanes -> single
+    # global phi (identical to unsharded but with lane-sliced score compute)
+    def step_all_scores_special(P, i):
+        scores = jnp.sum(
+            jax.vmap(lambda dl: batched_score(P, dl))((xs_stack, ts_stack)), axis=0
+        )
+        return P + eps * phi_auto(P, P, scores)
+
+    results["E:all_scores_special"] = timed_scan(step_all_scores_special, P0, args.iters)
+    print("E:all_scores_special", results["E:all_scores_special"], flush=True)
+
+    # F. vmap all_particles with phi forced to a k-major-friendly pallas block
+    def lane_step_p128(block, lane_data):
+        interacting = lax.all_gather(block, "sh", tiled=True)
+        scores = scale * batched_score(interacting, lane_data)
+        return block + eps * phi_pallas(block, interacting, scores, block_k=1250 // 2)
+
+    vstep_p = jax.vmap(lane_step_p128, in_axes=(0, 0), axis_name="sh", axis_size=S)
+
+    def step_vmap_p(P, i):
+        return vstep_p(P.reshape(S, N // S, d), (xs_stack, ts_stack)).reshape(N, d)
+
+    results["F:vmap_pallas_bk625"] = timed_scan(step_vmap_p, P0, args.iters)
+    print("F:vmap_pallas_bk625", results["F:vmap_pallas_bk625"], flush=True)
+
+    print()
+    for k, (ups, wall) in results.items():
+        print(f"{k:28s} {ups/1e6:8.2f} M up/s   wall {wall:.3f}s")
+
+
+if __name__ == "__main__":
+    main()
