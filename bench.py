@@ -175,21 +175,30 @@ def main():
     sharded_ups = N_PARTICLES * n_iters / wall
 
     # --- context: single-device unsharded step ---------------------------
-    # seed varies per rep so the relay cannot serve a cached result for a
-    # repeated identical computation (docs/notes.md timing trap)
+    # reps chain through initial_particles so each run depends on the
+    # previous one's output (_timed_chain's precondition: no rep can be
+    # elided, overlapped, or served from a relay cache)
     logp = make_logreg_logp(fold.x_train, fold.t_train.reshape(-1))
-    sampler = dt.Sampler(d, logp)
-    seeds = iter(range(100))
-    run_one = lambda: sampler.run(
-        N_PARTICLES, n_iters, 3e-3, seed=next(seeds), record=False
-    )[0]
+
+    def chained_runner(sampler, n):
+        state = {"out": None}
+
+        def run_one():
+            state["out"] = sampler.run(
+                n, n_iters if n == N_PARTICLES else 500, 3e-3, seed=0,
+                record=False, initial_particles=state["out"],
+            )[0]
+            return state["out"]
+
+        return run_one
+
+    run_one = chained_runner(dt.Sampler(d, logp), N_PARTICLES)
     _fence(run_one())  # compile, untimed
     single_wall = _timed_chain(run_one)
     single_ups = N_PARTICLES * n_iters / single_wall
 
     # --- reference's exact headline config (50 particles, 500 iters) -----
-    sampler_small = dt.Sampler(d, logp)
-    small_run = lambda: sampler_small.run(50, 500, 3e-3, seed=next(seeds), record=False)[0]
+    small_run = chained_runner(dt.Sampler(d, logp), 50)
     _fence(small_run())
     small_wall = _timed_chain(small_run, reps=2)
 

@@ -15,9 +15,11 @@ Reference parity notes (SURVEY.md §7.4):
 
 - particles not divisible by ``num_shards`` are dropped, like
   dsvgd/distsampler.py:42-45; same for data rows (experiments/logreg.py:35).
-- the update is Jacobi (simultaneous) rather than the reference's in-place
-  Gauss–Seidel sweep — deliberate, documented deviation with the same fixed
-  point (SURVEY.md §3.2).
+- the default update is Jacobi (simultaneous) rather than the reference's
+  in-place Gauss–Seidel sweep — deliberate, documented deviation with the
+  same fixed point (SURVEY.md §3.2); ``update_rule='gauss_seidel'`` opts in
+  to the reference's literal distributed sweep for trajectory-level parity
+  verification.
 - the Wasserstein ``previous_particles`` snapshot reproduces the reference's
   exact (warty) semantics: in exchanged modes each rank's "previous" set is
   the all-gathered array with only *its own* block post-update
@@ -85,6 +87,14 @@ class DistSampler:
             (True, False) = ``all_particles``, (False, False) =
             ``partitions``.
         include_wasserstein: add the W2/JKO proximal term each step.
+        update_rule: ``'jacobi'`` (vectorised, TPU-native default) or
+            ``'gauss_seidel'`` — the reference's literal in-place distributed
+            sweep (dsvgd/distsampler.py:194-200), each shard sweeping its own
+            block inside its private view via ``lax.scan``; small-n parity
+            verification mode (see ``parallel/exchange.py:make_shard_step``).
+            Requires ``exchange_impl='gather'`` and no ``batch_size``; the
+            scanned W2 path (``run_steps`` with the Wasserstein term) stays
+            Jacobi-only — use :meth:`make_step` for GS+W2.
         wasserstein_solver: ``'lp'`` (host LP, exact reference parity) or
             ``'sinkhorn'`` (on-device entropic OT, jit-fused fast path).
         mesh: ``'auto'`` (build a real mesh if the host has ≥ S devices, else
@@ -123,6 +133,7 @@ class DistSampler:
         exchange_particles: bool = True,
         exchange_scores: bool = True,
         include_wasserstein: bool = True,
+        update_rule: str = "jacobi",
         wasserstein_solver: str = "lp",
         sinkhorn_eps: float = 0.05,
         sinkhorn_iters: int = 200,
@@ -143,8 +154,15 @@ class DistSampler:
             raise ValueError(f"unknown exchange_impl {exchange_impl!r}")
         if shard_data and not exchange_particles:
             raise ValueError("shard_data is unsupported in partitions mode")
+        if update_rule not in ("jacobi", "gauss_seidel"):
+            raise ValueError(f"unknown update_rule {update_rule!r}")
+        if update_rule == "gauss_seidel" and exchange_impl == "ring":
+            raise ValueError(
+                "update_rule='gauss_seidel' requires exchange_impl='gather'"
+            )
 
         self._num_shards = int(num_shards)
+        self._update_rule = update_rule
         self._logp = logp
         self._kernel = kernel if kernel is not None else RBF(1.0)
         self._exchange_particles = exchange_particles
@@ -210,6 +228,7 @@ class DistSampler:
             batch_size=batch_size,
             log_prior=log_prior,
             phi_impl=phi_impl,
+            update_rule=update_rule,
         )
         self._bound_step = bind_shard_fn(
             step,
@@ -400,6 +419,11 @@ class DistSampler:
                     "run_steps with the Wasserstein term requires "
                     "wasserstein_solver='sinkhorn' and exchange_impl='gather' "
                     "(the host-LP snapshot path is make_step-only)"
+                )
+            if self._update_rule != "jacobi":
+                raise ValueError(
+                    "run_steps with the Wasserstein term is Jacobi-only; "
+                    "drive update_rule='gauss_seidel' + W2 through make_step"
                 )
             return self._run_steps_w2(num_steps, step_size, h, record)
         dtype = self._particles.dtype

@@ -87,6 +87,26 @@ def _ring_perm(num_shards: int):
     return [(j, (j + 1) % num_shards) for j in range(num_shards)]
 
 
+def _shard_data_resolver(mode, num_shards, n_local_data, shard_data):
+    """Shared per-shard data resolution: ``resolve(data, t, r) -> data_local``.
+
+    Encodes the one place the ``partitions`` data-rank rotation lives
+    (block ``b`` at step ``t`` pairs with data slice ``(b + t) mod S`` — the
+    re-derivation of the reference's ring migration, module docstring), so
+    the Jacobi core and the Gauss–Seidel sweep cannot diverge on it.
+    """
+    def resolve(data, t, r):
+        if shard_data:
+            return data
+        if mode == PARTITIONS:
+            data_rank = (r + t.astype(r.dtype)) % num_shards
+        else:
+            data_rank = r
+        return _slice_data(data, data_rank * n_local_data, n_local_data)
+
+    return resolve
+
+
 def _ring_phi_local_scores(y_block, score_of, phi_fn, num_shards):
     """Single-pass ring φ with ``all_particles`` semantics: the visiting block
     is scored by *this* device's ``score_of`` (local data, importance-scaled,
@@ -163,6 +183,7 @@ def make_shard_step(
     batch_size: Optional[int] = None,
     log_prior: Optional[Callable] = None,
     phi_impl: str = "xla",
+    update_rule: str = "jacobi",
 ) -> Callable:
     """Build the per-shard SVGD step for one exchange strategy.
 
@@ -199,6 +220,16 @@ def make_shard_step(
             dsvgd/distsampler.py:96-99, and psum-multiplied in all_scores).
         phi_impl: φ backend — ``'auto'`` / ``'xla'`` / ``'pallas'``; see
             :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
+        update_rule: ``'jacobi'`` (vectorised, TPU-native default — all
+            kernels/scores at pre-update values) or ``'gauss_seidel'`` (the
+            reference's literal in-place sweep, dsvgd/distsampler.py:194-200:
+            each shard sweeps its own block *inside its local view*, particle
+            ``i+1`` seeing particle ``i``'s new value, with per-pair scores
+            re-evaluated fresh at current positions — except in ``all_scores``
+            mode, whose exchanged scores are frozen at their pre-update
+            all-reduced values for the whole step, reference :160-170).
+            ``lax.scan``-sequential, O(n_loc) score re-batches per step — for
+            small-n parity verification, not throughput.
 
     Returns:
         ``step(block, data, w_grad_block, t, key, step_size, h) -> new_block``
@@ -211,6 +242,13 @@ def make_shard_step(
         the reference does (dsvgd/distsampler.py:194-200).  ``t`` is the
         1-based step counter driving the ``partitions`` rotation.
     """
+    if update_rule == "gauss_seidel":
+        return _build_gs_step(
+            logp, kernel, mode, num_shards, n_local_data, score_scale,
+            ring, shard_data, batch_size, log_prior, phi_impl,
+        )
+    if update_rule != "jacobi":
+        raise ValueError(f"unknown update_rule {update_rule!r}")
     core = _build_core(
         logp, kernel, mode, num_shards, n_local_data, score_scale,
         ring, shard_data, batch_size, log_prior, phi_impl,
@@ -220,6 +258,79 @@ def make_shard_step(
         delta, _ = core(block, data, t, key)
         delta = delta + h * w_grad_block
         return block + step_size * delta
+
+    return step
+
+
+def _build_gs_step(
+    logp, kernel, mode, num_shards, n_local_data, score_scale,
+    ring, shard_data, batch_size, log_prior, phi_impl,
+):
+    """The literal Gauss–Seidel per-shard step (see ``make_shard_step``).
+
+    Matches the oracle's distributed-GS semantics (tests/_oracle.py,
+    reference dsvgd/distsampler.py:194-200): each shard holds a private view
+    (the gathered global set in exchanged modes, its own block in
+    ``partitions``), sweeps its owned rows in place, and commits only its own
+    block — other shards' rows in the view stay at pre-exchange values.
+    """
+    if mode not in MODES:
+        raise ValueError(f"unknown exchange mode {mode!r}")
+    if ring:
+        raise ValueError(
+            "update_rule='gauss_seidel' requires exchange_impl='gather' "
+            "(the sweep mutates a materialised local view)"
+        )
+    if batch_size is not None:
+        raise ValueError("minibatching supports only the jacobi update rule")
+    if shard_data and mode == PARTITIONS:
+        raise ValueError("shard_data is unsupported in partitions mode")
+
+    phi_fn = resolve_phi_fn(kernel, phi_impl)
+    score_fn = jax.grad(logp, argnums=0)
+    batched_score = jax.vmap(score_fn, in_axes=(0, None))
+    if log_prior is not None:
+        batched_prior = jax.vmap(jax.grad(log_prior))
+    else:
+        batched_prior = lambda thetas: jnp.zeros_like(thetas)
+
+    resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
+
+    def step(block, data, w_grad_block, t, key, step_size, h):
+        r = lax.axis_index(AXIS)
+        s = block.shape[0]
+        data_local = resolve_data(data, t, r)
+
+        if mode == PARTITIONS:
+            view = block
+            lo = jnp.zeros((), dtype=jnp.int32)
+        else:
+            view = lax.all_gather(block, AXIS, tiled=True)
+            lo = r.astype(jnp.int32) * s
+
+        if mode == ALL_SCORES:
+            # exchanged scores are frozen at pre-update values for the whole
+            # step (the all_reduce happens once, reference :160-170)
+            frozen = lax.psum(batched_score(view, data_local), AXIS)
+            frozen = frozen + batched_prior(view)
+
+        def body(v, i):
+            if mode == ALL_SCORES:
+                scores = frozen
+            else:
+                # fresh per-pair scores at *current* positions (the
+                # reference's _dlogp(xj)-per-pair, dsvgd/distsampler.py:96-99)
+                scores = score_scale * batched_score(v, data_local)
+                scores = scores + batched_prior(v)
+            y = lax.dynamic_slice_in_dim(v, lo + i, 1, axis=0)
+            delta = phi_fn(y, v, scores)[0] + h * w_grad_block[i]
+            v = lax.dynamic_update_slice_in_dim(
+                v, (y[0] + step_size * delta)[None], lo + i, axis=0
+            )
+            return v, None
+
+        view, _ = lax.scan(body, view, jnp.arange(s, dtype=jnp.int32))
+        return lax.dynamic_slice_in_dim(view, lo, s, axis=0)
 
     return step
 
@@ -250,16 +361,11 @@ def _build_core(
     else:
         batched_prior = lambda thetas: jnp.zeros_like(thetas)
 
+    resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
+
     def core(block, data, t, key):
         r = lax.axis_index(AXIS)
-        if shard_data:
-            data_local = data
-        else:
-            if mode == PARTITIONS:
-                data_rank = (r + t.astype(r.dtype)) % num_shards
-            else:
-                data_rank = r
-            data_local = _slice_data(data, data_rank * n_local_data, n_local_data)
+        data_local = resolve_data(data, t, r)
 
         # One minibatch per shard per step, shared across every use of this
         # shard's data within the step (keeps ring ≡ gather exactly).

@@ -103,6 +103,42 @@ def test_bnn_cli_writes_metrics():
     assert np.isfinite(metrics["test_rmse"])
 
 
+def test_logreg_convergence_reaches_sklearn_baseline():
+    """SURVEY.md §4's quantitative acceptance test (the convergence half of
+    the primary metric, reference experiments/logreg_plots.py:37-57): the
+    sharded sampler's ensemble posterior-predictive accuracy reaches the
+    sklearn LogisticRegression baseline − 0.01 within a fixed step budget —
+    the same target ``bench.py`` measures steps-to at the 10k-particle scale."""
+    import jax
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import ensemble_test_accuracy, logreg_logp
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    sklearn = pytest.importorskip("sklearn.linear_model")
+
+    fold = load_benchmark("banana", 42)
+    clf = sklearn.LogisticRegression()
+    clf.fit(fold.x_train, fold.t_train.reshape(-1))
+    baseline = float(clf.score(fold.x_test, fold.t_test.reshape(-1)))
+
+    d = 1 + fold.x_train.shape[1]
+    sampler = dt.DistSampler(
+        4, logreg_logp, None, init_particles_per_shard(0, 256, d, 4),
+        data=(jnp.asarray(fold.x_train), jnp.asarray(fold.t_train.reshape(-1))),
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False,
+    )
+    sampler.run_steps(200, 0.1)
+    acc = float(ensemble_test_accuracy(
+        sampler.particles, jnp.asarray(fold.x_test),
+        jnp.asarray(fold.t_test.reshape(-1)),
+    ))
+    assert acc >= baseline - 0.01, (acc, baseline)
+
+
 @pytest.mark.slow
 def test_gmm_experiment_writes_figure():
     # tiny config via import (same process would fight the conftest backend;

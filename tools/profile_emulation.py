@@ -31,8 +31,12 @@ N = 10_000
 S = 8
 
 
-def timed_scan(step, particles, iters):
-    """One-dispatch scan timing: warm (compile) then time, fenced."""
+def timed_scan(step, particles, iters, reps=3):
+    """Scan timing, bench.py protocol: warm (compile), then ``reps``
+    state-chained runs (each feeds the previous output) under one trailing
+    scalar fetch — ``block_until_ready`` through the tunnel is not a
+    reliable fence, and a single rep is exposed to the ±40% pool variance
+    this tool exists to control for."""
 
     @jax.jit
     def run(p):
@@ -44,11 +48,13 @@ def timed_scan(step, particles, iters):
 
     import numpy as np
 
-    np.asarray(run(particles))  # warm/compile; scalar-less but full fetch
+    np.asarray(run(particles))  # warm/compile, full fetch
     t0 = time.perf_counter()
-    out = run(particles)
-    np.asarray(out)[0, 0]  # block_until_ready alone is not a reliable fence
-    wall = time.perf_counter() - t0
+    out = particles
+    for _ in range(reps):
+        out = run(out)
+    np.asarray(out)[0, 0]
+    wall = (time.perf_counter() - t0) / reps
     return N * iters / wall, wall
 
 
