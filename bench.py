@@ -180,25 +180,25 @@ def main():
     # elided, overlapped, or served from a relay cache)
     logp = make_logreg_logp(fold.x_train, fold.t_train.reshape(-1))
 
-    def chained_runner(sampler, n):
+    def chained_runner(sampler, n, iters):
         state = {"out": None}
 
         def run_one():
             state["out"] = sampler.run(
-                n, n_iters if n == N_PARTICLES else 500, 3e-3, seed=0,
+                n, iters, 3e-3, seed=0,
                 record=False, initial_particles=state["out"],
             )[0]
             return state["out"]
 
         return run_one
 
-    run_one = chained_runner(dt.Sampler(d, logp), N_PARTICLES)
+    run_one = chained_runner(dt.Sampler(d, logp), N_PARTICLES, n_iters)
     _fence(run_one())  # compile, untimed
     single_wall = _timed_chain(run_one)
     single_ups = N_PARTICLES * n_iters / single_wall
 
     # --- reference's exact headline config (50 particles, 500 iters) -----
-    small_run = chained_runner(dt.Sampler(d, logp), 50)
+    small_run = chained_runner(dt.Sampler(d, logp), 50, 500)
     _fence(small_run())
     small_wall = _timed_chain(small_run, reps=2)
 

@@ -10,7 +10,11 @@ has fewer devices), the rendezvous env-var machinery disappears, and the
 as documented no-ops.
 
 Flag surface mirrors the reference CLI (experiments/logreg.py:105-118), plus
-``--backend {auto,tpu,cpu}`` per the BASELINE.json north star.
+``--backend {auto,tpu,cpu}`` per the BASELINE.json north star and
+``--wasserstein-solver {lp,sinkhorn}`` selecting between the exact-parity
+eager host-LP W2 path and the scanned on-device Sinkhorn path (whole
+trajectory per dispatch — the fast way to run the reference's flagship
+``--wasserstein`` sweep config).
 
 Per-shard outputs keep the reference's exact conventions: a pandas pickle
 ``shard-<rank>.pkl`` per shard with columns ``timestep``/``value``, snapshots
@@ -32,7 +36,16 @@ from logreg_plots import get_results_dir, make_plots
 from dist_svgd_tpu.utils.platform import select_backend
 
 
-def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, wasserstein):
+#: Steps per recorded ``run_steps`` dispatch.  Chunking bounds the device
+#: history buffer at (RECORD_CHUNK, n, d) instead of (niter, n, d) — at the
+#: 10k-particle scale a long --niter would otherwise hold the whole
+#: trajectory in HBM before the host copy — and caps the number of compiled
+#: scan programs at two (the chunk length plus one remainder length).
+RECORD_CHUNK = 500
+
+
+def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
+        wasserstein, wasserstein_solver="lp"):
     """One SPMD run over ``num_shards`` shards; writes per-shard pickles."""
     import jax.numpy as jnp
 
@@ -66,6 +79,7 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, w
         exchange_particles=exchange in ("all_particles", "all_scores"),
         exchange_scores=exchange == "all_scores",
         include_wasserstein=wasserstein,
+        wasserstein_solver=wasserstein_solver,
     )
 
     # history: reference records each rank's owned block before every step
@@ -81,16 +95,29 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, w
             b = sampler.owned_block_index(r, t)
             shard_blocks[r].append(global_now[b * per : (b + 1) * per])
 
-    if wasserstein:
-        # W2 snapshots are host-side bookkeeping — eager reference loop
+    if wasserstein and wasserstein_solver == "lp":
+        # host-LP W2 (exact reference parity) needs per-step host snapshots —
+        # eager reference loop, one dispatch per step
         for _ in range(niter):
             slice_snapshot(np.asarray(sampler.particles))
             sampler.make_step(stepsize, h=10.0)  # h=10 matches logreg.py:83
         slice_snapshot(np.asarray(sampler.particles))
     else:
-        # whole trajectory (with pre-update history) in one scanned dispatch
-        final, hist = sampler.run_steps(niter, stepsize, record=True)
-        snaps = np.concatenate([np.asarray(hist), np.asarray(final)[None]])
+        # whole trajectory (with pre-update history) in scanned dispatches —
+        # one per RECORD_CHUNK of steps; with --wasserstein-solver sinkhorn
+        # the W2 snapshot state rides the scan carry on device, so the
+        # reference's flagship --wasserstein sweep config runs at scan speed
+        # instead of ~15 ms of tunnel dispatch per step (docs/notes.md)
+        h = 10.0 if wasserstein else 1.0  # h inert when the term is off
+        chunks = []
+        final = sampler.particles  # niter=0: single t=0 snapshot, no dispatch
+        done = 0
+        while done < niter:
+            k = min(RECORD_CHUNK, niter - done)
+            final, hist = sampler.run_steps(k, stepsize, record=True, h=h)
+            chunks.append(np.asarray(hist))
+            done += k
+        snaps = np.concatenate(chunks + [np.asarray(final)[None]])
         for t in range(niter + 1):
             slice_snapshot(snaps[t], t)
 
@@ -120,6 +147,11 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, w
 @click.option("--exchange", type=click.Choice(["partitions", "all_particles", "all_scores"]),
               default="partitions")
 @click.option("--wasserstein/--no-wasserstein", default=False)
+@click.option("--wasserstein-solver", type=click.Choice(["lp", "sinkhorn"]),
+              default="lp",
+              help="W2 solver: 'lp' = host LP, exact reference parity, eager "
+                   "dispatch per step; 'sinkhorn' = on-device entropic OT, "
+                   "whole trajectory in scanned dispatches")
 @click.option("--master_addr", default="127.0.0.1", type=str,
               help="no-op under SPMD; kept for reference CLI compatibility")
 @click.option("--master_port", default=29500, type=int,
@@ -129,7 +161,7 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange, w
 @click.option("--plots/--no-plots", default=True)
 @click.pass_context
 def cli(ctx, dataset, fold, nproc, nparticles, niter, stepsize, exchange,
-        wasserstein, master_addr, master_port, backend, plots):
+        wasserstein, wasserstein_solver, master_addr, master_port, backend, plots):
     select_backend(backend)
     # normalise nproc=0 to a single shard up front so the results dir, the
     # run, and the plots all agree on the same config name
@@ -141,7 +173,8 @@ def cli(ctx, dataset, fold, nproc, nparticles, niter, stepsize, exchange,
         shutil.rmtree(results_dir)
     os.makedirs(results_dir)
 
-    run(nproc, dataset, fold, nparticles, niter, stepsize, exchange, wasserstein)
+    run(nproc, dataset, fold, nparticles, niter, stepsize, exchange,
+        wasserstein, wasserstein_solver)
 
     if plots:
         ctx.invoke(
