@@ -69,7 +69,10 @@ class DistSampler:
             This replaces the reference's per-rank closure
             ``lambda x: logp(rank, x)`` (experiments/logreg.py:68).
         kernel: kernel for :func:`dist_svgd_tpu.ops.svgd.phi`; ``None`` means
-            the reference's ``RBF(bandwidth=1)``.
+            the reference's ``RBF(bandwidth=1)``.  The string ``'median'``
+            resolves an RBF at the median-heuristic bandwidth of the initial
+            ``particles`` (:func:`~dist_svgd_tpu.ops.kernels.
+            median_bandwidth`) once, at construction.
         particles: ``(n, d)`` global initial particle array.  Truncated to
             ``S · (n // S)`` rows (reference drop policy).
         data: optional pytree of arrays with a common leading data axis.
@@ -164,6 +167,10 @@ class DistSampler:
         self._num_shards = int(num_shards)
         self._update_rule = update_rule
         self._logp = logp
+        if kernel == "median":
+            from dist_svgd_tpu.ops.kernels import median_bandwidth
+
+            kernel = RBF(float(median_bandwidth(jnp.asarray(particles))))
         self._kernel = kernel if kernel is not None else RBF(1.0)
         self._exchange_particles = exchange_particles
         self._exchange_scores = exchange_scores
@@ -401,6 +408,15 @@ class DistSampler:
         reference's history convention: the state *before* each step,
         experiments/logreg.py:78-87 — append ``final`` for the trailing
         post-update snapshot); otherwise returns the final particle array.
+
+        Compile-cost note: one scan program is compiled (and cached on this
+        sampler, never evicted) **per distinct** ``(num_steps, record)``
+        pair.  Callers that vary ``num_steps`` freely — coprime cadences,
+        adaptive loops — should decompose their schedule into a bounded set
+        of lengths (e.g. power-of-two chunks, at most log2(K) programs; see
+        ``experiments/covertype.py`` and ``experiments/logreg.py:
+        RECORD_CHUNK``) or they will pay a fresh multi-second compile for
+        every new length.
 
         With the Wasserstein/JKO term enabled the ``previous`` snapshots ride
         the scan carry on device (``parallel/exchange.py:

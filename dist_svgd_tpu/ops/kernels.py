@@ -82,14 +82,27 @@ class RBF:
         return hash(("RBF", self.bandwidth))
 
 
-def median_bandwidth(particles: jax.Array) -> jax.Array:
+#: Above this many particles, :func:`median_bandwidth` computes the median
+#: over an evenly-strided subsample (the O(n²) sort of all pairwise
+#: distances — 10⁸ entries at n=10k — costs more than the SVGD step it
+#: configures; a 4096-point strided subsample estimates the same median).
+MEDIAN_BANDWIDTH_MAX_POINTS = 4096
+
+
+def median_bandwidth(particles: jax.Array, max_points: int = MEDIAN_BANDWIDTH_MAX_POINTS) -> jax.Array:
     """Median heuristic ``h = med^2 / log(n + 1)`` (Liu & Wang 2016, eq. 13).
 
     Extension beyond the reference, which hard-codes bandwidth 1
-    (SURVEY.md §0); useful for the larger BASELINE.json configs.  Returns a
-    scalar ``jax.Array`` suitable for a dynamically-banded RBF via
-    ``RBF``-equivalent expressions inside a jitted step.
+    (SURVEY.md §0); useful for the larger BASELINE.json configs — samplers
+    accept ``kernel='median'`` to resolve this per run from the initial
+    particles.  Returns a scalar ``jax.Array``.  ``log(n + 1)`` uses the
+    *full* particle count even when the median itself is estimated on a
+    ``max_points`` subsample.
     """
+    full_n = particles.shape[0]
+    if full_n > max_points:
+        stride = -(-full_n // max_points)  # ceil: at most max_points rows
+        particles = particles[::stride]
     n = particles.shape[0]
     sq = squared_distances(particles, particles)
     # median over *pairwise* (off-diagonal) distances; the n zero diagonal
@@ -99,7 +112,7 @@ def median_bandwidth(particles: jax.Array) -> jax.Array:
     flat = jnp.sort(sq.reshape(-1))
     m = n * n - n  # count of finite (off-diagonal) entries
     med_sq = 0.5 * (flat[(m - 1) // 2] + flat[m // 2])
-    return med_sq / math.log(n + 1.0)
+    return med_sq / math.log(full_n + 1.0)
 
 
 def kernel_matrix(kernel: Callable, x: jax.Array, y: jax.Array) -> jax.Array:

@@ -71,12 +71,23 @@ def initialize(
         )
         return True
     except ValueError as e:
-        # no cluster to auto-detect (jax: "coordinator_address should be
-        # defined") — a plain single-process run
         if coordinator_address is not None:
             raise RuntimeError(
                 f"multi-host initialize({coordinator_address=}) failed: {e}"
             ) from e
+        # Only the no-cluster-to-auto-detect case (jax: "coordinator_address
+        # should be defined") may degrade to single-process; any other
+        # ValueError means a present-but-malformed cluster env, and running
+        # on would give every worker an independent exchange-free job with
+        # wrong results.
+        if "coordinator_address" not in str(e):
+            raise
+        warnings.warn(
+            f"jax.distributed found no cluster to auto-detect ({e}); "
+            "continuing single-process.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         return False
     except RuntimeError as e:
         # Only the "must be called before any JAX calls …" too-late case may

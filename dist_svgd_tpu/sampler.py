@@ -39,7 +39,12 @@ class Sampler:
             ``data`` is given the signature is ``logp(theta, data_batch)``
             instead.
         kernel: :class:`RBF` instance or scalar kernel callable; defaults to
-            the reference's ``RBF(bandwidth=1)``.
+            the reference's ``RBF(bandwidth=1)``.  The string ``'median'``
+            selects an RBF whose bandwidth is resolved **per run** from the
+            initial particles via the median heuristic
+            (:func:`~dist_svgd_tpu.ops.kernels.median_bandwidth`, Liu & Wang
+            2016 eq. 13) — each distinct resolved bandwidth compiles its own
+            scan program.
         update_rule: ``'jacobi'`` (vectorised, TPU-native default) or
             ``'gauss_seidel'`` (the reference's sequential in-place sweep via
             ``lax.scan``, for small-n parity — SURVEY.md §3.2).
@@ -82,6 +87,9 @@ class Sampler:
             raise ValueError("minibatching supports only the jacobi update rule")
         self._d = d
         self._logp = logp
+        self._median_kernel = kernel == "median"
+        if self._median_kernel:
+            kernel = RBF(1.0)  # placeholder until run() resolves the bandwidth
         self._kernel = kernel if kernel is not None else RBF(1.0)
         self._update_rule = update_rule
         self._data = None if data is None else jax.tree_util.tree_map(jnp.asarray, data)
@@ -103,6 +111,7 @@ class Sampler:
             # the gauss_seidel sweep never calls φ through self._phi, so a
             # forced pallas choice would silently no-op
             raise ValueError("phi_impl='pallas' requires update_rule='jacobi'")
+        self._phi_impl = phi_impl
         self._phi = resolve_phi_fn(self._kernel, phi_impl)
         if data is None:
             if log_prior is not None:
@@ -128,9 +137,22 @@ class Sampler:
             scores = scores + jax.vmap(jax.grad(self._log_prior))(parts)
         return scores
 
+    def _resolve_median_kernel(self, particles) -> None:
+        """``kernel='median'``: bind an RBF at the median-heuristic bandwidth
+        of this run's initial particles (idempotent per bandwidth — the
+        compile cache below is keyed by it)."""
+        from dist_svgd_tpu.ops.kernels import median_bandwidth
+        from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
+
+        h = float(median_bandwidth(particles))
+        if self._kernel != RBF(h):
+            self._kernel = RBF(h)
+            self._phi = resolve_phi_fn(self._kernel, self._phi_impl)
+
     def _run_fn(self, num_iter: int, record: bool):
         """Build (and cache) the jitted scan over `num_iter` steps."""
-        cache_key = (num_iter, record)
+        cache_key = (num_iter, record, self._kernel.bandwidth
+                     if isinstance(self._kernel, RBF) else None)
         if cache_key in self._compiled:
             return self._compiled[cache_key]
 
@@ -187,6 +209,8 @@ class Sampler:
             particles = jnp.asarray(initial_particles, dtype=dtype)
         else:
             particles = init_particles(as_key(seed), n, self._d, dtype=dtype or jnp.float32)
+        if self._median_kernel:
+            self._resolve_median_kernel(particles)
         run = self._run_fn(num_iter, record)
         final, hist = run(
             particles, jnp.asarray(step_size, dtype=particles.dtype), minibatch_key(seed)

@@ -32,7 +32,11 @@ from paths import DATA_DIR  # noqa: F401  (bootstraps sys.path)
 
 from dist_svgd_tpu.utils.platform import select_backend
 
-from bench import REFERENCE_BEST_UPDATES_PER_SEC  # single source of truth
+from bench import (  # single sources of truth
+    REFERENCE_BEST_UPDATES_PER_SEC,
+    _fence,
+    _timed_chain,
+)
 
 
 def _platform():
@@ -47,24 +51,30 @@ def _emulated(num_shards: int) -> bool:
     return len(jax.devices()) < num_shards
 
 
-def _time_sampler_run(sampler, n, iters, step_size):
-    """Warm up (compiles the scan for this iteration count), then time."""
-    sampler.run(n, iters, step_size, seed=0, record=False)[0].block_until_ready()
-    t0 = time.perf_counter()
-    final, _ = sampler.run(n, iters, step_size, seed=0, record=False)
-    final.block_until_ready()
-    return time.perf_counter() - t0
+def _time_sampler_run(sampler, n, iters, step_size, initial_particles=None):
+    """Warm up (compiles the scan for this iteration count), then time with
+    bench.py's protocol: state-chained reps (each run continues from the
+    previous output) under one trailing scalar fetch —
+    ``block_until_ready`` through the axon tunnel is not a reliable fence."""
+    state = {"out": initial_particles}
+
+    def run_one():
+        state["out"] = sampler.run(
+            n, iters, step_size, seed=0, record=False,
+            initial_particles=state["out"],
+        )[0]
+        return state["out"]
+
+    _fence(run_one())
+    return _timed_chain(run_one)
 
 
 def _time_dist_steps(sampler, iters, step_size):
     """Time the scanned K-step path (one dispatch — how the framework is
-    meant to be driven for throughput; ``DistSampler.run_steps``).  The
-    untimed first call compiles the length-``iters`` scan."""
-    sampler.run_steps(iters, step_size).block_until_ready()
-    t0 = time.perf_counter()
-    out = sampler.run_steps(iters, step_size)
-    out.block_until_ready()
-    return time.perf_counter() - t0
+    meant to be driven for throughput; ``DistSampler.run_steps``), bench.py
+    timing protocol (``run_steps`` is stateful, so reps chain naturally)."""
+    _fence(sampler.run_steps(iters, step_size))  # compile, untimed
+    return _timed_chain(lambda: sampler.run_steps(iters, step_size))
 
 
 def _result(config, n, iters, wall, **extra):
@@ -181,13 +191,8 @@ def bench_bnn(iters, n_particles=500, dataset="boston", batch_size=100):
         d, likelihood, data=(split.x_train, split.y_train),
         batch_size=min(batch_size, split.x_train.shape[0]), log_prior=prior,
     )
-    sampler.run(n_particles, iters, 1e-3, seed=0, record=False,
-                initial_particles=init)[0].block_until_ready()
-    t0 = time.perf_counter()
-    final, _ = sampler.run(n_particles, iters, 1e-3, seed=0, record=False,
-                           initial_particles=init)
-    final.block_until_ready()
-    wall = time.perf_counter() - t0
+    wall = _time_sampler_run(sampler, n_particles, iters, 1e-3,
+                             initial_particles=init)
     return _result(
         "5:bnn-uci-500p", n_particles, iters, wall,
         dataset=dataset, d=d, batch_size=batch_size,
@@ -196,6 +201,44 @@ def bench_bnn(iters, n_particles=500, dataset="boston", batch_size=100):
 
 # --------------------------------------------------------------------- #
 # World-size scaling table (the reference table's shape, notes.md:128-132)
+
+
+def scaling_table_10k(iters, world_sizes=(1, 2, 4, 8), n_particles=10_000):
+    """Compute-bound scaling curve: banana logreg at 10k particles in
+    ``partitions`` mode, world sizes 1/2/4/8.
+
+    This is the config where shards genuinely help even on one chip: the
+    ``partitions`` interaction set is the owned block (n/S particles), so the
+    per-step pair count is n²/S — the same mechanism behind the reference's
+    superlinear table (its per-pair inner loop shrank with S,
+    notes.md:120-135).  The ``all_*`` modes are work-conserving under
+    emulation (each shard still interacts with all n particles), hence flat;
+    on real multi-chip hardware they scale by dividing that constant total
+    work across chips."""
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import logreg_logp
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    fold = load_benchmark("banana", 42)
+    data = (jnp.asarray(fold.x_train), jnp.asarray(fold.t_train.reshape(-1)))
+    d = 1 + fold.x_train.shape[1]
+    rows = []
+    for ws in world_sizes:
+        particles = init_particles_per_shard(0, n_particles, d, ws)
+        sampler = dt.DistSampler(
+            ws, logreg_logp, None, particles, data=data,
+            exchange_particles=False, exchange_scores=False,
+            include_wasserstein=False,
+        )
+        wall = _time_dist_steps(sampler, iters, 3e-3)
+        rows.append(_result(
+            f"scaling10k:ws{ws}", sampler.num_particles, iters, wall,
+            num_shards=ws, emulated=_emulated(ws), exchange="partitions",
+        ))
+    return rows
 
 
 def scaling_table(iters, world_sizes=(1, 2, 4, 8), n_particles=50):
@@ -278,10 +321,13 @@ _CONFIGS = {
               help="also run the world-size scaling table")
 @click.option("--scaling-iters", default=500,
               help="iterations for the scaling table (reference used 500)")
+@click.option("--scaling-10k/--no-scaling-10k", default=False,
+              help="also run the compute-bound 10k-particle partitions-mode "
+                   "scaling table (docs/notes.md)")
 @click.option("--table", is_flag=True, help="print markdown tables at the end")
 @click.option("--backend", default="auto",
               type=click.Choice(["auto", "tpu", "cpu"]))
-def cli(configs, iters, scaling, scaling_iters, table, backend):
+def cli(configs, iters, scaling, scaling_iters, scaling_10k, table, backend):
     select_backend(backend)
     wanted = list(_CONFIGS) if configs == "all" else configs.split(",")
     results = []
@@ -297,6 +343,9 @@ def cli(configs, iters, scaling, scaling_iters, table, backend):
     if scaling:
         srows = scaling_table(scaling_iters)
         for r in srows:
+            print(json.dumps(r), flush=True)
+    if scaling_10k:
+        for r in scaling_table_10k(iters):
             print(json.dumps(r), flush=True)
     if table:
         print()

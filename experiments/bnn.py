@@ -26,7 +26,7 @@ from dist_svgd_tpu.utils.platform import select_backend
 
 def get_results_dir(
     dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size,
-    exchange, seed,
+    exchange, seed, bandwidth="1.0",
 ):
     """Config-encoded results dir — every CLI knob that changes the run is in
     the name, so sweep configurations never overwrite each other (reference
@@ -35,9 +35,28 @@ def get_results_dir(
         f"bnn-{dataset}-{split}-{nproc}-{nparticles}-{n_hidden}-{niter}-"
         f"{stepsize}-{batch_size}-{exchange}-{seed}"
     )
+    # suffix keyed on the *resolved* semantics (not the spelling), so
+    # --bandwidth 1 / 1.0 / 1.00 all land in the default dir
+    if bandwidth == "median" or float(bandwidth) != 1.0:
+        name += f"-h={bandwidth}"
     path = os.path.join(RESULTS_DIR, name)
     os.makedirs(path, exist_ok=True)
     return path
+
+
+def _resolve_kernel(bandwidth: str):
+    """CLI ``--bandwidth`` → sampler kernel arg: ``'median'`` (heuristic,
+    resolved from the initial particles — the sensible default for the d=753
+    weight-vector space where the reference's h=1 puts every pairwise kernel
+    value near exp(-d)), a float, or the reference's fixed 1.0 → ``None``."""
+    if bandwidth == "median":
+        return "median"
+    h = float(bandwidth)
+    if h == 1.0:
+        return None  # reference RBF(1)
+    from dist_svgd_tpu.ops.kernels import RBF
+
+    return RBF(h)
 
 
 def run(
@@ -51,6 +70,7 @@ def run(
     batch_size=100,
     exchange="all_particles",
     seed=0,
+    bandwidth="1.0",
 ):
     """Train; returns (final_particles, metrics dict)."""
     import jax
@@ -71,10 +91,13 @@ def run(
     likelihood, prior = bnn.make_bnn_split(n_features, n_hidden)
     batch = min(batch_size, x_tr.shape[0] // nproc) if batch_size else None
 
+    kernel = _resolve_kernel(bandwidth)
+
     t0 = time.perf_counter()
     if nproc == 1:
         sampler = dt.Sampler(
-            d, likelihood, data=(x_tr, y_tr), batch_size=batch, log_prior=prior
+            d, likelihood, kernel=kernel, data=(x_tr, y_tr), batch_size=batch,
+            log_prior=prior,
         )
         final, _ = sampler.run(
             n_used, niter, stepsize, seed=seed, record=False,
@@ -84,7 +107,7 @@ def run(
         sampler = dt.DistSampler(
             nproc,
             likelihood,
-            None,
+            kernel,
             particles,
             data=(x_tr, y_tr),
             exchange_particles=exchange in ("all_particles", "all_scores"),
@@ -121,6 +144,11 @@ def run(
         "stepsize": stepsize,
         "batch_size": batch,
         "exchange": exchange,
+        "bandwidth": bandwidth,
+        "resolved_bandwidth": (
+            sampler._kernel.bandwidth
+            if hasattr(sampler._kernel, "bandwidth") else None
+        ),
         "test_rmse": rmse,
         "test_loglik": ll,
         "wall_s": round(wall, 3),
@@ -142,17 +170,21 @@ def run(
 @click.option("--exchange", type=click.Choice(["all_particles", "all_scores"]),
               default="all_particles")
 @click.option("--seed", type=int, default=0)
+@click.option("--bandwidth", default="1.0",
+              help="RBF bandwidth: a float (reference default 1.0) or "
+                   "'median' for the per-run median heuristic — the better "
+                   "default at d=753 where h=1 collapses every kernel value")
 @click.option("--backend", type=click.Choice(["auto", "tpu", "cpu"]), default="auto")
 def cli(dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size,
-        exchange, seed, backend):
+        exchange, seed, bandwidth, backend):
     select_backend(backend)
     final, metrics = run(
         dataset, split, nproc, nparticles, n_hidden, niter, stepsize,
-        batch_size, exchange, seed,
+        batch_size, exchange, seed, bandwidth,
     )
     results_dir = get_results_dir(
         dataset, split, nproc, nparticles, n_hidden, niter, stepsize,
-        batch_size, exchange, seed,
+        batch_size, exchange, seed, bandwidth,
     )
     np.save(os.path.join(results_dir, "particles.npy"), final)
     with open(os.path.join(results_dir, "metrics.json"), "w") as fh:

@@ -150,30 +150,54 @@ def run(
             """First multiple of ``every`` strictly past step index ``i``."""
             return (i // every + 1) * every if every else niter
 
+        def schedule(i):
+            """The loop's dispatch decomposition, as data: everything up to
+            the next log/checkpoint event is batched into scanned
+            ``('chunk', k)`` dispatches; the event step itself is an eager
+            ``('event', i)`` so `prev` (the pre-step snapshot particle_stats
+            drifts against) keeps its exact per-step meaning.  Chunks are
+            powers of two: ``run_steps`` compiles one scan program per
+            distinct length, so coprime cadences (e.g. --log-every 10
+            --checkpoint-every 7) would otherwise compile a fresh
+            multi-second scan for every gap length; this bounds it at
+            log2(niter) programs total.  Single source of truth for both the
+            pre-compile warm-up and the timed loop."""
+            while i < niter:
+                event = min(niter, next_after(i, log_every),
+                            next_after(i, checkpoint_every))
+                gap = event - i - 1
+                while gap > 0:
+                    chunk = 1 << (gap.bit_length() - 1)
+                    yield ("chunk", chunk)
+                    i += chunk
+                    gap -= chunk
+                yield ("event", i)
+                i += 1
+
+        # Pre-compile every program the schedule will use (each distinct
+        # chunk length, plus the eager event step), so no multi-second XLA
+        # compile lands inside a timed lap; then restore the pre-warm-up
+        # state and start the clock fresh.
+        needed = {k for kind, k in schedule(start) if kind == "chunk"}
+        if start < niter:
+            state0 = sampler.state_dict()
+            for k in sorted(needed):
+                sampler.run_steps(k, stepsize)
+            sampler.make_step(stepsize)
+            sampler.load_state_dict(state0)
+
+        t0 = time.perf_counter()  # exclude setup + warm-up from metrics wall
         timer = StepTimer()
         last_logged = start  # first lap after a resume may span < log_every steps
         with JsonlLogger(
             path=metrics_path,
             stream=None if metrics_path or not log_every else sys.stdout,
         ) as logger, profiler_trace(profile_dir):
-            i = start
-            while i < niter:
-                # batch everything up to the next log/checkpoint event into
-                # scanned dispatches; the event step itself stays eager so
-                # `prev` (the pre-step snapshot particle_stats drifts against)
-                # keeps its exact per-step meaning.  Chunks are powers of two:
-                # run_steps compiles one scan program per distinct length, so
-                # coprime cadences (e.g. --log-every 10 --checkpoint-every 7)
-                # would otherwise compile a fresh multi-second scan for every
-                # gap length; this bounds it at log2(niter) programs total.
-                event = min(niter, next_after(i, log_every),
-                            next_after(i, checkpoint_every))
-                gap = event - i - 1
-                while gap > 0:
-                    chunk = 1 << (gap.bit_length() - 1)
-                    sampler.run_steps(chunk, stepsize)
-                    i += chunk
-                    gap -= chunk
+            for kind, val in schedule(start):
+                if kind == "chunk":
+                    sampler.run_steps(val, stepsize)
+                    continue
+                i = val
                 log_now = log_every and (i + 1) % log_every == 0
                 prev = sampler.particles if log_now else None
                 out = sampler.make_step(stepsize)

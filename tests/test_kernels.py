@@ -93,3 +93,45 @@ def test_median_bandwidth_excludes_diagonal_and_jits():
 def test_rbf_rejects_bad_bandwidth():
     with pytest.raises(ValueError):
         RBF(0.0)
+
+
+def test_median_bandwidth_subsample_estimates_full(rng):
+    """Above max_points the median is estimated on an evenly-strided
+    subsample, with log(n+1) still using the full count — the estimate must
+    land near the exact value on iid data."""
+    x = jnp.asarray(rng.normal(size=(600, 3)))
+    exact = float(median_bandwidth(x, max_points=600))
+    sub = float(median_bandwidth(x, max_points=128))
+    assert sub == pytest.approx(exact, rel=0.15)
+
+
+def test_sampler_median_kernel_equals_precomputed(rng):
+    """kernel='median' on Sampler resolves per run from the initial
+    particles and reproduces an explicit RBF(h) run bitwise."""
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+    from dist_svgd_tpu.utils.rng import as_key, init_particles
+
+    init = init_particles(as_key(3), 24, 1)
+    h = float(median_bandwidth(init))
+    assert h != 1.0
+    a = dt.Sampler(1, gmm_logp, kernel="median")
+    b = dt.Sampler(1, gmm_logp, kernel=RBF(h))
+    got, _ = a.run(24, 10, 0.3, seed=3, record=False)
+    want, _ = b.run(24, 10, 0.3, seed=3, record=False)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert a._kernel == RBF(h)
+
+
+def test_distsampler_median_kernel_resolves_at_construction(rng):
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+
+    parts = jnp.asarray(rng.normal(size=(16, 2)))
+    h = float(median_bandwidth(parts))
+    ds = dt.DistSampler(4, gmm_logp, "median", parts, include_wasserstein=False)
+    assert ds._kernel == RBF(h)
+    ref = dt.DistSampler(4, gmm_logp, RBF(h), parts, include_wasserstein=False)
+    np.testing.assert_array_equal(
+        np.asarray(ds.make_step(0.1)), np.asarray(ref.make_step(0.1))
+    )
