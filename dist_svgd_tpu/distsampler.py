@@ -355,34 +355,89 @@ class DistSampler:
     # ------------------------------------------------------------------ #
     # Checkpoint / resume (utils/checkpoint.py; SURVEY.md §5)
 
+    def _mesh_is_multiprocess(self) -> bool:
+        return self._mesh is not None and (
+            len({d.process_index for d in self._mesh.devices.flat}) > 1
+        )
+
     def state_dict(self) -> dict:
         """Resume state: particles, the Wasserstein ``previous`` snapshot, and
         the step counter (drives the ``partitions`` rotation *and* the
         per-step minibatch key fold).  Restoring via :meth:`load_state_dict`
-        continues the exact uninterrupted trajectory."""
-        return {
-            "particles": np.asarray(self._particles),
-            "previous": None if self._previous is None else np.asarray(self._previous),
+        continues the exact uninterrupted trajectory.
+
+        Multi-host: on a mesh spanning several processes the global arrays
+        are not fully addressable, so each process's dict holds only **its
+        own** contiguous row block (plus its ``*_start`` offset) — every
+        process must save to its own path and restore its own checkpoint
+        (``parallel/multihost.py:host_addressable_block``)."""
+        from dist_svgd_tpu.parallel.multihost import host_addressable_block
+
+        particles, p_start = host_addressable_block(self._particles)
+        state = {
+            "particles": particles,
+            "particles_start": np.asarray(p_start, dtype=np.int64),
             "t": np.asarray(self._t, dtype=np.int64),
         }
+        if self._previous is None:
+            state["previous"] = None
+        else:
+            prev, prev_start = host_addressable_block(self._previous)
+            state["previous"] = prev
+            state["previous_start"] = np.asarray(prev_start, dtype=np.int64)
+        return state
+
+    def _restore_global(self, name: str, rows: np.ndarray, ck_start: int,
+                        want: tuple):
+        """Rebuild a ``P(AXIS)``-sharded global array of shape ``want`` from
+        a checkpoint entry that is either the full array (single-process
+        save) or this process's block (per-process multi-host save)."""
+        from dist_svgd_tpu.parallel import multihost
+
+        if not self._mesh_is_multiprocess():
+            if rows.shape != want:
+                raise ValueError(
+                    f"checkpoint {name} {rows.shape} != sampler {want}"
+                )
+            return jnp.asarray(rows)
+        # only axis 0 is mesh-sharded, for every global array in this framework
+        start, count = multihost.process_local_rows(want[0], self._mesh)
+        local_shape = (count,) + want[1:]
+        if rows.shape == want and ck_start == 0:
+            local = rows[start : start + count]  # full save → slice our block
+        elif rows.shape == local_shape and ck_start == start:
+            local = rows
+        else:
+            raise ValueError(
+                f"checkpoint {name} {rows.shape} (start {ck_start}) matches "
+                f"neither the global {want} nor this process's block "
+                f"{local_shape} at row {start} — was it saved by a different "
+                "process or mesh layout?"
+            )
+        return multihost.make_global_from_local(local, self._mesh, want)
 
     def load_state_dict(self, state: dict) -> None:
-        particles = jnp.asarray(state["particles"])
-        if particles.shape != (self._num_particles, self._d):
-            raise ValueError(
-                f"checkpoint particles {particles.shape} != sampler "
-                f"{(self._num_particles, self._d)}"
-            )
-        self._particles = particles
+        self._particles = self._restore_global(
+            "particles",
+            np.asarray(state["particles"]),
+            int(state.get("particles_start", 0)),
+            (self._num_particles, self._d),
+        )
         prev = state.get("previous")
         if prev is not None:
-            prev = np.asarray(prev)
             want = self._prev_shape()
-            if prev.shape != want:
+            prev_arr = np.asarray(prev)
+            if self._mesh_is_multiprocess():
+                prev = self._restore_global(
+                    "previous", prev_arr, int(state.get("previous_start", 0)), want
+                )
+            elif prev_arr.shape != want:
                 raise ValueError(
-                    f"checkpoint 'previous' snapshot {prev.shape} != expected "
+                    f"checkpoint 'previous' snapshot {prev_arr.shape} != expected "
                     f"{want} (was it saved with a different num_shards?)"
                 )
+            else:
+                prev = prev_arr  # host array, as the eager LP path keeps it
         self._previous = prev
         self._t = int(state["t"])
 

@@ -267,6 +267,62 @@ def make_global_particles(
     )
 
 
+def host_addressable_block(arr, axis: int = 0) -> Tuple[np.ndarray, int]:
+    """``(rows, start)``: a host copy of this process's contiguous
+    addressable block of a global array along ``axis`` (the whole array and
+    ``start=0`` when it is fully addressable — numpy inputs included).
+
+    The checkpoint counterpart of :func:`make_global_particles`:
+    ``np.asarray`` on a multi-process global array raises (other processes'
+    shards are not addressable), so per-process state saving goes through
+    this instead (``DistSampler.state_dict``).
+    """
+    if not isinstance(arr, jax.Array) or arr.is_fully_addressable:
+        return np.asarray(arr), 0
+    spans = {}
+    for s in arr.addressable_shards:
+        sl = s.index[axis]
+        key = (sl.start or 0, sl.stop)
+        if key not in spans:  # replicated shards repeat the same span
+            spans[key] = s.data
+    ordered = sorted(spans)
+    start = ordered[0][0]
+    cur = start
+    for a, b in ordered:
+        if a != cur:
+            raise ValueError(
+                "this process's addressable shards are not one contiguous "
+                f"block along axis {axis} (spans {ordered}); build the mesh "
+                "with make_particle_mesh (granule-major ordering)"
+            )
+        cur = b
+    return (
+        np.concatenate([np.asarray(spans[k]) for k in ordered], axis=axis),
+        start,
+    )
+
+
+def make_global_from_local(
+    local, mesh: Mesh, global_shape: Tuple[int, ...]
+) -> jax.Array:
+    """Assemble a ``P(AXIS)``-sharded global array of ``global_shape`` from
+    this process's axis-0 block (``process_local_block`` tells which) —
+    :func:`make_global_particles` for arrays of any rank (e.g. the
+    Wasserstein ``previous`` snapshot stack)."""
+    local = np.asarray(local)
+    sharding = NamedSharding(mesh, P(AXIS))
+    if jax.process_count() == 1:
+        if local.shape != tuple(global_shape):
+            raise ValueError(
+                f"single-process local block {local.shape} != global "
+                f"{tuple(global_shape)}"
+            )
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, local, global_shape=tuple(global_shape)
+    )
+
+
 def replicate(value, mesh: Mesh) -> jax.Array:
     """Place a host value replicated on every chip of the mesh (the multi-host
     equivalent of the reference's every-rank-loads-the-full-dataset pattern,

@@ -42,6 +42,27 @@ def _to_numpy_tree(tree: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _use_orbax() -> bool:
+    """Orbax for single-process saves only.  Its PyTreeCheckpointer runs
+    cross-process barriers keyed by the checkpoint path; the framework's
+    multi-host contract is per-process state (each process saves its own
+    addressable block to its own path — ``DistSampler.state_dict``), where
+    those barriers deadlock until the coordination-service timeout.  The
+    plain ``.npz`` layout is the correct per-process backend."""
+    try:
+        import orbax.checkpoint  # noqa: F401
+    except ImportError:
+        return False
+    try:
+        import jax
+
+        return jax.process_count() == 1
+    except Exception:
+        # process count unknowable (partially-initialized/torn-down runtime):
+        # .npz works everywhere; orbax is only safe when provably single-process
+        return False
+
+
 def save_state(path: str, state: Dict[str, Any]) -> str:
     """Persist a flat dict of arrays/scalars (``None`` values are elided).
 
@@ -56,12 +77,12 @@ def save_state(path: str, state: Dict[str, Any]) -> str:
     tmp = path + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
-    try:
+    if _use_orbax():
         import orbax.checkpoint as ocp
 
         with ocp.PyTreeCheckpointer() as ckptr:
             ckptr.save(tmp, state)
-    except ImportError:
+    else:
         os.makedirs(tmp)
         np.savez(os.path.join(tmp, _NPZ_NAME), **state)
     if os.path.exists(path):

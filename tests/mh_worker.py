@@ -59,6 +59,39 @@ def main():
     np.save(os.path.join(outdir, f"rows_{rank}.npy"), rows)
     np.save(os.path.join(outdir, f"range_{rank}.npy"), np.array([start, count]))
 
+    # --- multi-host checkpoint/resume (VERDICT r1 item 7): save mid-run,
+    # restore into a FRESH sampler in this same federation, finish, and
+    # match the uninterrupted trajectory — with the W2 term on, so the
+    # non-fully-addressable `previous` snapshot stack round-trips too.
+    from dist_svgd_tpu.utils.checkpoint import load_state, save_state
+
+    def make_w2_sampler():
+        return dt.DistSampler(
+            mesh.size, lambda th, _: gmm_logp(th), None, particles,
+            exchange_particles=True, exchange_scores=True,
+            include_wasserstein=True, wasserstein_solver="sinkhorn",
+            sinkhorn_iters=50, mesh=mesh,
+        )
+
+    # One sampler plays both roles: run 3, checkpoint, run 2 more — its
+    # final state IS the uninterrupted trajectory (the save is read-only).
+    straight = make_w2_sampler()
+    straight.run_steps(3, 0.1, h=0.5)
+    ckpt = os.path.join(outdir, f"ckpt_rank{rank}")
+    # per-process path: each process persists only its own addressable block
+    save_state(ckpt, straight.state_dict())
+    straight.run_steps(2, 0.1, h=0.5)
+    want_rows, _ = multihost.host_addressable_block(straight.particles)
+
+    state = load_state(ckpt)
+    assert state["particles"].shape[0] == count, (
+        state["particles"].shape, count)
+    resumed = make_w2_sampler()
+    resumed.load_state_dict(state)
+    resumed.run_steps(2, 0.1, h=0.5)
+    got_rows, _ = multihost.host_addressable_block(resumed.particles)
+    np.testing.assert_allclose(got_rows, want_rows, rtol=1e-6, atol=1e-7)
+
 
 if __name__ == "__main__":
     main()

@@ -127,7 +127,7 @@ def test_two_process_federation_matches_oracle(tmp_path):
         for r in range(2)
     ]
     try:
-        logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+        logs = [p.communicate(timeout=540)[0].decode() for p in procs]
     finally:
         # a worker that crashed pre-rendezvous leaves its peer blocked in
         # initialize(); never leak it past the test
