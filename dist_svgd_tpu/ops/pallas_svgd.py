@@ -58,7 +58,7 @@ SMALL_D = 8
 def _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref, *,
               inv_h: float, m_true: int, nm: int):
     """Shared accumulator epilogue of both kernel variants."""
-    rowsum = jnp.sum(kt, axis=1, keepdims=True)  # (bk, 1)
+    rowsum = jnp.sum(kt.astype(jnp.float32), axis=1, keepdims=True)  # (bk, 1)
 
     @pl.when(j == 0)
     def _():
@@ -74,7 +74,8 @@ def _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref, *,
 
 
 def _phi_kernel(y_ref, x_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
-                inv_h: float, m_true: int, block_m: int, nm: int):
+                inv_h: float, m_true: int, block_m: int, nm: int,
+                bf16_gram: bool):
     """One (i, j) grid step: accumulate tile j's contribution to output tile i."""
     j = pl.program_id(1)
 
@@ -90,27 +91,36 @@ def _phi_kernel(y_ref, x_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
     x2 = jnp.sum(x * x, axis=1, keepdims=True)          # (bm, 1)
     yx = jnp.dot(y, x.T, preferred_element_type=jnp.float32,
                  precision=jax.lax.Precision.HIGHEST)   # (bk, bm) MXU
-    d2 = jnp.maximum(y2 + x2.T - 2.0 * yx, 0.0)
-    kt = jnp.exp(-d2 * inv_h)                           # (bk, bm)
+    neg = -jnp.maximum(y2 + x2.T - 2.0 * yx, 0.0) * inv_h
+    if bf16_gram:
+        kt = jnp.exp(neg.astype(jnp.bfloat16))          # (bk, bm)
+        xs = xs.astype(jnp.bfloat16)
+    else:
+        kt = jnp.exp(neg)
 
     # mask padded columns (static m_true ⇒ no SMEM scalar plumbing needed)
     col = jax.lax.broadcasted_iota(jnp.int32, kt.shape, dimension=1)
-    kt = jnp.where(col + j * block_m < m_true, kt, 0.0)
+    kt = jnp.where(col + j * block_m < m_true, kt, jnp.zeros((), kt.dtype))
 
-    contrib = jnp.dot(kt, xs, preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST)  # (bk, dp) MXU
+    contrib = _drive_dot(kt, xs, bf16_gram)  # (bk, dp) MXU
     _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref,
               inv_h=inv_h, m_true=m_true, nm=nm)
 
 
 def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
                         inv_h: float, m_true: int, d_true: int, block_m: int,
-                        nm: int):
+                        nm: int, bf16_gram: bool):
     """Small-d variant: distances as Σ_c (y_c − x_c)² via rank-1 VPU
     broadcasts (one ``(bk,1) − (1,bm)`` per feature dim, d ≤ :data:`SMALL_D`).
     Skips the 128-lane-padded distance matmul entirely — ~30% faster at the
     10k-particle d=3 north star on a v5e — and is *exact* f32: no
-    y²+x²−2·y·x cancellation, so no clamp is needed."""
+    y²+x²−2·y·x cancellation, so no clamp is needed.
+
+    ``bf16_gram``: evaluate the exp and the drive contraction in bfloat16
+    (distances stay f32; accumulators stay f32).  Measured 1.28× at the
+    north star at 4.4e-4 max error of max|φ| vs the f64 oracle — opt-in via
+    ``phi_pallas(gram_dtype=jnp.bfloat16)``.
+    """
     j = pl.program_id(1)
 
     y = y_ref[:]    # (bk, dp)
@@ -121,15 +131,29 @@ def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
     for c in range(d_true):  # static unroll
         diff = y[:, c:c + 1] - xT[c:c + 1, :]  # (bk, bm)
         d2 = diff * diff if d2 is None else d2 + diff * diff
-    kt = jnp.exp(-d2 * inv_h)
+    neg = -d2 * inv_h
+    if bf16_gram:
+        kt = jnp.exp(neg.astype(jnp.bfloat16))
+        xs = xs.astype(jnp.bfloat16)
+    else:
+        kt = jnp.exp(neg)
 
     col = jax.lax.broadcasted_iota(jnp.int32, kt.shape, dimension=1)
-    kt = jnp.where(col + j * block_m < m_true, kt, 0.0)
+    kt = jnp.where(col + j * block_m < m_true, kt, jnp.zeros((), kt.dtype))
 
-    contrib = jnp.dot(kt, xs, preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST)  # (bk, dp) MXU
+    contrib = _drive_dot(kt, xs, bf16_gram)  # (bk, dp) MXU
     _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref,
               inv_h=inv_h, m_true=m_true, nm=nm)
+
+
+def _drive_dot(kt, xs, bf16_gram: bool):
+    """MXU contraction Kᵗ·xs with f32 accumulation.  bf16 operands are
+    MXU-native; Mosaic rejects them with ``precision=HIGHEST`` (a f32
+    multi-pass request), so the precision override applies to f32 only."""
+    if bf16_gram:
+        return jnp.dot(kt, xs, preferred_element_type=jnp.float32)
+    return jnp.dot(kt, xs, preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
 
 
 def _pad_to(a: jax.Array, rows: int, cols: int) -> jax.Array:
@@ -137,7 +161,8 @@ def _pad_to(a: jax.Array, rows: int, cols: int) -> jax.Array:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("bandwidth", "block_k", "block_m", "interpret")
+    jax.jit,
+    static_argnames=("bandwidth", "block_k", "block_m", "interpret", "gram_dtype"),
 )
 def phi_pallas(
     updated: jax.Array,
@@ -147,6 +172,7 @@ def phi_pallas(
     block_k: Optional[int] = None,
     block_m: Optional[int] = None,
     interpret: bool = False,
+    gram_dtype=None,
 ) -> jax.Array:
     """Fused-tile φ̂* — drop-in for ``ops.svgd.phi(..., RBF(bandwidth))``.
 
@@ -156,11 +182,17 @@ def phi_pallas(
         scores: ``(m, d)`` scores for the interaction set.
         bandwidth: RBF bandwidth ``h`` (static).
         block_k / block_m: output/interaction tile sizes (static).  Default:
-            512×512 in the small-d variant (measured fastest at the
-            10k-particle config on a v5e), 256×256 in the big-d variant
-            (512-tiles of three (512, dp) f32 blocks plus scratch overflow
-            VMEM for large dp, where 256 fits).
+            1024×1024 in the small-d variant, 256×256 in the big-d variant
+            — the round-2 autotune sweep at the 10k-particle north star on a
+            v5e (docs/notes.md): 1024² runs 1.56 ms vs 2.0 ms at the old
+            512² default; 2048-wide k-tiles overflow VMEM.
         interpret: run under the Pallas interpreter (CPU testing).
+        gram_dtype: ``None`` (f32, exact — the default) or ``jnp.bfloat16``:
+            evaluate the Gram exp and the drive contraction in bf16
+            (distances and accumulators stay f32).  Measured at the north
+            star: 1.28× faster, max error 4.4e-4 of max|φ| vs the f64
+            oracle — opt-in for runs that tolerate stochastic-gradient-level
+            noise.
 
     Note: computation is float32 internally regardless of input dtype (the
     TPU MXU has no f64 path); float64 inputs are cast down and the result
@@ -170,10 +202,13 @@ def phi_pallas(
     k, d = updated.shape
     m = interacting.shape[0]
     in_dtype = updated.dtype
+    if gram_dtype is not None and gram_dtype != jnp.bfloat16:
+        raise ValueError("gram_dtype must be None (f32) or jnp.bfloat16")
+    bf16_gram = gram_dtype == jnp.bfloat16
 
-    default_block = 512 if d <= SMALL_D else 256
-    bk = min(block_k or default_block, _round_up(k, 8))
-    bm = min(block_m or default_block, _round_up(m, 8))
+    default_block = 1024 if d <= SMALL_D else 256
+    bk = min(block_k or _auto_block(k, default_block), _round_up(k, 8))
+    bm = min(block_m or _auto_block(m, default_block), _round_up(m, 8))
     kp, mp = _round_up(k, bk), _round_up(m, bm)
     dp = _round_up(d, 128)
     inv_h = 1.0 / float(bandwidth)
@@ -193,12 +228,14 @@ def phi_pallas(
         kern = functools.partial(
             _phi_kernel_small_d,
             inv_h=inv_h, m_true=m, d_true=d, block_m=bm, nm=nm,
+            bf16_gram=bf16_gram,
         )
         x_in = _pad_to(interacting.T.astype(f32), SMALL_D, mp)
         x_spec = pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem)
     else:
         kern = functools.partial(
             _phi_kernel, inv_h=inv_h, m_true=m, block_m=bm, nm=nm,
+            bf16_gram=bf16_gram,
         )
         x_in = _pad_to(interacting.astype(f32), mp, dp)
         x_spec = pl.BlockSpec((bm, dp), lambda i, j: (j, 0), **vmem)
@@ -231,6 +268,24 @@ def _round_up(v: int, mult: int) -> int:
     return ((v + mult - 1) // mult) * mult
 
 
+def _auto_block(n: int, default: int) -> int:
+    """Largest tile ≤ ``default`` that pads this axis ≤ ~10%.
+
+    Big tiles win at the north star (1024² measured 1.56 ms vs 2.0 ms at
+    512² — docs/notes.md), but zero-padding to the tile multiple is pure
+    waste: a vmap-emulated 8-shard lane has k = 1250, which a 1024 tile
+    pads to 2048 (64% dead work) while a 256 tile pads to 1280 (2.4%)."""
+    if n <= default:
+        # a single exact tile (the old behaviour): zero padding beyond the
+        # 8-row alignment — e.g. n=300 gets one 304-row tile, not 128-tiles
+        # padding to 384
+        return _round_up(n, 8)
+    b = default
+    while b > 128 and _round_up(n, b) > 1.1 * n + 8:
+        b //= 2
+    return b
+
+
 def pallas_available() -> bool:
     """True when the default backend is a TPU (the only platform this kernel
     is compiled for; elsewhere use ``interpret=True`` or the XLA path)."""
@@ -260,11 +315,15 @@ def resolve_phi_fn(kernel, phi_impl: str):
       small ones; plain XLA everywhere else;
     - ``'xla'``    — always the XLA program;
     - ``'pallas'`` — force this kernel (requires RBF); off-TPU it runs under
-      the Pallas interpreter — slow but exact, for CPU testing.
+      the Pallas interpreter — slow but exact, for CPU testing;
+    - ``'pallas_bf16'`` — this kernel with the bf16 Gram variant
+      (``gram_dtype=jnp.bfloat16``): ~1.3× faster at the north star at
+      ~4e-4 relative φ error (docs/notes.md) — opt-in, never chosen by
+      ``'auto'``.
     """
     from dist_svgd_tpu.ops.kernels import RBF
 
-    if phi_impl not in ("auto", "xla", "pallas"):
+    if phi_impl not in ("auto", "xla", "pallas", "pallas_bf16"):
         raise ValueError(f"unknown phi_impl {phi_impl!r}")
     on_tpu = pallas_available()
     if phi_impl == "auto":
@@ -285,7 +344,10 @@ def resolve_phi_fn(kernel, phi_impl: str):
 
         return lambda y, x, s: phi(y, x, s, kernel)
     if not isinstance(kernel, RBF):
-        raise ValueError("phi_impl='pallas' requires an RBF kernel")
+        raise ValueError(f"phi_impl={phi_impl!r} requires an RBF kernel")
     bw = kernel.bandwidth
     interp = not on_tpu
-    return lambda y, x, s: phi_pallas(y, x, s, bandwidth=bw, interpret=interp)
+    gd = jnp.bfloat16 if phi_impl == "pallas_bf16" else None
+    return lambda y, x, s: phi_pallas(
+        y, x, s, bandwidth=bw, interpret=interp, gram_dtype=gd
+    )

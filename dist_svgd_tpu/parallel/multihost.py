@@ -306,7 +306,8 @@ def make_global_from_local(
     local, mesh: Mesh, global_shape: Tuple[int, ...]
 ) -> jax.Array:
     """Assemble a ``P(AXIS)``-sharded global array of ``global_shape`` from
-    this process's axis-0 block (``process_local_block`` tells which) —
+    this process's axis-0 block (``process_local_rows(global_shape[0],
+    mesh)`` tells which) —
     :func:`make_global_particles` for arrays of any rank (e.g. the
     Wasserstein ``previous`` snapshot stack)."""
     local = np.asarray(local)

@@ -107,10 +107,10 @@ class Sampler:
 
         from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
 
-        if phi_impl == "pallas" and update_rule != "jacobi":
+        if phi_impl.startswith("pallas") and update_rule != "jacobi":
             # the gauss_seidel sweep never calls φ through self._phi, so a
             # forced pallas choice would silently no-op
-            raise ValueError("phi_impl='pallas' requires update_rule='jacobi'")
+            raise ValueError(f"phi_impl={phi_impl!r} requires update_rule='jacobi'")
         self._phi_impl = phi_impl
         self._phi = resolve_phi_fn(self._kernel, phi_impl)
         if data is None:

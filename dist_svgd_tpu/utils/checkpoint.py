@@ -8,9 +8,11 @@ state every K steps plus resume; this module provides exactly that.
 Design:
 
 - :func:`save_state` / :func:`load_state` persist an arbitrary pytree of
-  arrays via Orbax (``PyTreeCheckpointer``), falling back to a plain ``.npz``
-  when Orbax is unavailable — both layouts are self-describing and the loader
-  auto-detects which one is on disk.
+  arrays via Orbax (``PyTreeCheckpointer``) on provably single-process runs,
+  and via a plain ``.npz`` otherwise — multi-process runs save per-process
+  state to per-process paths, where Orbax's path-keyed cross-process
+  barriers would deadlock (:func:`_use_orbax`).  Both layouts are
+  self-describing and the loader auto-detects which one is on disk.
 - :class:`CheckpointManager` wraps the every-K-steps cadence with retention
   (keep the newest ``max_to_keep`` step dirs) and latest-step discovery.
 - ``DistSampler.state_dict()`` / ``.load_state_dict()`` (distsampler.py)
@@ -66,9 +68,11 @@ def _use_orbax() -> bool:
 def save_state(path: str, state: Dict[str, Any]) -> str:
     """Persist a flat dict of arrays/scalars (``None`` values are elided).
 
-    Uses Orbax when importable; ``.npz`` fallback otherwise.  ``path`` is a
-    directory; an existing checkpoint there is replaced atomically enough for
-    single-writer use (removed then rewritten).
+    Backend per :func:`_use_orbax`: Orbax on provably single-process runs,
+    ``.npz`` otherwise (multi-process per-path saves deadlock Orbax's
+    barriers).  ``path`` is a directory; an existing checkpoint there is
+    replaced atomically enough for single-writer use (removed then
+    rewritten).
     """
     state = _to_numpy_tree(state)
     path = os.path.abspath(path)

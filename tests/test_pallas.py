@@ -36,6 +36,25 @@ def test_phi_pallas_matches_xla(rng, k, m, d):
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize("k,m,d", [(50, 37, 3), (40, 60, 55)])
+def test_phi_pallas_bf16_gram_within_budget(rng, k, m, d):
+    """gram_dtype=bfloat16 (both kernel variants): φ stays within the bf16
+    error budget of the exact path (measured 4.4e-4 of max|φ| at the
+    10k-particle north star on a v5e — docs/notes.md)."""
+    y = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    s = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    h = float(2 * d)  # keep kernel values O(1): h=1 underflows at large d
+    want = np.asarray(phi(y, x, s, RBF(h)))
+    got = np.asarray(
+        phi_pallas(y, x, s, bandwidth=h, block_k=128, block_m=128,
+                   interpret=True, gram_dtype=jnp.bfloat16)
+    )
+    assert np.abs(got - want).max() <= 2e-2 * np.abs(want).max()
+    with pytest.raises(ValueError, match="gram_dtype"):
+        phi_pallas(y, x, s, interpret=True, gram_dtype=jnp.float16)
+
+
 def test_phi_pallas_nondefault_bandwidth(rng):
     y = jnp.asarray(rng.normal(size=(24, 4)), dtype=jnp.float32)
     x = jnp.asarray(rng.normal(size=(24, 4)), dtype=jnp.float32)

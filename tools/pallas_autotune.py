@@ -1,0 +1,221 @@
+"""Autotune + roofline measurement for the Pallas φ kernel (VERDICT r1 #5).
+
+Three measurements at the north-star shape (k, m, d) = (10k, 10k, 3):
+
+1. **Pure-exp roofline**: scan-chained elementwise ``exp`` throughput on the
+   VPU (f32 and bf16) — the φ step evaluates k·m exps, so this bounds any
+   implementation of the step.
+2. **Block-size sweep**: ``phi_pallas`` over (block_k, block_m) pairs, vs the
+   fused XLA φ, bench.py timing protocol (state-chained reps, scalar fetch).
+3. **bf16-Gram variant**: φ with the Gram tile cast to bf16 before the MXU
+   contraction — error budget vs the f64 numpy oracle and speed delta.
+
+Usage: ``python tools/pallas_autotune.py [--iters 50]``.
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "experiments"))
+from paths import DATA_DIR  # noqa: F401  (bootstraps sys.path)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dist_svgd_tpu.ops.kernels import RBF
+from dist_svgd_tpu.ops.pallas_svgd import phi_pallas
+from dist_svgd_tpu.ops.svgd import phi
+
+K = M = 10_000
+D = 3
+
+
+def timed(fn, x0, iters, reps=3):
+    """Chained scan timing with a trailing scalar fetch (bench.py protocol)."""
+
+    @jax.jit
+    def run(x):
+        def body(c, i):
+            return fn(c), None
+
+        out, _ = lax.scan(body, x, jnp.arange(iters))
+        return out
+
+    np.asarray(run(x0))
+    t0 = time.perf_counter()
+    out = x0
+    for _ in range(reps):
+        out = run(out)
+    np.asarray(out).ravel()[0]
+    return (time.perf_counter() - t0) / (reps * iters)
+
+
+def exp_roofline(iters):
+    """Elements/s of a bare chained exp on a (4096, 4096) tile."""
+    n = 4096
+    x = jnp.ones((n, n), jnp.float32)
+    t_f32 = timed(lambda c: jnp.exp(-c), x, iters)
+    t_bf16 = timed(lambda c: jnp.exp(-c), x.astype(jnp.bfloat16), iters)
+    print(f"exp roofline f32 : {n*n/t_f32/1e9:8.2f} G exp/s  ({t_f32*1e3:.3f} ms / {n}x{n})")
+    print(f"exp roofline bf16: {n*n/t_bf16/1e9:8.2f} G exp/s  ({t_bf16*1e3:.3f} ms / {n}x{n})")
+    return n * n / t_f32
+
+
+def sweep(y, x, s, iters):
+    results = {}
+    eps = jnp.float32(1e-6)
+
+    def make(fn):
+        # chain by feeding phi output back into the updated set
+        return lambda c: c + eps * fn(c)
+
+    t = timed(make(lambda c: phi(c, x, s, RBF(1.0))), y, iters)
+    results["xla"] = t
+    print(f"XLA fused φ                  : {t*1e3:7.3f} ms  ({K*M/t/1e9:6.1f} G pairs/s)", flush=True)
+
+    for bk in (256, 512, 1024, 2048):
+        for bm in (256, 512, 1024, 2048):
+            try:
+                t = timed(
+                    make(lambda c, bk=bk, bm=bm: phi_pallas(c, x, s, block_k=bk, block_m=bm)),
+                    y, iters,
+                )
+            except Exception as e:
+                print(f"pallas bk={bk:4d} bm={bm:4d}: FAILED {type(e).__name__}", flush=True)
+                continue
+            results[(bk, bm)] = t
+            print(f"pallas bk={bk:4d} bm={bm:4d}        : {t*1e3:7.3f} ms  ({K*M/t/1e9:6.1f} G pairs/s)", flush=True)
+    return results
+
+
+def _noexp_kernel(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
+                  d_true, block_m, m_true, nm):
+    """The small-d φ kernel with ``exp`` replaced by identity — identical
+    memory traffic, broadcasts, mask, and MXU contractions, so
+    (T_full − T_noexp) isolates the VPU exp cost."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    y = y_ref[:]
+    xT = xT_ref[:]
+    xs = xs_ref[:]
+    d2 = None
+    for c in range(d_true):
+        diff = y[:, c:c + 1] - xT[c:c + 1, :]
+        d2 = diff * diff if d2 is None else d2 + diff * diff
+    kt = -d2  # exp elided
+    col = jax.lax.broadcasted_iota(jnp.int32, kt.shape, dimension=1)
+    kt = jnp.where(col + j * block_m < m_true, kt, 0.0)
+    contrib = jnp.dot(kt, xs, preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+    rowsum = jnp.sum(kt, axis=1, keepdims=True)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        ksum_ref[:] = jnp.zeros_like(ksum_ref)
+
+    acc_ref[:] = acc_ref[:] + contrib
+    ksum_ref[:] = ksum_ref[:] + rowsum
+
+    @pl.when(j == nm - 1)
+    def _():
+        o_ref[:] = (acc_ref[:] + 2.0 * y * ksum_ref[:, :1]) / m_true
+
+
+def phi_noexp(y, x, s, bk, bm):
+    """pallas_call wrapper around :func:`_noexp_kernel` at the φ blocking."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from dist_svgd_tpu.ops.pallas_svgd import SMALL_D, _pad_to, _round_up
+
+    k, d = y.shape
+    m = x.shape[0]
+    kp, mp = _round_up(k, bk), _round_up(m, bm)
+    dp = 128
+    f32 = jnp.float32
+    yp = _pad_to(y.astype(f32), kp, dp)
+    xs = _pad_to(s.astype(f32) - 2.0 * x.astype(f32), mp, dp)
+    xT = _pad_to(x.T.astype(f32), SMALL_D, mp)
+    nk, nm = kp // bk, mp // bm
+    kern = functools.partial(_noexp_kernel, d_true=d, block_m=bm, m_true=m, nm=nm)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((kp, dp), f32),
+        grid=(nk, nm),
+        in_specs=[
+            pl.BlockSpec((bk, dp), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, dp), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bk, dp), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((bk, dp), f32), pltpu.VMEM((bk, 128), f32)],
+    )(yp, xT, xs)
+    return out[:k, :d]
+
+
+def f64_oracle_phi(y, x, s):
+    """Loopless f64 numpy φ for error budgets."""
+    y64, x64, s64 = (np.asarray(a, np.float64) for a in (y, x, s))
+    d2 = ((y64[:, None, :] - x64[None, :, :]) ** 2).sum(-1)
+    kt = np.exp(-d2)
+    drive = kt @ s64
+    repulse = 2.0 * (y64 * kt.sum(1)[:, None] - kt @ x64)
+    return (drive + repulse) / x64.shape[0]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--skip-sweep", action="store_true")
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    y = jnp.asarray(rng.normal(size=(K, D)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(M, D)), jnp.float32)
+    s = jnp.asarray(rng.normal(size=(M, D)), jnp.float32)
+
+    results = {}
+    if not args.skip_sweep:
+        exp_roofline(args.iters)
+        results = sweep(y, x, s, args.iters)
+
+    eps = jnp.float32(1e-6)
+    bk = bm = 1024
+    t_full = timed(lambda c: c + eps * phi_pallas(c, x, s, block_k=bk, block_m=bm),
+                   y, args.iters)
+    t_noexp = timed(lambda c: c + eps * phi_noexp(c, x, s, bk, bm), y, args.iters)
+    t_bf16 = timed(
+        lambda c: c + eps * phi_pallas(c, x, s, block_k=bk, block_m=bm,
+                                       gram_dtype=jnp.bfloat16),
+        y, args.iters,
+    )
+    print()
+    print(f"φ full f32  (1024²): {t_full*1e3:7.3f} ms  ({K*M/t_full/1e9:6.1f} G pairs/s)")
+    print(f"φ no-exp    (1024²): {t_noexp*1e3:7.3f} ms  → exp share ≈ "
+          f"{(t_full-t_noexp)/t_full*100:.0f}% of the step")
+    print(f"φ bf16-gram (1024²): {t_bf16*1e3:7.3f} ms  ({K*M/t_bf16/1e9:6.1f} G pairs/s, "
+          f"{t_full/t_bf16:.2f}x vs f32)")
+
+    # error budget vs the f64 oracle (on a subsample: the full 10k oracle is
+    # an (10k,10k,3) broadcast in numpy — slow but fine once)
+    sub = 2000
+    want = f64_oracle_phi(y[:sub], x, s)
+    got_f32 = np.asarray(phi_pallas(y[:sub], x, s, block_k=bk, block_m=bm))
+    got_bf16 = np.asarray(
+        phi_pallas(y[:sub], x, s, block_k=bk, block_m=bm, gram_dtype=jnp.bfloat16)
+    )
+    scale = np.abs(want).max()
+    print(f"max |φ_f32  − φ_f64| / max|φ| : {np.abs(got_f32 - want).max()/scale:.2e}")
+    print(f"max |φ_bf16 − φ_f64| / max|φ| : {np.abs(got_bf16 - want).max()/scale:.2e}")
+
+
+if __name__ == "__main__":
+    main()
