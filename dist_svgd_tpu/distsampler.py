@@ -118,9 +118,9 @@ class DistSampler:
             ``logp`` is pure likelihood and the prior gradient is added once,
             unscaled (see ``parallel/exchange.py``).
         phi_impl: φ backend — ``'auto'`` (Pallas fused-tile φ on TPU with an
-            RBF kernel at Gram-bound sizes, XLA otherwise), ``'xla'``, or
-            ``'pallas'`` (force); see
-            :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
+            RBF kernel at Gram-bound sizes, XLA otherwise), ``'xla'``,
+            ``'pallas'`` (force), or ``'pallas_bf16'`` (bf16-Gram variant);
+            see :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
         seed: root PRNG seed for the per-step minibatch streams.
     """
 

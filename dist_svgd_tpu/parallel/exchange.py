@@ -218,7 +218,8 @@ def make_shard_step(
             neither minibatch-amplified nor summed S times — unlike the
             reference, whose in-logp prior is importance-scaled,
             dsvgd/distsampler.py:96-99, and psum-multiplied in all_scores).
-        phi_impl: φ backend — ``'auto'`` / ``'xla'`` / ``'pallas'``; see
+        phi_impl: φ backend — ``'auto'`` / ``'xla'`` / ``'pallas'`` /
+            ``'pallas_bf16'``; see
             :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
         update_rule: ``'jacobi'`` (vectorised, TPU-native default — all
             kernels/scores at pre-update values) or ``'gauss_seidel'`` (the

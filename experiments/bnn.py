@@ -71,6 +71,7 @@ def run(
     exchange="all_particles",
     seed=0,
     bandwidth="1.0",
+    phi_impl="auto",
 ):
     """Train; returns (final_particles, metrics dict)."""
     import jax
@@ -97,7 +98,7 @@ def run(
     if nproc == 1:
         sampler = dt.Sampler(
             d, likelihood, kernel=kernel, data=(x_tr, y_tr), batch_size=batch,
-            log_prior=prior,
+            log_prior=prior, phi_impl=phi_impl,
         )
         final, _ = sampler.run(
             n_used, niter, stepsize, seed=seed, record=False,
@@ -115,6 +116,7 @@ def run(
             include_wasserstein=False,
             batch_size=batch,
             log_prior=prior,
+            phi_impl=phi_impl,
             seed=seed,
         )
         sampler.run_steps(niter, stepsize)  # one scanned dispatch
@@ -145,6 +147,7 @@ def run(
         "batch_size": batch,
         "exchange": exchange,
         "bandwidth": bandwidth,
+        "phi_impl": phi_impl,
         "resolved_bandwidth": (
             sampler._kernel.bandwidth
             if hasattr(sampler._kernel, "bandwidth") else None
@@ -175,12 +178,15 @@ def run(
                    "'median' for the per-run median heuristic — the better "
                    "default at d=753 where h=1 collapses every kernel value")
 @click.option("--backend", type=click.Choice(["auto", "tpu", "cpu"]), default="auto")
+@click.option("--phi-impl", type=click.Choice(["auto", "xla", "pallas", "pallas_bf16"]),
+              default="auto",
+              help="phi backend (ops/pallas_svgd.py:resolve_phi_fn)")
 def cli(dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size,
-        exchange, seed, bandwidth, backend):
+        exchange, seed, bandwidth, backend, phi_impl):
     select_backend(backend)
     final, metrics = run(
         dataset, split, nproc, nparticles, n_hidden, niter, stepsize,
-        batch_size, exchange, seed, bandwidth,
+        batch_size, exchange, seed, bandwidth, phi_impl,
     )
     results_dir = get_results_dir(
         dataset, split, nproc, nparticles, n_hidden, niter, stepsize,

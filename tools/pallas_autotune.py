@@ -182,10 +182,12 @@ def main():
     x = jnp.asarray(rng.normal(size=(M, D)), jnp.float32)
     s = jnp.asarray(rng.normal(size=(M, D)), jnp.float32)
 
-    results = {}
     if not args.skip_sweep:
         exp_roofline(args.iters)
         results = sweep(y, x, s, args.iters)
+        best = min(results, key=results.get)
+        print(f"\nbest: {best}  {results[best]*1e3:.3f} ms  "
+              f"(XLA/best ratio {results['xla']/results[best]:.2f}x)")
 
     eps = jnp.float32(1e-6)
     bk = bm = 1024
