@@ -26,7 +26,7 @@ from dist_svgd_tpu.utils.platform import select_backend
 
 def get_results_dir(
     dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size,
-    exchange, seed, bandwidth="1.0",
+    exchange, seed, bandwidth="1.0", phi_impl="auto",
 ):
     """Config-encoded results dir — every CLI knob that changes the run is in
     the name, so sweep configurations never overwrite each other (reference
@@ -39,6 +39,8 @@ def get_results_dir(
     # --bandwidth 1 / 1.0 / 1.00 all land in the default dir
     if bandwidth == "median" or float(bandwidth) != 1.0:
         name += f"-h={bandwidth}"
+    if phi_impl != "auto":
+        name += f"-phi={phi_impl}"
     path = os.path.join(RESULTS_DIR, name)
     os.makedirs(path, exist_ok=True)
     return path
@@ -190,7 +192,7 @@ def cli(dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size
     )
     results_dir = get_results_dir(
         dataset, split, nproc, nparticles, n_hidden, niter, stepsize,
-        batch_size, exchange, seed, bandwidth,
+        batch_size, exchange, seed, bandwidth, phi_impl,
     )
     np.save(os.path.join(results_dir, "particles.npy"), final)
     with open(os.path.join(results_dir, "metrics.json"), "w") as fh:

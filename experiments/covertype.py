@@ -29,12 +29,18 @@ from dist_svgd_tpu.utils.platform import select_backend
 
 
 def get_results_dir(
-    nrows, nproc, nparticles, niter, stepsize, batch_size, exchange, shard_data, seed
+    nrows, nproc, nparticles, niter, stepsize, batch_size, exchange, shard_data,
+    seed, phi_impl="auto",
 ):
+    """Every run-changing CLI knob is in the name, so configurations never
+    share results or checkpoints; non-default-only suffixes keep
+    pre-existing names stable."""
     name = (
         f"covertype-{nrows}-{nproc}-{nparticles}-{niter}-{stepsize}-"
         f"{batch_size}-{exchange}-{'shard' if shard_data else 'repl'}-{seed}"
     )
+    if phi_impl != "auto":
+        name += f"-phi={phi_impl}"
     path = os.path.join(RESULTS_DIR, name)
     os.makedirs(path, exist_ok=True)
     return path
@@ -130,7 +136,7 @@ def run(
             if checkpoint_dir is None:
                 checkpoint_dir = get_results_dir(
                     nrows, nproc, nparticles, niter, stepsize, batch_size,
-                    exchange, shard_data, seed,
+                    exchange, shard_data, seed, phi_impl,
                 ) + "-ckpt"
             # every=0 with resume means restore-only (no new checkpoints)
             mgr = CheckpointManager(checkpoint_dir, every=checkpoint_every or max(niter, 1))
@@ -276,7 +282,7 @@ def cli(nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
     select_backend(backend)
     results_dir = get_results_dir(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
-        shard_data, seed,
+        shard_data, seed, phi_impl,
     )
     ckpt_dir = results_dir + "-ckpt" if checkpoint_every else None
     final, metrics = run(

@@ -45,7 +45,7 @@ RECORD_CHUNK = 500
 
 
 def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
-        wasserstein, wasserstein_solver="lp"):
+        wasserstein, wasserstein_solver="lp", update_rule="jacobi"):
     """One SPMD run over ``num_shards`` shards; writes per-shard pickles."""
     import jax.numpy as jnp
 
@@ -80,6 +80,7 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
         exchange_scores=exchange == "all_scores",
         include_wasserstein=wasserstein,
         wasserstein_solver=wasserstein_solver,
+        update_rule=update_rule,
     )
 
     # history: reference records each rank's owned block before every step
@@ -95,7 +96,7 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
             b = sampler.owned_block_index(r, t)
             shard_blocks[r].append(global_now[b * per : (b + 1) * per])
 
-    if wasserstein and wasserstein_solver == "lp":
+    if wasserstein and (wasserstein_solver == "lp" or update_rule != "jacobi"):
         # host-LP W2 (exact reference parity) needs per-step host snapshots —
         # eager reference loop, one dispatch per step
         for _ in range(niter):
@@ -122,7 +123,8 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
             slice_snapshot(snaps[t], t)
 
     results_dir = get_results_dir(
-        dataset_name, fold, num_shards, nparticles, stepsize, exchange, wasserstein
+        dataset_name, fold, num_shards, nparticles, stepsize, exchange,
+        wasserstein, update_rule,
     )
     for r in range(num_shards):
         rows = [
@@ -147,6 +149,11 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
 @click.option("--exchange", type=click.Choice(["partitions", "all_particles", "all_scores"]),
               default="partitions")
 @click.option("--wasserstein/--no-wasserstein", default=False)
+@click.option("--update-rule", type=click.Choice(["jacobi", "gauss_seidel"]),
+              default="jacobi",
+              help="jacobi = vectorised TPU-native update; gauss_seidel = "
+                   "the reference's literal in-place sweep (exact reference "
+                   "trajectories, small-n verification speed)")
 @click.option("--wasserstein-solver", type=click.Choice(["lp", "sinkhorn"]),
               default="lp",
               help="W2 solver: 'lp' = host LP, exact reference parity, eager "
@@ -161,26 +168,28 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
 @click.option("--plots/--no-plots", default=True)
 @click.pass_context
 def cli(ctx, dataset, fold, nproc, nparticles, niter, stepsize, exchange,
-        wasserstein, wasserstein_solver, master_addr, master_port, backend, plots):
+        wasserstein, update_rule, wasserstein_solver, master_addr, master_port,
+        backend, plots):
     select_backend(backend)
     # normalise nproc=0 to a single shard up front so the results dir, the
     # run, and the plots all agree on the same config name
     nproc = max(nproc, 1)
 
     # clean out any previous results (reference behaviour, logreg.py:120-124)
-    results_dir = get_results_dir(dataset, fold, nproc, nparticles, stepsize, exchange, wasserstein)
+    results_dir = get_results_dir(dataset, fold, nproc, nparticles, stepsize,
+                                  exchange, wasserstein, update_rule)
     if os.path.isdir(results_dir):
         shutil.rmtree(results_dir)
     os.makedirs(results_dir)
 
     run(nproc, dataset, fold, nparticles, niter, stepsize, exchange,
-        wasserstein, wasserstein_solver)
+        wasserstein, wasserstein_solver, update_rule)
 
     if plots:
         ctx.invoke(
             make_plots, dataset=dataset, fold=fold, nproc=nproc,
             nparticles=nparticles, stepsize=stepsize, exchange=exchange,
-            wasserstein=wasserstein,
+            wasserstein=wasserstein, update_rule=update_rule,
         )
 
 

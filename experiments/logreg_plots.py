@@ -32,12 +32,18 @@ from dist_svgd_tpu.utils.datasets import load_benchmark
 TIMESTEPS_BETWEEN_KDE_PLOTS = 10
 
 
-def get_results_dir(dataset_name, fold, nproc, nparticles, stepsize, exchange, wasserstein):
+def get_results_dir(dataset_name, fold, nproc, nparticles, stepsize, exchange,
+                    wasserstein, update_rule="jacobi"):
     """Config-encoded results dir — exact reference naming
-    (logreg_plots.py:19-22)."""
+    (logreg_plots.py:19-22).  The non-reference ``update_rule`` knob is
+    appended only when non-default, so reference-config names stay
+    byte-identical while a gauss_seidel verification run never collides
+    with its jacobi counterpart."""
     subdir = "logreg_{}_{}-nshards={}-nparticles={}-exchange={}-wasserstein={}-stepsize={:.0e}".format(
         dataset_name, fold, nproc, nparticles, exchange, wasserstein, stepsize
     )
+    if update_rule != "jacobi":
+        subdir += f"-update_rule={update_rule}"
     return os.path.join(RESULTS_DIR, subdir)
 
 
@@ -144,10 +150,14 @@ def plot_alpha_hist(df, plot_title, out_dir):
 @click.option("--exchange", type=click.Choice(["partitions", "all_particles", "all_scores"]),
               default="partitions")
 @click.option("--wasserstein/--no-wasserstein", default=False)
-def make_plots(dataset, fold, nproc, nparticles, stepsize, exchange, wasserstein, **kwargs):
+@click.option("--update-rule", type=click.Choice(["jacobi", "gauss_seidel"]),
+              default="jacobi")
+def make_plots(dataset, fold, nproc, nparticles, stepsize, exchange, wasserstein,
+               update_rule="jacobi", **kwargs):
     """Aggregate shard-*.pkl results and write evaluation PNGs
     (reference make_plots, logreg_plots.py:95-124)."""
-    results_dir = get_results_dir(dataset, fold, nproc, nparticles, stepsize, exchange, wasserstein)
+    results_dir = get_results_dir(dataset, fold, nproc, nparticles, stepsize,
+                                  exchange, wasserstein, update_rule)
     df = pd.concat(map(pd.read_pickle, glob(os.path.join(results_dir, "shard-*.pkl"))))
 
     cfg = "logreg_{}_{} {} nshards={} nparticles={} exchange={} wasserstein={} stepsize={:.0e}".format(

@@ -55,6 +55,24 @@ def test_phi_pallas_bf16_gram_within_budget(rng, k, m, d):
         phi_pallas(y, x, s, interpret=True, gram_dtype=jnp.float16)
 
 
+def test_auto_block_padding_contract():
+    """Default tile selection: a single exact tile below the default size
+    (zero padding beyond 8-row alignment), halved tiles above it until the
+    zero-padding is ~<=10% (docs/notes.md: a 1024 tile pads a k=1250
+    vmap-emulated shard lane 64%, measured as a 5.1M vs 7.4M up/s headline
+    regression)."""
+    from dist_svgd_tpu.ops.pallas_svgd import _auto_block, _round_up
+
+    assert _auto_block(300, 1024) == 304   # single exact tile
+    assert _auto_block(1024, 1024) == 1024
+    assert _auto_block(1250, 1024) == 256  # 1280 rows (2.4%), not 2048 (64%)
+    assert _auto_block(10_000, 1024) == 1024
+    for n in (8, 129, 300, 460, 1030, 1250, 4097, 10_000):
+        b = _auto_block(n, 1024)
+        padded = _round_up(n, min(b, _round_up(n, 8)))
+        assert padded <= 1.15 * n + 8, (n, b, padded)
+
+
 def test_phi_pallas_nondefault_bandwidth(rng):
     y = jnp.asarray(rng.normal(size=(24, 4)), dtype=jnp.float32)
     x = jnp.asarray(rng.normal(size=(24, 4)), dtype=jnp.float32)

@@ -10,7 +10,11 @@ interpreter tests (tests/test_pallas.py) cover the math; this script covers
 the Mosaic compile and real-grid semantics of both kernel variants (d=3 →
 small-d broadcast distances, d=16/55 → the matmul form).
 """
+import sys
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import numpy as np
 import jax
