@@ -25,8 +25,11 @@ reference models); larger d uses the classic ``y²+x²−2·y·x`` MXU form.
 The grid is ``(k/bk, m/bm)`` with the m-axis innermost; per output tile the
 two accumulators (φ partial sum and Gram row-sum) live in VMEM scratch, which
 persists across the sequentially-executed grid steps (standard TPU
-accumulation pattern).  Ragged edges are handled by zero-padding plus an
-in-kernel column-validity mask computed from the *static* true ``m``.
+accumulation pattern).  Ragged edges: the big-d variant zero-pads and masks
+padded columns in-kernel from the *static* true ``m``; the small-d variant
+instead pads interaction columns with the :data:`_FAR` sentinel, whose
+(clamped) squared distance saturates the exp to an exact zero — no mask
+arithmetic on any tile.
 
 CPU/testing: ``interpret=True`` runs the same kernel under the Pallas
 interpreter — used by tests/test_pallas.py to check bit-level agreement with
@@ -108,7 +111,7 @@ def _phi_kernel(y_ref, x_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
 
 
 def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
-                        inv_h: float, m_true: int, d_true: int, block_m: int,
+                        inv_h: float, m_true: int, d_true: int,
                         nm: int, bf16_gram: bool):
     """Small-d variant: distances as Σ_c (y_c − x_c)² via rank-1 VPU
     broadcasts (one ``(bk,1) − (1,bm)`` per feature dim, d ≤ :data:`SMALL_D`).
@@ -120,6 +123,11 @@ def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
     (distances stay f32; accumulators stay f32).  Measured 1.28× at the
     north star at 4.4e-4 max error of max|φ| vs the f64 oracle — opt-in via
     ``phi_pallas(gram_dtype=jnp.bfloat16)``.
+
+    No in-kernel column mask: padded interaction columns hold the
+    :data:`_FAR` sentinel, whose squared distance saturates the exp to an
+    exact zero — the VPU iota/compare/select of the masked form is dead
+    weight on every non-edge tile.
     """
     j = pl.program_id(1)
 
@@ -131,15 +139,15 @@ def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
     for c in range(d_true):  # static unroll
         diff = y[:, c:c + 1] - xT[c:c + 1, :]  # (bk, bm)
         d2 = diff * diff if d2 is None else d2 + diff * diff
-    neg = -d2 * inv_h
+    # cap the sentinel columns' distance so no inf/nan can reach the exp or
+    # the bf16 cast regardless of d and bandwidth (real distances are
+    # untouched: the cap is ~1e30)
+    neg = -jnp.minimum(d2, _D2_CAP) * inv_h
     if bf16_gram:
         kt = jnp.exp(neg.astype(jnp.bfloat16))
         xs = xs.astype(jnp.bfloat16)
     else:
         kt = jnp.exp(neg)
-
-    col = jax.lax.broadcasted_iota(jnp.int32, kt.shape, dimension=1)
-    kt = jnp.where(col + j * block_m < m_true, kt, jnp.zeros((), kt.dtype))
 
     contrib = _drive_dot(kt, xs, bf16_gram)  # (bk, dp) MXU
     _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref,
@@ -156,8 +164,25 @@ def _drive_dot(kt, xs, bf16_gram: bool):
                    precision=jax.lax.Precision.HIGHEST)
 
 
-def _pad_to(a: jax.Array, rows: int, cols: int) -> jax.Array:
-    return jnp.pad(a, ((0, rows - a.shape[0]), (0, cols - a.shape[1])))
+#: Sentinel coordinate for padded interaction columns in the small-d kernel:
+#: (y − 6e18)² ≈ 3.6e37 per dim keeps even the SMALL_D-dim sum finite in f32
+#: (8 · 3.6e37 < f32 max), and the kernel clamps d² at :data:`_D2_CAP`
+#: before the bandwidth scaling so ``exp`` sees a large finite negative —
+#: an exact zero for every realistic bandwidth, with no inf/nan anywhere
+#: and no in-kernel mask.
+_FAR = 6e18
+
+#: Upper clamp on the padded-column squared distance (see :data:`_FAR`):
+#: exp(−1e30 / h) == 0 for any h < ~1e27 while −1e30 · inv_h stays finite
+#: (f32 and bf16) for any h > ~3e-9.
+_D2_CAP = 1e30
+
+
+def _pad_to(a: jax.Array, rows: int, cols: int, value: float = 0.0) -> jax.Array:
+    return jnp.pad(
+        a, ((0, rows - a.shape[0]), (0, cols - a.shape[1])),
+        constant_values=value,
+    )
 
 
 @functools.partial(
@@ -227,10 +252,10 @@ def phi_pallas(
     if small_d:
         kern = functools.partial(
             _phi_kernel_small_d,
-            inv_h=inv_h, m_true=m, d_true=d, block_m=bm, nm=nm,
+            inv_h=inv_h, m_true=m, d_true=d, nm=nm,
             bf16_gram=bf16_gram,
         )
-        x_in = _pad_to(interacting.T.astype(f32), SMALL_D, mp)
+        x_in = _pad_to(interacting.T.astype(f32), SMALL_D, mp, value=_FAR)
         x_spec = pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem)
     else:
         kern = functools.partial(

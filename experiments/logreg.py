@@ -97,8 +97,9 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
             shard_blocks[r].append(global_now[b * per : (b + 1) * per])
 
     if wasserstein and (wasserstein_solver == "lp" or update_rule != "jacobi"):
-        # host-LP W2 (exact reference parity) needs per-step host snapshots —
-        # eager reference loop, one dispatch per step
+        # eager reference loop, one dispatch per step: the host-LP W2 (exact
+        # reference parity) needs per-step host snapshots, and the scanned W2
+        # dispatch is Jacobi-only (DistSampler.run_steps raises for GS+W2)
         for _ in range(niter):
             slice_snapshot(np.asarray(sampler.particles))
             sampler.make_step(stepsize, h=10.0)  # h=10 matches logreg.py:83

@@ -38,7 +38,10 @@ def phi_np(y, x, s, h=1.0):
 
 xla_phi = jax.jit(lambda y, x, s: phi(y, x, s, RBF(1.0)))
 rng = np.random.default_rng(0)
-for (k, m, d) in [(50, 37, 3), (1024, 1024, 55), (4096, 4096, 16)]:
+#  (130, 257, 7): ragged small-d at the top of the SMALL_D range — exercises
+#  the sentinel-padded-column path (7 accumulated _FAR² terms + _D2_CAP
+#  clamp) on real Mosaic, not just the CPU interpreter
+for (k, m, d) in [(50, 37, 3), (130, 257, 7), (1024, 1024, 55), (4096, 4096, 16)]:
     y = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
     x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
     s = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
