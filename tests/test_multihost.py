@@ -152,6 +152,21 @@ def test_two_process_federation_matches_oracle(tmp_path):
     want = np.asarray(ref.run_steps(5, 0.1))
     np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-7)
 
+    # ppermute-ring exchange across the process boundary: every hop of the
+    # two-pass all_scores ring rotates blocks between the two processes
+    got_p = np.empty((n, d), dtype=np.float32)
+    for r in range(2):
+        start, count = np.load(tmp_path / f"range_{r}.npy")
+        got_p[start : start + count] = np.load(tmp_path / f"ring_rows_{r}.npy")
+    ref_p = dt.DistSampler(
+        8, lambda th, _: gmm_logp(th), None, full,
+        exchange_particles=True, exchange_scores=True,
+        include_wasserstein=False, exchange_impl="ring",
+        mesh=multihost.make_particle_mesh(8),
+    )
+    want_p = np.asarray(ref_p.run_steps(4, 0.1))
+    np.testing.assert_allclose(got_p, want_p, rtol=2e-6, atol=2e-7)
+
 
 def test_distsampler_runs_on_multihost_mesh():
     """The full driver recipe: build the granule-major mesh, assemble the global

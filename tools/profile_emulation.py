@@ -170,19 +170,23 @@ def main():
     results["E:all_scores_special"] = timed_scan(step_all_scores_special, P0, args.iters)
     print("E:all_scores_special", results["E:all_scores_special"], flush=True)
 
-    # F. vmap all_particles with phi forced to a k-major-friendly pallas block
-    def lane_step_p128(block, lane_data):
+    # F. vmap all_particles with the per-lane tile config pinned explicitly
+    # (bk=256/bm=1024 is what _auto_block picks for k=1250 today — this row
+    # deliberately duplicates B under current defaults, so a future
+    # _auto_block change shows up as B diverging from F)
+    def lane_step_p(block, lane_data):
         interacting = lax.all_gather(block, "sh", tiled=True)
         scores = scale * batched_score(interacting, lane_data)
-        return block + eps * phi_pallas(block, interacting, scores, block_k=1250 // 2)
+        return block + eps * phi_pallas(block, interacting, scores,
+                                        block_k=256, block_m=1024)
 
-    vstep_p = jax.vmap(lane_step_p128, in_axes=(0, 0), axis_name="sh", axis_size=S)
+    vstep_p = jax.vmap(lane_step_p, in_axes=(0, 0), axis_name="sh", axis_size=S)
 
     def step_vmap_p(P, i):
         return vstep_p(P.reshape(S, N // S, d), (xs_stack, ts_stack)).reshape(N, d)
 
-    results["F:vmap_pallas_bk625"] = timed_scan(step_vmap_p, P0, args.iters)
-    print("F:vmap_pallas_bk625", results["F:vmap_pallas_bk625"], flush=True)
+    results["F:vmap_pallas_bk256"] = timed_scan(step_vmap_p, P0, args.iters)
+    print("F:vmap_pallas_bk256", results["F:vmap_pallas_bk256"], flush=True)
 
     print()
     for k, (ups, wall) in results.items():
