@@ -110,7 +110,7 @@ def _phi_kernel(y_ref, x_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
               inv_h=inv_h, m_true=m_true, nm=nm)
 
 
-def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
+def _phi_kernel_small_d(y_ref, xT_ref, xsT_ref, o_ref, acc_ref, ksum_ref, *,
                         inv_h: float, m_true: int, d_true: int,
                         nm: int, bf16_gram: bool):
     """Small-d variant: distances as Σ_c (y_c − x_c)² via rank-1 VPU
@@ -119,10 +119,19 @@ def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
     10k-particle d=3 north star on a v5e — and is *exact* f32: no
     y²+x²−2·y·x cancellation, so no clamp is needed.
 
-    ``bf16_gram``: evaluate the exp and the drive contraction in bfloat16
-    (distances stay f32; accumulators stay f32).  Measured 1.28× at the
-    north star at 4.4e-4 max error of max|φ| vs the f64 oracle — opt-in via
-    ``phi_pallas(gram_dtype=jnp.bfloat16)``.
+    The drive term is computed on the **VPU as per-dim reductions**
+    (``Σ_j Kᵗ[i,j]·xsᵀ[c,j]`` — one (bk, bm) multiply + row-reduce per
+    feature dim) instead of the 128-lane-padded MXU contraction: at d=3 the
+    ``precision=HIGHEST`` dot pays its multi-pass decomposition on 128-wide
+    tiles that are 97% padding, and the per-dim form measured 1.6× faster
+    at the north star at identical f32 exactness (docs/notes.md).
+
+    ``bf16_gram``: evaluate the exp in bfloat16; distances and the drive
+    accumulation stay f32 — the bf16·f32 multiply promotes.  Measured
+    ~3e-4 max error of max|φ| vs the f64 oracle, and *parity* speed with
+    exact f32 on this variant (the MXU left the critical path) — opt-in
+    via ``phi_pallas(gram_dtype=jnp.bfloat16)``, mainly for the big-d
+    kernel where the drive is a real matmul.
 
     No in-kernel column mask: padded interaction columns hold the
     :data:`_FAR` sentinel, whose squared distance saturates the exp to an
@@ -131,9 +140,9 @@ def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
     """
     j = pl.program_id(1)
 
-    y = y_ref[:]    # (bk, dp)
-    xT = xT_ref[:]  # (SMALL_D, bm)  — interaction block, transposed
-    xs = xs_ref[:]  # (bm, dp)       == s − (2/h)·x
+    y = y_ref[:]      # (bk, dp)
+    xT = xT_ref[:]    # (SMALL_D, bm)  — interaction block, transposed
+    xsT = xsT_ref[:]  # (SMALL_D, bm)  == (s − (2/h)·x)ᵀ
 
     d2 = None
     for c in range(d_true):  # static unroll
@@ -145,11 +154,17 @@ def _phi_kernel_small_d(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
     neg = -jnp.minimum(d2, _D2_CAP) * inv_h
     if bf16_gram:
         kt = jnp.exp(neg.astype(jnp.bfloat16))
-        xs = xs.astype(jnp.bfloat16)
     else:
         kt = jnp.exp(neg)
 
-    contrib = _drive_dot(kt, xs, bf16_gram)  # (bk, dp) MXU
+    cols = [
+        jnp.sum(kt * xsT[c:c + 1, :], axis=1, keepdims=True)  # (bk, 1) f32
+        for c in range(d_true)
+    ]
+    pad = y.shape[1] - d_true
+    contrib = jnp.concatenate(
+        cols + [jnp.zeros((y.shape[0], pad), jnp.float32)], axis=1
+    )
     _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref,
               inv_h=inv_h, m_true=m_true, nm=nm)
 
@@ -213,11 +228,12 @@ def phi_pallas(
             512² default; 2048-wide k-tiles overflow VMEM.
         interpret: run under the Pallas interpreter (CPU testing).
         gram_dtype: ``None`` (f32, exact — the default) or ``jnp.bfloat16``:
-            evaluate the Gram exp and the drive contraction in bf16
-            (distances and accumulators stay f32).  Measured at the north
-            star: 1.28× faster, max error 4.4e-4 of max|φ| vs the f64
-            oracle — opt-in for runs that tolerate stochastic-gradient-level
-            noise.
+            evaluate the Gram exp (and, in the big-d variant, the drive
+            contraction) in bf16; distances and accumulators stay f32.
+            Max error ~3e-4 of max|φ| vs the f64 oracle.  Worthwhile only
+            for the big-d MXU kernel — since the small-d variant moved its
+            drive to per-dim VPU reductions, exact f32 measures at parity
+            with bf16 there (docs/notes.md round-2 table).
 
     Note: computation is float32 internally regardless of input dtype (the
     TPU MXU has no f64 path); float64 inputs are cast down and the result
@@ -242,9 +258,7 @@ def phi_pallas(
     y = _pad_to(updated.astype(f32), kp, dp)
     # s − (2/h)·x, computed once instead of per output tile — in f32, so
     # low-precision inputs keep the "float32 internally" contract below
-    xs = _pad_to(
-        scores.astype(f32) - (2.0 * inv_h) * interacting.astype(f32), mp, dp
-    )
+    xs_full = scores.astype(f32) - (2.0 * inv_h) * interacting.astype(f32)
 
     nk, nm = kp // bk, mp // bm
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
@@ -257,6 +271,10 @@ def phi_pallas(
         )
         x_in = _pad_to(interacting.T.astype(f32), SMALL_D, mp, value=_FAR)
         x_spec = pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem)
+        # transposed for the per-dim VPU drive (kernel docstring); padded
+        # columns multiply kt == 0 so the pad value is irrelevant
+        xs = _pad_to(xs_full.T, SMALL_D, mp)
+        xs_spec = x_spec  # same (SMALL_D, bm) column blocking as xT
     else:
         kern = functools.partial(
             _phi_kernel, inv_h=inv_h, m_true=m, block_m=bm, nm=nm,
@@ -264,6 +282,8 @@ def phi_pallas(
         )
         x_in = _pad_to(interacting.astype(f32), mp, dp)
         x_spec = pl.BlockSpec((bm, dp), lambda i, j: (j, 0), **vmem)
+        xs = _pad_to(xs_full, mp, dp)
+        xs_spec = x_spec  # same (bm, dp) row blocking as x
     scratch = (
         [pltpu.VMEM((bk, dp), f32), pltpu.VMEM((bk, 128), f32)]
         if pltpu is not None
@@ -280,7 +300,7 @@ def phi_pallas(
         in_specs=[
             pl.BlockSpec((bk, dp), lambda i, j: (i, 0), **vmem),
             x_spec,
-            pl.BlockSpec((bm, dp), lambda i, j: (j, 0), **vmem),
+            xs_spec,
         ],
         out_specs=pl.BlockSpec((bk, dp), lambda i, j: (i, 0), **vmem),
         scratch_shapes=scratch,
@@ -342,9 +362,10 @@ def resolve_phi_fn(kernel, phi_impl: str):
     - ``'pallas'`` — force this kernel (requires RBF); off-TPU it runs under
       the Pallas interpreter — slow but exact, for CPU testing;
     - ``'pallas_bf16'`` — this kernel with the bf16 Gram variant
-      (``gram_dtype=jnp.bfloat16``): ~1.3× faster at the north star at
-      ~4e-4 relative φ error (docs/notes.md) — opt-in, never chosen by
-      ``'auto'``.
+      (``gram_dtype=jnp.bfloat16``, ~3e-4 relative φ error): a win for
+      big-d shapes (one native MXU pass instead of the HIGHEST
+      decomposition); at small d the exact f32 path now measures at parity
+      (docs/notes.md) — opt-in, never chosen by ``'auto'``.
     """
     from dist_svgd_tpu.ops.kernels import RBF
 
