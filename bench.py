@@ -42,7 +42,11 @@ NUM_SHARDS = 8
 
 TARGET_ACC_MARGIN = 0.01   # target = sklearn baseline − margin
 CONV_STEP_SIZE = 0.1       # fastest stable stepsize measured for this config
-CONV_EVAL_EVERY = 25       # steps between accuracy checks (one scan program)
+CONV_EVAL_EVERY = 5        # steps between accuracy checks (one scan program).
+                           # The detection loop only finds S = steps-to-
+                           # target; wall_to_target is then re-measured as
+                           # S-step scanned dispatches with no eval fetches
+                           # (pure trajectory cost, mean of 3 chained runs)
 CONV_MAX_STEPS = 2_000
 
 
@@ -137,21 +141,33 @@ def _steps_to_target(fold) -> dict:
 
     steps = 0
     acc = float(acc_fn(sampler.particles))
-    t0 = time.perf_counter()
     while steps < CONV_MAX_STEPS:
         sampler.run_steps(CONV_EVAL_EVERY, CONV_STEP_SIZE)
         steps += CONV_EVAL_EVERY
         acc = float(acc_fn(sampler.particles))
         if acc >= target:
             break
-    wall = time.perf_counter() - t0
     reached = acc >= target
+
+    # wall: S-step scanned dispatches (pure compute — the detection loop's
+    # per-eval tunnel fetches are not trajectory cost), mean of 3
+    # state-chained runs per the bench-wide timing protocol (the first
+    # starts from the initial state; the chained continuations measure the
+    # same program on evolving state, so no rep can be relay-cached)
+    wall = None
+    if reached:
+        sampler.load_state_dict(state0)
+        run = lambda: sampler.run_steps(steps, CONV_STEP_SIZE)
+        _fence(run())  # compile, untimed
+        sampler.load_state_dict(state0)
+        wall = _timed_chain(run)
+
     return {
         "sklearn_acc": round(baseline, 4),
         "target_acc": round(target, 4),
         "final_acc": round(acc, 4),
         "steps_to_target_acc": steps if reached else None,
-        "wall_to_target_acc_s": round(wall, 3) if reached else None,
+        "wall_to_target_acc_s": None if wall is None else round(wall, 3),
         "conv_step_size": CONV_STEP_SIZE,
     }
 

@@ -7,7 +7,13 @@ from dist_svgd_tpu.ops.kernels import (
     median_bandwidth,
     squared_distances,
 )
-from dist_svgd_tpu.ops.svgd import phi, phi_chunked, svgd_step, svgd_step_sequential
+from dist_svgd_tpu.ops.svgd import (
+    phi,
+    phi_blockwise,
+    phi_chunked,
+    svgd_step,
+    svgd_step_sequential,
+)
 
 __all__ = [
     "RBF",
@@ -16,6 +22,7 @@ __all__ = [
     "median_bandwidth",
     "squared_distances",
     "phi",
+    "phi_blockwise",
     "phi_chunked",
     "svgd_step",
     "svgd_step_sequential",

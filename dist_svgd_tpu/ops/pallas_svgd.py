@@ -344,8 +344,15 @@ def pallas_available() -> bool:
 #: interactions (k·m).  Below it the Gram tile pressure the kernel exists to
 #: relieve isn't the bottleneck and XLA's fusion wins (measured on a v5e:
 #: XLA 1.7 ms vs Pallas 2.4 ms at (500, 500, 753); Pallas ahead from ~2048²
-#: up — docs/notes.md).
+#: up — re-validated after the VPU-drive change, docs/notes.md).
 PALLAS_MIN_PAIRS = 1 << 22
+
+#: On the XLA path, switch from the one-shot ``phi`` (whole (m, k) Gram in
+#: memory) to the both-axes-chunked ``phi_blockwise`` at/above this many
+#: pairs: 2³¹ pairs is an 8.6 GB f32 Gram — near the memory cliff on every
+#: supported platform, far above any size where the blockwise scan overhead
+#: could matter.
+XLA_BLOCKWISE_MIN_PAIRS = 1 << 31
 
 
 def resolve_phi_fn(kernel, phi_impl: str):
@@ -386,9 +393,14 @@ def resolve_phi_fn(kernel, phi_impl: str):
             return auto_fn
         phi_impl = "xla"
     if phi_impl == "xla":
-        from dist_svgd_tpu.ops.svgd import phi
+        from dist_svgd_tpu.ops.svgd import phi, phi_blockwise
 
-        return lambda y, x, s: phi(y, x, s, kernel)
+        def xla_fn(y, x, s):
+            if y.shape[0] * x.shape[0] >= XLA_BLOCKWISE_MIN_PAIRS:
+                return phi_blockwise(y, x, s, kernel)
+            return phi(y, x, s, kernel)
+
+        return xla_fn
     if not isinstance(kernel, RBF):
         raise ValueError(f"phi_impl={phi_impl!r} requires an RBF kernel")
     bw = kernel.bandwidth

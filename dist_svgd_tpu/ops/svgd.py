@@ -117,6 +117,40 @@ def phi_chunked(
     return acc
 
 
+def phi_blockwise(
+    updated: jax.Array,
+    interacting: jax.Array,
+    scores: jax.Array,
+    kernel=None,
+    chunk_k: int = 4096,
+    chunk_m: int = 1024,
+) -> jax.Array:
+    """φ̂* accumulated over chunks of **both** axes — identical result to
+    :func:`phi` (modulo float summation order) with O(chunk_k · chunk_m)
+    peak Gram memory.
+
+    :func:`phi_chunked` bounds memory only along the interaction axis: its
+    per-chunk Gram block is ``(chunk, k)``, which at k = 1M is 32 GB on its
+    own.  This wrapper additionally ``lax.map``s over k-chunks, making the
+    XLA path viable at any n on platforms without the Pallas kernel (which
+    streams VMEM tiles and needs neither — docs/notes.md 1M measurement).
+    """
+    k, d = updated.shape
+    main = (k // chunk_k) * chunk_k
+    parts = []
+    if main:
+        yb = updated[:main].reshape(-1, chunk_k, d)
+        out = lax.map(
+            lambda y: phi_chunked(y, interacting, scores, kernel, chunk_m), yb
+        )
+        parts.append(out.reshape(main, d))
+    if main < k:
+        parts.append(
+            phi_chunked(updated[main:], interacting, scores, kernel, chunk_m)
+        )
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
 def svgd_step(
     particles: jax.Array,
     scores: jax.Array,

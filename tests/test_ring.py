@@ -130,6 +130,45 @@ def test_phi_chunked_matches_phi(chunk_size):
     np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-14)
 
 
+@pytest.mark.parametrize("chunk_k,chunk_m", [(4, 5), (7, 16), (100, 3), (6, 100)])
+def test_phi_blockwise_matches_phi(chunk_k, chunk_m):
+    """Both-axes chunked accumulation (ragged tails in k and m, chunks larger
+    than the axis) equals the one-shot φ — the XLA fallback for n past what
+    phi_chunked's (chunk, k) Gram block can hold."""
+    from dist_svgd_tpu.ops.svgd import phi_blockwise
+
+    rng = np.random.default_rng(17)
+    y = jnp.asarray(rng.normal(size=(13, 3)))
+    x = jnp.asarray(rng.normal(size=(19, 3)))
+    s = jnp.asarray(rng.normal(size=(19, 3)))
+    want = np.asarray(phi(y, x, s))
+    got = np.asarray(phi_blockwise(y, x, s, chunk_k=chunk_k, chunk_m=chunk_m))
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-14)
+    # jit-traceable (the sampler-loop context it exists for)
+    got_jit = np.asarray(jax.jit(
+        lambda a, b, c: phi_blockwise(a, b, c, chunk_k=chunk_k, chunk_m=chunk_m)
+    )(y, x, s))
+    np.testing.assert_allclose(got_jit, want, rtol=1e-12, atol=1e-14)
+
+
+def test_xla_dispatch_switches_to_blockwise_past_threshold(monkeypatch):
+    """resolve_phi_fn's 'xla' path selects phi_blockwise above
+    XLA_BLOCKWISE_MIN_PAIRS (both paths must agree numerically — verified by
+    lowering the threshold so a small shape crosses it)."""
+    from dist_svgd_tpu.ops import pallas_svgd
+    from dist_svgd_tpu.ops.kernels import RBF
+    from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
+
+    rng = np.random.default_rng(23)
+    y = jnp.asarray(rng.normal(size=(12, 3)))
+    x = jnp.asarray(rng.normal(size=(9, 3)))
+    s = jnp.asarray(rng.normal(size=(9, 3)))
+    want = np.asarray(resolve_phi_fn(RBF(1.0), "xla")(y, x, s))
+    monkeypatch.setattr(pallas_svgd, "XLA_BLOCKWISE_MIN_PAIRS", 10)
+    got = np.asarray(resolve_phi_fn(RBF(1.0), "xla")(y, x, s))
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-14)
+
+
 def test_phi_chunked_generic_kernel():
     """Chunked path also supports non-analytic (autograd-fallback) kernels."""
     rng = np.random.default_rng(19)
