@@ -190,8 +190,9 @@ def main():
     wall = _timed_chain(lambda: sharded.run_steps(n_iters, 3e-3))
     sharded_ups = N_PARTICLES * n_iters / wall
 
-    # --- context: the same sharded config on the bf16-Gram kernel --------
-    # (opt-in phi_impl='pallas_bf16', 4.4e-4 phi error — converges to the
+    # --- context: the same sharded config on the reduced-precision kernel
+    # (opt-in phi_impl='pallas_bf16'; at this small-d shape that is the
+    # bf16-exp variant, ~3e-4 phi error — converges to the
     # same accuracy at the bench stepsize, docs/notes.md; reported as
     # context, never as the exact-math headline)
     bf16_ups = None

@@ -89,23 +89,30 @@ def _phi_kernel(y_ref, x_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
     # pairwise squared distances, clamped like ops/kernels.py:squared_distances.
     # HIGHEST precision: the TPU MXU's default bf16 passes put ~1e-2 absolute
     # error into d2, which the exp() turns into percent-level kernel error
-    # (observed 9e-2 rel vs the f32 XLA path on a v5e).
+    # (observed 9e-2 rel vs the f32 XLA path on a v5e).  The fast tier
+    # replaces the 6-pass HIGHEST decomposition with a 3-pass bf16x3 split
+    # (:func:`_dot3`) — d2 error ~1e-6·|y·x|, below the f32 drive-sum floor.
     y2 = jnp.sum(y * y, axis=1, keepdims=True)          # (bk, 1)
     x2 = jnp.sum(x * x, axis=1, keepdims=True)          # (bm, 1)
-    yx = jnp.dot(y, x.T, preferred_element_type=jnp.float32,
-                 precision=jax.lax.Precision.HIGHEST)   # (bk, bm) MXU
-    neg = -jnp.maximum(y2 + x2.T - 2.0 * yx, 0.0) * inv_h
     if bf16_gram:
-        kt = jnp.exp(neg.astype(jnp.bfloat16))          # (bk, bm)
-        xs = xs.astype(jnp.bfloat16)
+        yx = _dot3(y, x.T)                              # (bk, bm) 3 MXU passes
     else:
-        kt = jnp.exp(neg)
+        yx = jnp.dot(y, x.T, preferred_element_type=jnp.float32,
+                     precision=jax.lax.Precision.HIGHEST)  # (bk, bm) 6 passes
+    neg = -jnp.maximum(y2 + x2.T - 2.0 * yx, 0.0) * inv_h
+    kt = jnp.exp(neg)  # f32 exp in both tiers — a bf16 Gram's per-entry 0.4%
+    # rounding decorrelates the drive sum's cancellation (measured 0.67 max
+    # rel φ error at (1250, 10k, 55) with a median bandwidth; docs/notes.md)
 
     # mask padded columns (static m_true ⇒ no SMEM scalar plumbing needed)
     col = jax.lax.broadcasted_iota(jnp.int32, kt.shape, dimension=1)
     kt = jnp.where(col + j * block_m < m_true, kt, jnp.zeros((), kt.dtype))
 
-    contrib = _drive_dot(kt, xs, bf16_gram)  # (bk, dp) MXU
+    if bf16_gram:
+        contrib = _dot3(kt, xs)                          # (bk, dp) 3 MXU passes
+    else:
+        contrib = jnp.dot(kt, xs, preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
     _phi_tail(j, y, kt, contrib, o_ref, acc_ref, ksum_ref,
               inv_h=inv_h, m_true=m_true, nm=nm)
 
@@ -169,14 +176,25 @@ def _phi_kernel_small_d(y_ref, xT_ref, xsT_ref, o_ref, acc_ref, ksum_ref, *,
               inv_h=inv_h, m_true=m_true, nm=nm)
 
 
-def _drive_dot(kt, xs, bf16_gram: bool):
-    """MXU contraction Kᵗ·xs with f32 accumulation.  bf16 operands are
-    MXU-native; Mosaic rejects them with ``precision=HIGHEST`` (a f32
-    multi-pass request), so the precision override applies to f32 only."""
-    if bf16_gram:
-        return jnp.dot(kt, xs, preferred_element_type=jnp.float32)
-    return jnp.dot(kt, xs, preferred_element_type=jnp.float32,
-                   precision=jax.lax.Precision.HIGHEST)
+def _dot3(a, b):
+    """``a @ b`` with f32 accumulation via a 3-pass bf16x3 split — the
+    ``Precision.HIGH`` decomposition, hand-rolled because Mosaic's dot
+    lowering accepts only DEFAULT and HIGHEST.  Each f32 operand splits into
+    a bf16 high part and a bf16 residual (exactly representable); the
+    ``lo·lo`` cross term (~2⁻³² relative) is dropped:
+
+        a·b ≈ a_hi·b_hi + a_hi·b_lo + a_lo·b_hi
+
+    Three native bf16 MXU passes instead of HIGHEST's six — measured 1.3×
+    on the (8×1250, 10k, 55) covertype φ at the default tiles, 1.4e-3 max
+    rel error vs the f64 oracle (the exact path's own f32 floor there is
+    4.4e-4; docs/notes.md)."""
+    a_hi = a.astype(jnp.bfloat16)
+    a_lo = (a - a_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    b_hi = b.astype(jnp.bfloat16)
+    b_lo = (b - b_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    dot = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+    return dot(a_hi, b_hi) + dot(a_hi, b_lo) + dot(a_lo, b_hi)
 
 
 #: Sentinel coordinate for padded interaction columns in the small-d kernel:
@@ -222,18 +240,21 @@ def phi_pallas(
         scores: ``(m, d)`` scores for the interaction set.
         bandwidth: RBF bandwidth ``h`` (static).
         block_k / block_m: output/interaction tile sizes (static).  Default:
-            1024×1024 in the small-d variant, 256×256 in the big-d variant
-            — the round-2 autotune sweep at the 10k-particle north star on a
-            v5e (docs/notes.md): 1024² runs 1.56 ms vs 2.0 ms at the old
-            512² default; 2048-wide k-tiles overflow VMEM.
+            1024×1024 in the small-d variant (round-2 autotune at the
+            10k-particle north star: 1024² runs 1.56 ms vs 2.0 ms at the
+            old 512² default; 2048-wide k-tiles overflow VMEM) and
+            256×1024 in the big-d variant (covertype-shape sweep —
+            docs/notes.md).  Auto-shrunk per axis to keep padding ≤ ~10%.
         interpret: run under the Pallas interpreter (CPU testing).
-        gram_dtype: ``None`` (f32, exact — the default) or ``jnp.bfloat16``:
-            evaluate the Gram exp (and, in the big-d variant, the drive
-            contraction) in bf16; distances and accumulators stay f32.
-            Max error ~3e-4 of max|φ| vs the f64 oracle.  Worthwhile only
-            for the big-d MXU kernel — since the small-d variant moved its
-            drive to per-dim VPU reductions, exact f32 measures at parity
-            with bf16 there (docs/notes.md round-2 table).
+        gram_dtype: ``None`` (f32, exact — the default) or ``jnp.bfloat16``,
+            the fast reduced-precision tier.  Big-d variant: both MXU
+            contractions (distance and drive) run as 3-pass bf16x3 splits
+            (:func:`_dot3`) instead of HIGHEST's 6 passes; the Gram exp and
+            all accumulators stay f32 — measured 1.3× end-to-end at the
+            (8×1250, 10k, 55) covertype shape at 1.4e-3 max rel φ error vs
+            the f64 oracle (vs a 4.4e-4 exact-f32 floor there).  Small-d
+            variant: bf16 exp only (~3e-4 error) — parity speed with exact
+            f32, since its drive is per-dim VPU reductions with no MXU.
 
     Note: computation is float32 internally regardless of input dtype (the
     TPU MXU has no f64 path); float64 inputs are cast down and the result
@@ -247,9 +268,17 @@ def phi_pallas(
         raise ValueError("gram_dtype must be None (f32) or jnp.bfloat16")
     bf16_gram = gram_dtype == jnp.bfloat16
 
-    default_block = 1024 if d <= SMALL_D else 256
-    bk = min(block_k or _auto_block(k, default_block), _round_up(k, 8))
-    bm = min(block_m or _auto_block(m, default_block), _round_up(m, 8))
+    if d <= SMALL_D:
+        default_k = default_m = 1024
+    else:
+        # asymmetric: small output tiles, wide interaction tiles — with the
+        # m-axis innermost, a wider bm cuts the per-tile overheads (mask,
+        # rowsum, accumulator traffic) without re-loading the y tile; the
+        # round-2 sweep at (8×1250, 10k, 55) measured 256×1024 at 2.52 ms
+        # vs 2.78 at 256² (f32) and 1.93 vs 2.80 (bf16x3) — docs/notes.md
+        default_k, default_m = 256, 1024
+    bk = min(block_k or _auto_block(k, default_k), _round_up(k, 8))
+    bm = min(block_m or _auto_block(m, default_m), _round_up(m, 8))
     kp, mp = _round_up(k, bk), _round_up(m, bm)
     dp = _round_up(d, 128)
     inv_h = 1.0 / float(bandwidth)
@@ -368,11 +397,13 @@ def resolve_phi_fn(kernel, phi_impl: str):
     - ``'xla'``    — always the XLA program;
     - ``'pallas'`` — force this kernel (requires RBF); off-TPU it runs under
       the Pallas interpreter — slow but exact, for CPU testing;
-    - ``'pallas_bf16'`` — this kernel with the bf16 Gram variant
-      (``gram_dtype=jnp.bfloat16``, ~3e-4 relative φ error): a win for
-      big-d shapes (one native MXU pass instead of the HIGHEST
-      decomposition); at small d the exact f32 path now measures at parity
-      (docs/notes.md) — opt-in, never chosen by ``'auto'``.
+    - ``'pallas_bf16'`` — this kernel's fast reduced-precision tier
+      (``gram_dtype=jnp.bfloat16``): at big d both MXU contractions run as
+      3-pass bf16x3 splits (1.4e-3 max rel φ error, 1.3× at the covertype
+      shape — docs/notes.md); at small d, bf16 exp only (~3e-4 error,
+      parity speed — the small-d drive has no MXU).  Opt-in, never chosen
+      by ``'auto'``; appropriate when the score is already stochastic
+      (minibatched configs).
     """
     from dist_svgd_tpu.ops.kernels import RBF
 

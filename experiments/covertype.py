@@ -275,7 +275,8 @@ def run(
 @click.option("--phi-impl", type=click.Choice(["auto", "xla", "pallas", "pallas_bf16"]),
               default="auto",
               help="phi backend (ops/pallas_svgd.py:resolve_phi_fn); "
-                   "pallas_bf16 = bf16-Gram kernel, ~1.3-1.8x at 4.4e-4 error")
+                   "pallas_bf16 = bf16x3-matmul fast tier, ~1.15-1.3x at "
+                   "~1.4e-3 phi error (docs/notes.md)")
 def cli(nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed, checkpoint_every, resume, log_every, profile_dir,
         backend, phi_impl):
