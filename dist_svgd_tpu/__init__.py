@@ -22,8 +22,21 @@ across a `jax.sharding.Mesh` with `lax.all_gather` / `lax.psum` /
 
 from dist_svgd_tpu.sampler import Sampler
 from dist_svgd_tpu.distsampler import DistSampler
-from dist_svgd_tpu.ops.kernels import RBF, median_bandwidth
+from dist_svgd_tpu.ops.kernels import (
+    RBF,
+    AdaptiveRBF,
+    median_bandwidth,
+    median_bandwidth_approx,
+)
 
 __version__ = "0.1.0"
 
-__all__ = ["Sampler", "DistSampler", "RBF", "median_bandwidth", "__version__"]
+__all__ = [
+    "Sampler",
+    "DistSampler",
+    "RBF",
+    "AdaptiveRBF",
+    "median_bandwidth",
+    "median_bandwidth_approx",
+    "__version__",
+]

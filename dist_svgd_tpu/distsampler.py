@@ -72,7 +72,12 @@ class DistSampler:
             the reference's ``RBF(bandwidth=1)``.  The string ``'median'``
             resolves an RBF at the median-heuristic bandwidth of the initial
             ``particles`` (:func:`~dist_svgd_tpu.ops.kernels.
-            median_bandwidth`) once, at construction.
+            median_bandwidth`) once, at construction.  The string
+            ``'median_step'`` (an :class:`~dist_svgd_tpu.ops.kernels.
+            AdaptiveRBF`) re-resolves the bandwidth from each step's
+            interaction set *inside* the jitted step (the gathered global
+            set in the ``all_*`` modes — identical on every shard — or the
+            owned block in ``partitions``); Jacobi + ``'gather'`` only.
         particles: ``(n, d)`` global initial particle array.  Truncated to
             ``S · (n // S)`` rows (reference drop policy).
         data: optional pytree of arrays with a common leading data axis.
@@ -171,6 +176,26 @@ class DistSampler:
             from dist_svgd_tpu.ops.kernels import median_bandwidth
 
             kernel = RBF(float(median_bandwidth(jnp.asarray(particles))))
+        from dist_svgd_tpu.ops.kernels import AdaptiveRBF
+
+        if kernel == "median_step":
+            kernel = AdaptiveRBF()
+        if isinstance(kernel, AdaptiveRBF):
+            # per-step median of the interaction set: well-defined for the
+            # Jacobi gather paths (and partitions, where the interaction set
+            # *is* the owned block and exchange_impl is ignored); a per-hop
+            # median would silently break the ring implementation's gather
+            # equivalence, and the literal GS sweep exists for reference
+            # parity (fixed bandwidth)
+            if update_rule != "jacobi":
+                raise ValueError(
+                    "kernel='median_step' requires update_rule='jacobi'"
+                )
+            if exchange_impl == "ring" and exchange_particles:
+                raise ValueError(
+                    "kernel='median_step' requires exchange_impl='gather' "
+                    "in the all_* modes"
+                )
         self._kernel = kernel if kernel is not None else RBF(1.0)
         self._exchange_particles = exchange_particles
         self._exchange_scores = exchange_scores

@@ -2,9 +2,11 @@
 
 from dist_svgd_tpu.ops.kernels import (
     RBF,
+    AdaptiveRBF,
     kernel_matrix,
     kernel_grad_matrix,
     median_bandwidth,
+    median_bandwidth_approx,
     squared_distances,
 )
 from dist_svgd_tpu.ops.svgd import (
@@ -17,9 +19,11 @@ from dist_svgd_tpu.ops.svgd import (
 
 __all__ = [
     "RBF",
+    "AdaptiveRBF",
     "kernel_matrix",
     "kernel_grad_matrix",
     "median_bandwidth",
+    "median_bandwidth_approx",
     "squared_distances",
     "phi",
     "phi_blockwise",

@@ -115,6 +115,89 @@ def median_bandwidth(particles: jax.Array, max_points: int = MEDIAN_BANDWIDTH_MA
     return med_sq / math.log(full_n + 1.0)
 
 
+def median_bandwidth_approx(
+    particles: jax.Array,
+    max_points: int = 1024,
+    probes: int = 16,
+) -> jax.Array:
+    """Per-step estimate of the Liu & Wang median bandwidth, sort-free.
+
+    :func:`median_bandwidth` takes the exact order statistic of the pairwise
+    distances with ``jnp.sort`` — fine once per run, but a 4096-point
+    subsample sort costs 34 ms on a v5e, 36× the whole 10k-particle SVGD
+    step.  This estimator instead brackets the median with four multi-probe
+    counting passes (``probes`` thresholds per pass, each one broadcast
+    compare + count over the subsample's distance matrix — pure VPU work,
+    no sort): resolution ``max(d²)/probes⁴`` (~1.5e-5 of the range at the
+    default 16⁴), measured ~1e-4 relative vs the exact median and **free
+    against the scan-step floor** at ``max_points ≤ 1024`` on a v5e
+    (docs/notes.md).  Used by :class:`AdaptiveRBF` to re-resolve the
+    bandwidth *inside* the jitted step, every step.
+
+    Returns a scalar ``jax.Array``: ``max(med², 1e-12) / log(n + 1)``
+    (the floor keeps a degenerate all-identical particle set from producing
+    a zero bandwidth).  Converges to the *lower middle* order statistic —
+    no even-count interpolation, unlike :func:`median_bandwidth`; the gap
+    between adjacent order statistics is O(1/p²) of the range and
+    immaterial for a kernel bandwidth.
+    """
+    full_n = particles.shape[0]
+    if full_n > max_points:
+        stride = -(-full_n // max_points)  # ceil: at most max_points rows
+        particles = particles[::stride]
+    p = particles.shape[0]
+    sq = squared_distances(particles, particles)
+    # rank of the off-diagonal median within the full p² count — the p
+    # diagonal zeros always fall below any positive threshold, so they are
+    # simply added to the target rank instead of being masked out
+    target = p + (p * p - p + 1) // 2
+    ks = jnp.arange(1, probes + 1, dtype=sq.dtype)
+
+    def refine(lo, width):
+        t = lo + width * ks / probes                              # (probes,)
+        cnt = jnp.sum(sq[None] <= t[:, None, None], axis=(1, 2))  # (probes,)
+        i = jnp.argmax(cnt >= target)  # first bucket reaching the rank
+        return lo + width * i.astype(sq.dtype) / probes, width / probes
+
+    lo, w = refine(jnp.zeros((), sq.dtype), jnp.max(sq))
+    for _ in range(3):
+        lo, w = refine(lo, w)
+    med_sq = jnp.maximum(lo + 0.5 * w, 1e-12)  # probes⁻⁴ ≈ 1.5e-5 of range
+    return med_sq / math.log(full_n + 1.0)
+
+
+class AdaptiveRBF:
+    """Marker kernel: RBF whose bandwidth is re-resolved **every step** from
+    the current interaction set via :func:`median_bandwidth_approx` — the
+    standard adaptive median heuristic (Liu & Wang 2016, eq. 13) evaluated
+    inside the jitted scan, an extension beyond both the reference (fixed
+    ``h=1``, SURVEY.md §0) and the per-run ``kernel='median'`` resolution.
+
+    The φ backends stay compiled at bandwidth 1: ``resolve_phi_fn`` applies
+    the exact rescaling identity ``φ_h(y; x, s) = φ₁(y/√h; x/√h, √h·s)/√h``
+    outside the kernel, so the same Pallas/XLA programs serve every traced
+    bandwidth value (docs/notes.md).
+
+    Jacobi gather/partitions paths only: a per-hop median would break the
+    ring implementation's gather equivalence, and the literal Gauss–Seidel
+    sweep exists for reference parity, which has no adaptive bandwidth.
+    """
+
+    def __init__(self, max_points: int = 1024):
+        if max_points <= 0:
+            raise ValueError(f"max_points must be positive, got {max_points}")
+        self.max_points = int(max_points)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"AdaptiveRBF(max_points={self.max_points})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, AdaptiveRBF) and other.max_points == self.max_points
+
+    def __hash__(self) -> int:
+        return hash(("AdaptiveRBF", self.max_points))
+
+
 def kernel_matrix(kernel: Callable, x: jax.Array, y: jax.Array) -> jax.Array:
     """Gram matrix for an arbitrary scalar kernel callable (vmap fallback)."""
     if hasattr(kernel, "matrix"):

@@ -388,6 +388,11 @@ def resolve_phi_fn(kernel, phi_impl: str):
     """The framework-wide φ-backend policy, shared by ``Sampler``,
     ``DistSampler``, and ``parallel/exchange.py``.
 
+    An :class:`~dist_svgd_tpu.ops.kernels.AdaptiveRBF` kernel composes with
+    every ``phi_impl`` below: the returned function first re-estimates the
+    median bandwidth from the interaction set, then calls the bandwidth-1
+    backend through the rescaling identity (see the inline comment).
+
     Returns ``phi_fn(updated, interacting, scores)``:
 
     - ``'auto'``   — on TPU with an RBF kernel, this Pallas kernel for
@@ -405,10 +410,30 @@ def resolve_phi_fn(kernel, phi_impl: str):
       by ``'auto'``; appropriate when the score is already stochastic
       (minibatched configs).
     """
-    from dist_svgd_tpu.ops.kernels import RBF
+    from dist_svgd_tpu.ops.kernels import (
+        RBF,
+        AdaptiveRBF,
+        median_bandwidth_approx,
+    )
 
     if phi_impl not in ("auto", "xla", "pallas", "pallas_bf16"):
         raise ValueError(f"unknown phi_impl {phi_impl!r}")
+    if isinstance(kernel, AdaptiveRBF):
+        # Per-step median bandwidth via the exact rescaling identity
+        #     φ_h(y; x, s) = φ₁(y/√h; x/√h, √h·s) / √h
+        # (k_h(y, x) = exp(-‖y−x‖²/h) = k₁(y/√h, x/√h), and the repulsive
+        # term's 2/h factor becomes 2·(1/√h)² — algebra in docs/notes.md).
+        # Every backend below stays compiled at the static bandwidth 1; the
+        # traced h touches only elementwise scalings XLA fuses away.
+        base = resolve_phi_fn(RBF(1.0), phi_impl)
+        max_points = kernel.max_points
+
+        def adaptive_fn(y, x, s):
+            h = median_bandwidth_approx(x, max_points)
+            sh = jnp.sqrt(h.astype(y.dtype))
+            return base(y / sh, x / sh, s * sh) / sh
+
+        return adaptive_fn
     on_tpu = pallas_available()
     if phi_impl == "auto":
         if on_tpu and isinstance(kernel, RBF):

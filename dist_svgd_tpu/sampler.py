@@ -44,7 +44,13 @@ class Sampler:
             initial particles via the median heuristic
             (:func:`~dist_svgd_tpu.ops.kernels.median_bandwidth`, Liu & Wang
             2016 eq. 13) — each distinct resolved bandwidth compiles its own
-            scan program.
+            scan program.  The string ``'median_step'`` (equivalently an
+            :class:`~dist_svgd_tpu.ops.kernels.AdaptiveRBF` instance)
+            instead re-resolves the bandwidth from the **current** particles
+            on every step, inside the jitted scan
+            (:func:`~dist_svgd_tpu.ops.kernels.median_bandwidth_approx`; one
+            compiled program regardless of how the bandwidth evolves) —
+            Jacobi update rule only.
         update_rule: ``'jacobi'`` (vectorised, TPU-native default) or
             ``'gauss_seidel'`` (the reference's sequential in-place sweep via
             ``lax.scan``, for small-n parity — SURVEY.md §3.2).
@@ -90,6 +96,21 @@ class Sampler:
         self._median_kernel = kernel == "median"
         if self._median_kernel:
             kernel = RBF(1.0)  # placeholder until run() resolves the bandwidth
+        if kernel == "median_step":
+            from dist_svgd_tpu.ops.kernels import AdaptiveRBF
+
+            kernel = AdaptiveRBF()
+        if update_rule != "jacobi":
+            from dist_svgd_tpu.ops.kernels import AdaptiveRBF
+
+            if isinstance(kernel, AdaptiveRBF):
+                # the gauss_seidel sweep evaluates the kernel directly
+                # (svgd_step_sequential), which a per-step-median marker
+                # cannot do — and the sweep exists for reference parity,
+                # which has no adaptive bandwidth
+                raise ValueError(
+                    "kernel='median_step' requires update_rule='jacobi'"
+                )
         self._kernel = kernel if kernel is not None else RBF(1.0)
         self._update_rule = update_rule
         self._data = None if data is None else jax.tree_util.tree_map(jnp.asarray, data)

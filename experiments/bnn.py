@@ -37,7 +37,7 @@ def get_results_dir(
     )
     # suffix keyed on the *resolved* semantics (not the spelling), so
     # --bandwidth 1 / 1.0 / 1.00 all land in the default dir
-    if bandwidth == "median" or float(bandwidth) != 1.0:
+    if bandwidth in ("median", "median_step") or float(bandwidth) != 1.0:
         name += f"-h={bandwidth}"
     if phi_impl != "auto":
         name += f"-phi={phi_impl}"
@@ -46,13 +46,15 @@ def get_results_dir(
     return path
 
 
-def _resolve_kernel(bandwidth: str):
+def resolve_bandwidth_kernel(bandwidth: str):
     """CLI ``--bandwidth`` → sampler kernel arg: ``'median'`` (heuristic,
     resolved from the initial particles — the sensible default for the d=753
     weight-vector space where the reference's h=1 puts every pairwise kernel
-    value near exp(-d)), a float, or the reference's fixed 1.0 → ``None``."""
-    if bandwidth == "median":
-        return "median"
+    value near exp(-d)), ``'median_step'`` (re-resolved from the current
+    particles every step, inside the scan), a float, or the reference's
+    fixed 1.0 → ``None``."""
+    if bandwidth in ("median", "median_step"):
+        return bandwidth
     h = float(bandwidth)
     if h == 1.0:
         return None  # reference RBF(1)
@@ -94,7 +96,7 @@ def run(
     likelihood, prior = bnn.make_bnn_split(n_features, n_hidden)
     batch = min(batch_size, x_tr.shape[0] // nproc) if batch_size else None
 
-    kernel = _resolve_kernel(bandwidth)
+    kernel = resolve_bandwidth_kernel(bandwidth)
 
     t0 = time.perf_counter()
     if nproc == 1:
@@ -176,9 +178,10 @@ def run(
               default="all_particles")
 @click.option("--seed", type=int, default=0)
 @click.option("--bandwidth", default="1.0",
-              help="RBF bandwidth: a float (reference default 1.0) or "
-                   "'median' for the per-run median heuristic — the better "
-                   "default at d=753 where h=1 collapses every kernel value")
+              help="RBF bandwidth: a float (reference default 1.0), 'median' "
+                   "(per-run median heuristic — the better default at d=753 "
+                   "where h=1 collapses every kernel value), or 'median_step' "
+                   "(re-resolved from the current particles every step)")
 @click.option("--backend", type=click.Choice(["auto", "tpu", "cpu"]), default="auto")
 @click.option("--phi-impl", type=click.Choice(["auto", "xla", "pallas", "pallas_bf16"]),
               default="auto",

@@ -30,7 +30,7 @@ from dist_svgd_tpu.utils.platform import select_backend
 
 def get_results_dir(
     nrows, nproc, nparticles, niter, stepsize, batch_size, exchange, shard_data,
-    seed, phi_impl="auto",
+    seed, phi_impl="auto", bandwidth="1.0",
 ):
     """Every run-changing CLI knob is in the name, so configurations never
     share results or checkpoints; non-default-only suffixes keep
@@ -41,6 +41,8 @@ def get_results_dir(
     )
     if phi_impl != "auto":
         name += f"-phi={phi_impl}"
+    if bandwidth in ("median", "median_step") or float(bandwidth) != 1.0:
+        name += f"-h={bandwidth}"
     path = os.path.join(RESULTS_DIR, name)
     os.makedirs(path, exist_ok=True)
     return path
@@ -63,6 +65,7 @@ def run(
     metrics_path=None,
     profile_dir=None,
     phi_impl="auto",
+    bandwidth="1.0",
 ):
     """Train; returns (final_particles, metrics dict).
 
@@ -95,6 +98,12 @@ def run(
     # likelihood-only logp + separate prior: with minibatching only the data
     # term should carry the N/B scale (see Sampler/make_shard_step docstrings)
     likelihood, prior = make_logreg_split()
+    # shared CLI bandwidth -> kernel mapping (at d=55 the reference's h=1
+    # collapses every off-diagonal kernel value the same way it does at the
+    # BNN's d=753 -- docs/notes.md)
+    from bnn import resolve_bandwidth_kernel
+
+    kernel = resolve_bandwidth_kernel(bandwidth)
 
     n_used = (nparticles // nproc) * nproc
     particles = init_particles_per_shard(seed, n_used, d, nproc)
@@ -106,8 +115,8 @@ def run(
     t0 = time.perf_counter()
     if nproc == 1:
         sampler = dt.Sampler(
-            d, likelihood, data=(x_train, t_train), batch_size=batch,
-            log_prior=prior, phi_impl=phi_impl,
+            d, likelihood, kernel=kernel, data=(x_train, t_train),
+            batch_size=batch, log_prior=prior, phi_impl=phi_impl,
         )
         final, _ = sampler.run(
             n_used, niter, stepsize, seed=seed, record=False,
@@ -117,7 +126,7 @@ def run(
         sampler = dt.DistSampler(
             nproc,
             likelihood,
-            None,
+            kernel,
             particles,
             data=(x_train, t_train),
             exchange_particles=exchange in ("all_particles", "all_scores"),
@@ -136,7 +145,7 @@ def run(
             if checkpoint_dir is None:
                 checkpoint_dir = get_results_dir(
                     nrows, nproc, nparticles, niter, stepsize, batch_size,
-                    exchange, shard_data, seed, phi_impl,
+                    exchange, shard_data, seed, phi_impl, bandwidth,
                 ) + "-ckpt"
             # every=0 with resume means restore-only (no new checkpoints)
             mgr = CheckpointManager(checkpoint_dir, every=checkpoint_every or max(niter, 1))
@@ -238,6 +247,7 @@ def run(
         "exchange": exchange,
         "shard_data": shard_data,
         "phi_impl": phi_impl,
+        "bandwidth": bandwidth,
         "test_acc": acc,
         "wall_s": round(wall, 3),
         # throughput counts only the steps *this* process ran (resume skips
@@ -277,20 +287,24 @@ def run(
               help="phi backend (ops/pallas_svgd.py:resolve_phi_fn); "
                    "pallas_bf16 = bf16x3-matmul fast tier, ~1.15-1.3x at "
                    "~1.4e-3 phi error (docs/notes.md)")
+@click.option("--bandwidth", default="1.0",
+              help="RBF bandwidth: a float (reference default 1.0), 'median' "
+                   "(per-run heuristic), or 'median_step' (re-resolved from "
+                   "the current particles every step, inside the scan)")
 def cli(nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed, checkpoint_every, resume, log_every, profile_dir,
-        backend, phi_impl):
+        backend, phi_impl, bandwidth):
     select_backend(backend)
     results_dir = get_results_dir(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
-        shard_data, seed, phi_impl,
+        shard_data, seed, phi_impl, bandwidth,
     )
     ckpt_dir = results_dir + "-ckpt" if checkpoint_every else None
     final, metrics = run(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed, checkpoint_every, ckpt_dir, resume,
         log_every, os.path.join(results_dir, "metrics.jsonl") if log_every else None,
-        profile_dir, phi_impl,
+        profile_dir, phi_impl, bandwidth,
     )
     np.save(os.path.join(results_dir, "particles.npy"), final)
     with open(os.path.join(results_dir, "metrics.json"), "w") as fh:
