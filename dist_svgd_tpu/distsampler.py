@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dist_svgd_tpu.ops.kernels import RBF
+from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.ot import wasserstein_grad_lp, wasserstein_grad_sinkhorn
 from dist_svgd_tpu.parallel.exchange import (
     ALL_PARTICLES,
@@ -176,8 +176,6 @@ class DistSampler:
             from dist_svgd_tpu.ops.kernels import median_bandwidth
 
             kernel = RBF(float(median_bandwidth(jnp.asarray(particles))))
-        from dist_svgd_tpu.ops.kernels import AdaptiveRBF
-
         if kernel == "median_step":
             kernel = AdaptiveRBF()
         if isinstance(kernel, AdaptiveRBF):

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dist_svgd_tpu.ops.kernels import RBF
+from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.svgd import svgd_step_sequential
 from dist_svgd_tpu.utils.history import history_to_dataframe
 from dist_svgd_tpu.utils.rng import as_key, draw_minibatch, init_particles, minibatch_key
@@ -97,20 +97,15 @@ class Sampler:
         if self._median_kernel:
             kernel = RBF(1.0)  # placeholder until run() resolves the bandwidth
         if kernel == "median_step":
-            from dist_svgd_tpu.ops.kernels import AdaptiveRBF
-
             kernel = AdaptiveRBF()
-        if update_rule != "jacobi":
-            from dist_svgd_tpu.ops.kernels import AdaptiveRBF
-
-            if isinstance(kernel, AdaptiveRBF):
-                # the gauss_seidel sweep evaluates the kernel directly
-                # (svgd_step_sequential), which a per-step-median marker
-                # cannot do — and the sweep exists for reference parity,
-                # which has no adaptive bandwidth
-                raise ValueError(
-                    "kernel='median_step' requires update_rule='jacobi'"
-                )
+        if update_rule != "jacobi" and isinstance(kernel, AdaptiveRBF):
+            # the gauss_seidel sweep evaluates the kernel directly
+            # (svgd_step_sequential), which a per-step-median marker cannot
+            # do — and the sweep exists for reference parity, which has no
+            # adaptive bandwidth
+            raise ValueError(
+                "kernel='median_step' requires update_rule='jacobi'"
+            )
         self._kernel = kernel if kernel is not None else RBF(1.0)
         self._update_rule = update_rule
         self._data = None if data is None else jax.tree_util.tree_map(jnp.asarray, data)
