@@ -163,6 +163,29 @@ def test_distsampler_median_step_scanned_matches_eager(rng):
     )
 
 
+def test_distsampler_median_step_composes_with_sinkhorn_w2(rng):
+    """median_step + the carried-snapshot Sinkhorn W2 term run inside one
+    scanned dispatch, and the scanned trajectory equals the eager one."""
+    init = jnp.asarray(rng.normal(size=(16, 2)))
+    logp = lambda th, _=None: gmm_logp(th)
+
+    def make():
+        return DistSampler(
+            4, logp, "median_step", init,
+            exchange_particles=True, exchange_scores=False,
+            include_wasserstein=True, wasserstein_solver="sinkhorn",
+            sinkhorn_iters=20,
+        )
+
+    a, b = make(), make()
+    a.run_steps(3, 0.1, h=1.0)
+    for _ in range(3):
+        b.make_step(0.1, h=1.0)
+    np.testing.assert_allclose(
+        np.asarray(a.particles), np.asarray(b.particles), rtol=1e-6
+    )
+
+
 def test_median_step_rejected_outside_jacobi_gather(rng):
     init = jnp.asarray(rng.normal(size=(16, 2)))
     logp = lambda th, _=None: gmm_logp(th)
