@@ -104,7 +104,17 @@ class DistSampler:
             scanned W2 path (``run_steps`` with the Wasserstein term) stays
             Jacobi-only — use :meth:`make_step` for GS+W2.
         wasserstein_solver: ``'lp'`` (host LP, exact reference parity) or
-            ``'sinkhorn'`` (on-device entropic OT, jit-fused fast path).
+            ``'sinkhorn'`` (on-device entropic OT, jit-fused fast path;
+            ``sinkhorn_eps`` / ``sinkhorn_iters`` configure it, and
+            ``sinkhorn_tol`` adds an early exit once the per-iteration
+            change of the log-scalings drops below it — plan entries stable
+            to ~``tol`` relatively, dual potentials to ``tol·reg`` in cost
+            units, so precision tracks ``eps``; see
+            :func:`dist_svgd_tpu.ops.ot.sinkhorn_plan`.  The default
+            ``1e-2`` measured 438 → 186 ms/step (2.4×) vs the fixed
+            200-iteration run at the 10k-particle north star, at 7e-5 max
+            trajectory deviation; ``sinkhorn_tol=None`` restores the
+            fixed-count loop (docs/notes.md)).
         mesh: ``'auto'`` (build a real mesh if the host has ≥ S devices, else
             vmap emulation), an explicit ``jax.sharding.Mesh``, or ``None``
             to force emulation.
@@ -145,6 +155,7 @@ class DistSampler:
         wasserstein_solver: str = "lp",
         sinkhorn_eps: float = 0.05,
         sinkhorn_iters: int = 200,
+        sinkhorn_tol: Optional[float] = 1e-2,
         mesh="auto",
         exchange_impl: str = "gather",
         shard_data: bool = False,
@@ -201,6 +212,7 @@ class DistSampler:
         self._wasserstein_solver = wasserstein_solver
         self._sinkhorn_eps = sinkhorn_eps
         self._sinkhorn_iters = sinkhorn_iters
+        self._sinkhorn_tol = sinkhorn_tol
 
         particles = jnp.asarray(particles)
         n = particles.shape[0]
@@ -351,7 +363,8 @@ class DistSampler:
             self._sinkhorn_batched = jax.jit(
                 jax.vmap(
                     lambda c, p: wasserstein_grad_sinkhorn(
-                        c, p, eps=self._sinkhorn_eps, iters=self._sinkhorn_iters
+                        c, p, eps=self._sinkhorn_eps,
+                        iters=self._sinkhorn_iters, tol=self._sinkhorn_tol,
                     )
                 )
             )
@@ -571,6 +584,7 @@ class DistSampler:
                 phi_impl=self._phi_impl,
                 sinkhorn_eps=self._sinkhorn_eps,
                 sinkhorn_iters=self._sinkhorn_iters,
+                sinkhorn_tol=self._sinkhorn_tol,
             )
             self._bound_w2_step = bind_shard_fn(
                 step,

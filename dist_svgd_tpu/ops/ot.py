@@ -14,9 +14,11 @@ Two solvers are provided:
   O((m+n)·m·n) constraint matrix — the reference's single biggest scalability
   cliff (SURVEY.md §3.3); kept for fidelity and as the oracle for tests.
 - :func:`wasserstein_grad_sinkhorn` — TPU-native fast path: entropic OT via
-  log-domain Sinkhorn iterations, fully jittable (``lax.fori_loop``), fusable
-  into the sharded step.  Converges to the LP plan as ``eps → 0``; tested
-  against the LP on small problems.
+  log-domain Sinkhorn iterations, fully jittable and fusable into the
+  sharded step (fixed-count ``lax.fori_loop``, or a ``lax.while_loop``
+  bounded by ``iters`` when the ``tol`` early exit is enabled — the
+  ``DistSampler`` default).  Converges to the LP plan as ``eps → 0``;
+  tested against the LP on small problems.
 """
 
 from __future__ import annotations
@@ -63,37 +65,75 @@ def wasserstein_grad_lp(particles, previous) -> np.ndarray:
     return np.sum(plan[:, :, None] * diffs, axis=1)
 
 
-def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200):
+def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200,
+                  tol: float | None = None):
     """Entropic-OT transport plan between uniform measures on ``x`` and ``y``.
 
     ``eps`` is *relative*: the entropic regulariser is ``eps · mean(C)``,
     making the solver scale-free across targets.  Log-domain updates for
-    stability; fixed iteration count so the loop is a compile-time constant
-    (XLA-friendly control flow).
+    stability.
+
+    ``tol=None`` runs exactly ``iters`` iterations (compile-time-constant
+    ``fori_loop``).  A float ``tol`` adds an early exit (``lax.while_loop``
+    bounded by ``iters``): stop once the sup-norm change of ``log v`` per
+    iteration drops below ``tol``.  Log-scaling units are the right ones —
+    plan entries ``exp(log u ⊕ log k ⊕ log v)`` are stable to ~``tol``
+    relatively, and the equivalent dual-potential precision is ``tol·reg``
+    in cost units, so the exit *tracks the precision intent encoded in
+    eps* (a tiny-``eps`` run converges further before exiting).  At the
+    10k-particle north-star shard shape (1250 × 10000, eps=0.05) the
+    default-precision potentials stabilise in a few dozen iterations while
+    small problems need ~120+ of the 200 default — the adaptive exit
+    serves both without a tuning knob (docs/notes.md).
     """
     m, n = x.shape[0], y.shape[0]
     cost = squared_distances(x, y)
-    reg = eps * jnp.maximum(jnp.mean(cost), jnp.finfo(cost.dtype).tiny)
+    mean_c = jnp.maximum(jnp.mean(cost), jnp.finfo(cost.dtype).tiny)
+    reg = eps * mean_c
     log_k = -cost / reg
     log_a = jnp.full((m,), -jnp.log(float(m)), dtype=cost.dtype)
     log_b = jnp.full((n,), -jnp.log(float(n)), dtype=cost.dtype)
 
-    def body(_, carry):
-        log_u, log_v = carry
+    def half_steps(log_v):
         log_u = log_a - logsumexp(log_k + log_v[None, :], axis=1)
-        log_v = log_b - logsumexp(log_k + log_u[:, None], axis=0)
-        return log_u, log_v
+        return log_u, log_b - logsumexp(log_k + log_u[:, None], axis=0)
 
-    log_u = jnp.zeros((m,), dtype=cost.dtype)
-    log_v = jnp.zeros((n,), dtype=cost.dtype)
-    log_u, log_v = lax.fori_loop(0, iters, body, (log_u, log_v))
+    log_v0 = jnp.zeros((n,), dtype=cost.dtype)
+    if tol is None:
+        def body(_, carry):
+            _, log_v = carry
+            return half_steps(log_v)
+
+        log_u, log_v = lax.fori_loop(
+            0, iters, body, (jnp.zeros((m,), dtype=cost.dtype), log_v0)
+        )
+    else:
+        thresh = jnp.asarray(tol, cost.dtype)
+
+        def cond(carry):
+            i, _, _, delta = carry
+            return (i < iters) & (delta > thresh)
+
+        def body(carry):
+            i, _, log_v, _ = carry
+            log_u, new_v = half_steps(log_v)
+            delta = jnp.max(jnp.abs(new_v - log_v))
+            return i + 1, log_u, new_v, delta
+
+        _, log_u, log_v, _ = lax.while_loop(
+            cond,
+            body,
+            (0, jnp.zeros((m,), dtype=cost.dtype), log_v0,
+             jnp.asarray(jnp.inf, cost.dtype)),
+        )
     return jnp.exp(log_u[:, None] + log_k + log_v[None, :])
 
 
-def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05, iters: int = 200):
+def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
+                              iters: int = 200, tol: float | None = None):
     """W2 gradient from the Sinkhorn plan — same formula as the LP path:
     ``grad_i = Σ_j P_ij (x_i − y_j) = x_i · rowsum_i − P @ y``, computed
     without materialising the ``(m, n, d)`` difference tensor."""
-    plan = sinkhorn_plan(particles, previous, eps=eps, iters=iters)
+    plan = sinkhorn_plan(particles, previous, eps=eps, iters=iters, tol=tol)
     row = jnp.sum(plan, axis=1)
     return particles * row[:, None] - plan @ previous

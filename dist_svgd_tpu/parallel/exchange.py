@@ -420,6 +420,7 @@ def make_shard_step_sinkhorn_w2(
     phi_impl: str = "xla",
     sinkhorn_eps: float = 0.05,
     sinkhorn_iters: int = 200,
+    sinkhorn_tol: Optional[float] = None,
 ) -> Callable:
     """Per-shard SVGD step with the Wasserstein/JKO term computed **inside
     the step** from carried previous-snapshot state, so whole W2 trajectories
@@ -461,7 +462,8 @@ def make_shard_step_sinkhorn_w2(
         else:
             prev_for = prev
         w_grad = w_on * wasserstein_grad_sinkhorn(
-            block, prev_for, eps=sinkhorn_eps, iters=sinkhorn_iters
+            block, prev_for, eps=sinkhorn_eps, iters=sinkhorn_iters,
+            tol=sinkhorn_tol,
         )
         delta, interacting = core(block, data, t, key)
         new = block + step_size * (delta + h * w_grad)

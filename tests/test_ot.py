@@ -63,3 +63,32 @@ def test_sinkhorn_approaches_lp(rng):
         wasserstein_grad_sinkhorn(jnp.asarray(x), jnp.asarray(y), eps=0.002, iters=5000)
     )
     np.testing.assert_allclose(sk, lp, atol=0.05)
+
+
+def test_sinkhorn_tol_early_exit_matches_converged(rng):
+    """The while_loop early exit (tol) lands on the same plan as running the
+    fixed-count loop to convergence, and still jits."""
+    import jax
+
+    x = jnp.asarray(rng.normal(size=(9, 2)))
+    y = jnp.asarray(rng.normal(size=(7, 2)) + 0.3)
+    full = np.asarray(sinkhorn_plan(x, y, eps=0.05, iters=2000))
+    tol = np.asarray(
+        jax.jit(lambda a, b: sinkhorn_plan(a, b, eps=0.05, iters=2000, tol=1e-6))(x, y)
+    )
+    # tol bounds the per-iteration potential change, not the distance to the
+    # fixpoint — the geometric tail adds ~delta/(1-rate), hence the margin
+    np.testing.assert_allclose(tol, full, atol=1e-4)
+    # marginals hold at the exit point too
+    np.testing.assert_allclose(tol.sum(axis=1), np.full(9, 1 / 9), atol=1e-5)
+    np.testing.assert_allclose(tol.sum(axis=0), np.full(7, 1 / 7), atol=1e-5)
+
+
+def test_sinkhorn_tol_respects_iteration_cap(rng):
+    """tol far below reachable precision: the iters bound still terminates
+    the loop and the result equals the fixed-count plan."""
+    x = jnp.asarray(rng.normal(size=(5, 2)))
+    y = jnp.asarray(rng.normal(size=(5, 2)))
+    capped = np.asarray(sinkhorn_plan(x, y, eps=0.05, iters=3, tol=1e-30))
+    fixed = np.asarray(sinkhorn_plan(x, y, eps=0.05, iters=3))
+    np.testing.assert_allclose(capped, fixed, rtol=1e-12)
