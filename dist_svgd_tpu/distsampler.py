@@ -110,9 +110,10 @@ class DistSampler:
             change of the log-scalings drops below it — plan entries stable
             to ~``tol`` relatively, dual potentials to ``tol·reg`` in cost
             units, so precision tracks ``eps``; see
-            :func:`dist_svgd_tpu.ops.ot.sinkhorn_plan`.  The default
-            ``1e-2`` measured 438 → 186 ms/step (2.4×) vs the fixed
-            200-iteration run at the 10k-particle north star, at 7e-5 max
+            :func:`dist_svgd_tpu.ops.ot.sinkhorn_plan`.  With the
+            absorption-stabilised solver, the default ``1e-2`` measured
+            74.5 ms/step at the 10k-particle north star vs 438 for the
+            round-1 log-domain fixed-200 path (5.9× total) at 3.6e-5 max
             trajectory deviation; ``sinkhorn_tol=None`` restores the
             fixed-count loop (docs/notes.md)).
         mesh: ``'auto'`` (build a real mesh if the host has ≥ S devices, else

@@ -14,11 +14,12 @@ Two solvers are provided:
   O((m+n)·m·n) constraint matrix — the reference's single biggest scalability
   cliff (SURVEY.md §3.3); kept for fidelity and as the oracle for tests.
 - :func:`wasserstein_grad_sinkhorn` — TPU-native fast path: entropic OT via
-  log-domain Sinkhorn iterations, fully jittable and fusable into the
-  sharded step (fixed-count ``lax.fori_loop``, or a ``lax.while_loop``
-  bounded by ``iters`` when the ``tol`` early exit is enabled — the
-  ``DistSampler`` default).  Converges to the LP plan as ``eps → 0``;
-  tested against the LP on small problems.
+  absorption-stabilised Sinkhorn scaling (matvec blocks between log-domain
+  absorptions — see :func:`sinkhorn_plan`), fully jittable and fusable
+  into the sharded step (fixed-count loop, or a ``lax.while_loop`` bounded
+  by ``iters`` when the ``tol`` early exit is enabled — the ``DistSampler``
+  default).  Converges to the LP plan as ``eps → 0``; tested against the
+  LP on small problems.
 """
 
 from __future__ import annotations
@@ -28,7 +29,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.scipy.special import logsumexp
 
 from dist_svgd_tpu.ops.kernels import squared_distances
 
@@ -66,67 +66,99 @@ def wasserstein_grad_lp(particles, previous) -> np.ndarray:
 
 
 def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200,
-                  tol: float | None = None):
+                  tol: float | None = None, absorb_every: int = 10):
     """Entropic-OT transport plan between uniform measures on ``x`` and ``y``.
 
     ``eps`` is *relative*: the entropic regulariser is ``eps · mean(C)``,
-    making the solver scale-free across targets.  Log-domain updates for
-    stability.
+    making the solver scale-free across targets.
+
+    Implementation is **absorption-stabilised scaling** (Schmitzer-style):
+    blocks of ``absorb_every`` plain Sinkhorn matvec iterations
+    (``u ← a/(K v)``, ``v ← b/(Kᵀ u)`` — two streamed multiply-reduce
+    passes, no transcendentals) between log-domain absorptions that fold
+    ``reg·log u`` / ``reg·log v`` into the dual potentials and rebuild the
+    kernel (one ``exp`` pass per block).  Measured 2.3× faster than
+    all-log-domain updates at the 10k-particle north-star shard shape at
+    plan agreement ~1e-8 (docs/notes.md).  Scalings are clamped at the
+    smallest f32 normal, so a fully-underflowed outlier row cannot produce
+    inf/NaN — its potential shifts by up to ``~87·reg`` per absorption
+    until the row re-enters range (the standard stabilisation argument).
 
     ``tol=None`` runs exactly ``iters`` iterations (compile-time-constant
-    ``fori_loop``).  A float ``tol`` adds an early exit (``lax.while_loop``
-    bounded by ``iters``): stop once the sup-norm change of ``log v`` per
-    iteration drops below ``tol``.  Log-scaling units are the right ones —
-    plan entries ``exp(log u ⊕ log k ⊕ log v)`` are stable to ~``tol``
-    relatively, and the equivalent dual-potential precision is ``tol·reg``
-    in cost units, so the exit *tracks the precision intent encoded in
-    eps* (a tiny-``eps`` run converges further before exiting).  At the
-    10k-particle north-star shard shape (1250 × 10000, eps=0.05) the
-    default-precision potentials stabilise in a few dozen iterations while
-    small problems need ~120+ of the 200 default — the adaptive exit
-    serves both without a tuning knob (docs/notes.md).
+    loop).  A float ``tol`` adds an early exit (``lax.while_loop`` over
+    uniform absorption blocks, checked at block ends — the cap may
+    overshoot ``iters`` by up to ``absorb_every − 1`` iterations on the
+    final block): stop once the sup-norm change of ``log v`` per iteration
+    drops below ``tol``.  Log-scaling units are the right ones — plan
+    entries are stable to ~``tol`` relatively, and the equivalent
+    dual-potential precision is ``tol·reg`` in cost units, so the exit
+    *tracks the precision intent encoded in eps* (a tiny-``eps`` run
+    converges further before exiting).  At the north-star shard shape
+    (eps=0.05) the default-precision potentials stabilise in a few dozen
+    iterations while small problems need ~120+ of the 200 default — the
+    adaptive exit serves both without a tuning knob (docs/notes.md).
     """
+    if absorb_every <= 0:
+        raise ValueError(f"absorb_every must be positive, got {absorb_every}")
     m, n = x.shape[0], y.shape[0]
     cost = squared_distances(x, y)
-    mean_c = jnp.maximum(jnp.mean(cost), jnp.finfo(cost.dtype).tiny)
+    dt = cost.dtype
+    tiny = jnp.finfo(dt).tiny
+    mean_c = jnp.maximum(jnp.mean(cost), tiny)
     reg = eps * mean_c
-    log_k = -cost / reg
-    log_a = jnp.full((m,), -jnp.log(float(m)), dtype=cost.dtype)
-    log_b = jnp.full((n,), -jnp.log(float(n)), dtype=cost.dtype)
+    a = jnp.asarray(1.0 / m, dt)
+    b = jnp.asarray(1.0 / n, dt)
 
-    def half_steps(log_v):
-        log_u = log_a - logsumexp(log_k + log_v[None, :], axis=1)
-        return log_u, log_b - logsumexp(log_k + log_u[:, None], axis=0)
+    def run_block(f, g, k_iters: int):
+        """``k_iters`` scaling iterations against the absorbed kernel;
+        returns the new potentials and the last iteration's ``log v``
+        sup-change (the convergence statistic)."""
+        kmat = jnp.exp((f[:, None] + g[None, :] - cost) / reg)
 
-    log_v0 = jnp.zeros((n,), dtype=cost.dtype)
+        def one(v):
+            u = a / jnp.maximum(kmat @ v, tiny)
+            return u, b / jnp.maximum(kmat.T @ u, tiny)
+
+        v = lax.fori_loop(
+            0, k_iters - 1, lambda _, v: one(v)[1], jnp.ones((n,), dt)
+        )
+        u, new_v = one(v)
+        delta = jnp.max(jnp.abs(jnp.log(new_v) - jnp.log(v)))
+        return f + reg * jnp.log(u), g + reg * jnp.log(new_v), delta
+
+    f0 = jnp.zeros((m,), dt)
+    g0 = jnp.zeros((n,), dt)
+    if iters:
+        absorb_every = min(absorb_every, iters)  # short runs stay exact
+    blocks, rem = divmod(iters, absorb_every)
     if tol is None:
         def body(_, carry):
-            _, log_v = carry
-            return half_steps(log_v)
+            f, g = carry
+            f, g, _ = run_block(f, g, absorb_every)
+            return f, g
 
-        log_u, log_v = lax.fori_loop(
-            0, iters, body, (jnp.zeros((m,), dtype=cost.dtype), log_v0)
-        )
+        f, g = lax.fori_loop(0, blocks, body, (f0, g0))
+        if rem:
+            f, g, _ = run_block(f, g, rem)
     else:
-        thresh = jnp.asarray(tol, cost.dtype)
+        thresh = jnp.asarray(tol, dt)
+        total = blocks + (1 if rem else 0)
 
         def cond(carry):
             i, _, _, delta = carry
-            return (i < iters) & (delta > thresh)
+            return (i < total) & (delta > thresh)
 
         def body(carry):
-            i, _, log_v, _ = carry
-            log_u, new_v = half_steps(log_v)
-            delta = jnp.max(jnp.abs(new_v - log_v))
-            return i + 1, log_u, new_v, delta
+            i, f, g, _ = carry
+            # uniform block length keeps one compiled body; the cap may
+            # overshoot ``iters`` by < absorb_every on the last block
+            f, g, delta = run_block(f, g, absorb_every)
+            return i + 1, f, g, delta
 
-        _, log_u, log_v, _ = lax.while_loop(
-            cond,
-            body,
-            (0, jnp.zeros((m,), dtype=cost.dtype), log_v0,
-             jnp.asarray(jnp.inf, cost.dtype)),
+        _, f, g, _ = lax.while_loop(
+            cond, body, (0, f0, g0, jnp.asarray(jnp.inf, dt))
         )
-    return jnp.exp(log_u[:, None] + log_k + log_v[None, :])
+    return jnp.exp((f[:, None] + g[None, :] - cost) / reg)
 
 
 def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,

@@ -84,6 +84,19 @@ def test_sinkhorn_tol_early_exit_matches_converged(rng):
     np.testing.assert_allclose(tol.sum(axis=0), np.full(7, 1 / 7), atol=1e-5)
 
 
+def test_sinkhorn_outlier_row_stays_finite(rng):
+    """A particle so far from every target that its whole kernel row
+    underflows f32 must not produce inf/NaN: the clamped scalings plus
+    per-block absorption walk its potential back into range."""
+    x = np.asarray(rng.normal(size=(8, 2)))
+    x[0] = 40.0  # ~1600 squared-distance units from the cluster
+    y = jnp.asarray(rng.normal(size=(6, 2)))
+    plan = np.asarray(sinkhorn_plan(jnp.asarray(x), y, eps=0.01, iters=400))
+    assert np.all(np.isfinite(plan))
+    np.testing.assert_allclose(plan.sum(axis=1), np.full(8, 1 / 8), atol=1e-4)
+    np.testing.assert_allclose(plan.sum(axis=0), np.full(6, 1 / 6), atol=1e-4)
+
+
 def test_sinkhorn_tol_respects_iteration_cap(rng):
     """tol far below reachable precision: the iters bound still terminates
     the loop and the result equals the fixed-count plan."""
