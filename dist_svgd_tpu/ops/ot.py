@@ -79,10 +79,20 @@ def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200,
     ``reg·log u`` / ``reg·log v`` into the dual potentials and rebuild the
     kernel (one ``exp`` pass per block).  Measured 2.3× faster than
     all-log-domain updates at the 10k-particle north-star shard shape at
-    plan agreement ~1e-8 (docs/notes.md).  Scalings are clamped at the
-    smallest f32 normal, so a fully-underflowed outlier row cannot produce
-    inf/NaN — its potential shifts by up to ``~87·reg`` per absorption
-    until the row re-enters range (the standard stabilisation argument).
+    plan agreement ~1e-8 (docs/notes.md).  The potentials start at the
+    exact c-transform warm start ``f⁰_i = min_j C_ij``,
+    ``g⁰_j = min_i (C_ij − f⁰_i)``, which makes the max entry of every row
+    *and* every column of the initial log-kernel exactly zero (for the
+    row-wise argmin ``j*``, ``g⁰_{j*} = 0`` since ``C_{ij*} − f⁰_i = 0``,
+    so the row's best entry is ``0``; columns by construction) — no
+    outlier row can start underflowed, however far away it sits, for two
+    cheap min passes over ``C``.  Scalings are additionally clamped at the
+    smallest f32 normal, so even a row that drifts dead mid-run cannot
+    produce inf/NaN — its potential walks back by up to ``~87·reg`` per
+    absorption (the standard stabilisation argument; without the warm
+    start this walk silently fails to cover a far outlier's cost within
+    the ``iters`` budget, zeroing its plan row and W2 gradient — the
+    regression tests/test_ot.py pins).
 
     ``tol=None`` runs exactly ``iters`` iterations (compile-time-constant
     loop).  A float ``tol`` adds an early exit (``lax.while_loop`` over
@@ -126,8 +136,8 @@ def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200,
         delta = jnp.max(jnp.abs(jnp.log(new_v) - jnp.log(v)))
         return f + reg * jnp.log(u), g + reg * jnp.log(new_v), delta
 
-    f0 = jnp.zeros((m,), dt)
-    g0 = jnp.zeros((n,), dt)
+    f0 = jnp.min(cost, axis=1)                    # (m,) nearest-target cost
+    g0 = jnp.min(cost - f0[:, None], axis=0)      # (n,) c-transform of f0
     if iters:
         absorb_every = min(absorb_every, iters)  # short runs stay exact
     blocks, rem = divmod(iters, absorb_every)

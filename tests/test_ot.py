@@ -84,17 +84,33 @@ def test_sinkhorn_tol_early_exit_matches_converged(rng):
     np.testing.assert_allclose(tol.sum(axis=0), np.full(7, 1 / 7), atol=1e-5)
 
 
-def test_sinkhorn_outlier_row_stays_finite(rng):
-    """A particle so far from every target that its whole kernel row
-    underflows f32 must not produce inf/NaN: the clamped scalings plus
-    per-block absorption walk its potential back into range."""
-    x = np.asarray(rng.normal(size=(8, 2)))
-    x[0] = 40.0  # ~1600 squared-distance units from the cluster
-    y = jnp.asarray(rng.normal(size=(6, 2)))
-    plan = np.asarray(sinkhorn_plan(jnp.asarray(x), y, eps=0.01, iters=400))
+@pytest.mark.parametrize("tol", [None, 1e-2])
+def test_sinkhorn_outlier_row_keeps_its_mass(rng, tol):
+    """A particle so far from every target that exp(-C_ij/reg) underflows
+    f32 across its whole kernel row must still carry its 1/m of plan mass
+    (and hence a nonzero W2 gradient).  The c-transform warm start keeps
+    the row's best log-kernel entry at 0, so it never starts dead.
+
+    Regression: without the warm start, the clamp-and-absorb walk recovers
+    only ~87·reg per absorption and this exact configuration (m=64 with
+    x[0] at squared distance ~3200, eps=0.01, iters=400, larger m pushing
+    mean(C) and reg down) silently returned a zero row — including on the
+    DistSampler production path (tol=1e-2)."""
+    x = np.asarray(rng.normal(size=(64, 2)))
+    x[0] = 40.0
+    y = jnp.asarray(rng.normal(size=(32, 2)))
+    plan = np.asarray(
+        sinkhorn_plan(jnp.asarray(x), y, eps=0.01, iters=400, tol=tol)
+    )
     assert np.all(np.isfinite(plan))
-    np.testing.assert_allclose(plan.sum(axis=1), np.full(8, 1 / 8), atol=1e-4)
-    np.testing.assert_allclose(plan.sum(axis=0), np.full(6, 1 / 6), atol=1e-4)
+    np.testing.assert_allclose(plan.sum(axis=1), np.full(64, 1 / 64), atol=1e-4)
+    np.testing.assert_allclose(plan.sum(axis=0), np.full(32, 1 / 32), atol=1e-4)
+    grad = np.asarray(
+        wasserstein_grad_sinkhorn(jnp.asarray(x), y, eps=0.01, iters=400, tol=tol)
+    )
+    # the outlier's W2 pull is its 1/m of mass times the ~(40,40) offset to
+    # the cloud: Σ_j P_0j (x_0 − y_j) ≈ (1/64)·40 ≈ 0.62 per dim
+    assert np.all(grad[0] > 0.5)
 
 
 def test_sinkhorn_tol_respects_iteration_cap(rng):
