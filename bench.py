@@ -42,7 +42,10 @@ N_ITERS = 500
 NUM_SHARDS = 8
 
 TARGET_ACC_MARGIN = 0.01   # target = sklearn baseline − margin
-CONV_STEP_SIZE = 0.1       # fastest stable stepsize measured for this config
+CONV_STEP_SIZE = 0.3       # fastest measured stepsize for this config: the
+                           # deterministic seed-0 trajectory reaches target
+                           # at step 10 (0.1 → 55, 0.2 → 20, 0.5 → 20 —
+                           # stability margin on both sides)
 CONV_EVAL_EVERY = 5        # steps between accuracy checks (one scan program).
                            # The detection loop only finds S = steps-to-
                            # target; wall_to_target is then re-measured as

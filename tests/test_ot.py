@@ -94,8 +94,9 @@ def test_sinkhorn_outlier_row_keeps_its_mass(rng, tol):
     Regression: without the warm start, the clamp-and-absorb walk recovers
     only ~87·reg per absorption and this exact configuration (m=64 with
     x[0] at squared distance ~3200, eps=0.01, iters=400, larger m pushing
-    mean(C) and reg down) silently returned a zero row — including on the
-    DistSampler production path (tol=1e-2)."""
+    mean(C) and reg down) corrupted the row outright (zero/NaN mass and a
+    zero W2 gradient) — including on the DistSampler production path
+    (tol=1e-2)."""
     x = np.asarray(rng.normal(size=(64, 2)))
     x[0] = 40.0
     y = jnp.asarray(rng.normal(size=(32, 2)))
