@@ -123,6 +123,15 @@ class DistSampler:
             or ``'ring'`` (``lax.ppermute`` block rotation with blockwise φ
             accumulation — same semantics, O(n/S) per-device memory; see
             ``parallel/exchange.py``).  Only affects the ``all_*`` modes.
+        exchange_every: gather cadence T (default 1 = the reference's
+            per-step exchange).  T > 1 selects the **lagged** variant the
+            reference timed but never implemented (its notes.md:134
+            "laggedlocal"): one all-gather per T steps, interactions
+            against the stale set with the live own block patched in —
+            T-fold fewer collectives (``parallel/exchange.py:
+            make_shard_step_lagged``).  ``all_particles`` + ``'gather'`` +
+            Jacobi, no W2; drive through :meth:`run_steps` with
+            ``num_steps`` a multiple of T.
         shard_data: shard the data rows over the mesh instead of replicating
             the full set to every device (``all_*`` modes only).  Rows are
             truncated to ``S · (rows // S)`` (reference drop policy).
@@ -159,6 +168,7 @@ class DistSampler:
         sinkhorn_tol: Optional[float] = 1e-2,
         mesh="auto",
         exchange_impl: str = "gather",
+        exchange_every: int = 1,
         shard_data: bool = False,
         batch_size: Optional[int] = None,
         log_prior: Optional[Callable] = None,
@@ -172,6 +182,29 @@ class DistSampler:
             raise ValueError(f"unknown wasserstein_solver {wasserstein_solver!r}")
         if exchange_impl not in ("gather", "ring"):
             raise ValueError(f"unknown exchange_impl {exchange_impl!r}")
+        if exchange_every < 1:
+            raise ValueError(f"exchange_every must be >= 1, got {exchange_every}")
+        if exchange_every > 1:
+            # lagged exchange is defined for the gathered all_particles mode
+            # only (exchange.py:make_shard_step_lagged docstring); the W2
+            # term's previous-snapshot bookkeeping is per step, not per
+            # refresh, and the GS sweep exists for reference parity
+            if not (exchange_particles and not exchange_scores):
+                raise ValueError(
+                    "exchange_every > 1 requires the all_particles mode"
+                )
+            if exchange_impl != "gather":
+                raise ValueError(
+                    "exchange_every > 1 requires exchange_impl='gather'"
+                )
+            if include_wasserstein:
+                raise ValueError(
+                    "exchange_every > 1 is incompatible with the Wasserstein term"
+                )
+            if update_rule != "jacobi":
+                raise ValueError(
+                    "exchange_every > 1 requires update_rule='jacobi'"
+                )
         if shard_data and not exchange_particles:
             raise ValueError("shard_data is unsupported in partitions mode")
         if update_rule not in ("jacobi", "gauss_seidel"):
@@ -281,6 +314,30 @@ class DistSampler:
             out_specs=(0,),
         )
         self._step = jax.jit(self._bound_step)
+        self._exchange_every = int(exchange_every)
+        self._bound_lagged = None
+        if self._exchange_every > 1:
+            from dist_svgd_tpu.parallel.exchange import make_shard_step_lagged
+
+            lagged = make_shard_step_lagged(
+                logp=self._logp,
+                kernel=self._kernel,
+                num_shards=self._num_shards,
+                n_local_data=self._rows_per_shard,
+                score_scale=self._score_scale,
+                exchange_every=self._exchange_every,
+                shard_data=shard_data,
+                batch_size=batch_size,
+                log_prior=log_prior,
+                phi_impl=phi_impl,
+            )
+            self._bound_lagged = bind_shard_fn(
+                lagged,
+                self._num_shards,
+                self._mesh,
+                in_specs=(0, 0 if shard_data else None, 0, None, None, None, None),
+                out_specs=(0,),
+            )
         self._scan_cache = {}
         self._bound_w2_step = None  # lazily built by _run_steps_w2
         self._batch_key = minibatch_key(seed)
@@ -493,7 +550,11 @@ class DistSampler:
         call instead of once per step.  Semantically identical to ``num_steps``
         calls of :meth:`make_step`: the step counter (``partitions`` rotation)
         and the per-step minibatch key fold advance exactly as the eager path
-        does.
+        does.  Exception: with ``exchange_every > 1`` this method is the
+        *only* driver (``make_step`` raises — one gather is amortised over a
+        block of steps, so ``num_steps`` must be a multiple of the cadence
+        and ``record`` is unsupported; sub-step minibatch keys fold
+        ``(key, i)`` within each block).
 
         With ``record=True`` returns ``(final, history)`` where ``history`` is
         the ``(num_steps, n, d)`` device array of pre-update snapshots (the
@@ -503,7 +564,8 @@ class DistSampler:
 
         Compile-cost note: one scan program is compiled (and cached on this
         sampler, never evicted) **per distinct** ``(num_steps, record)``
-        pair.  Callers that vary ``num_steps`` freely — coprime cadences,
+        pair (per ``(num_steps, record, lagged)`` triple, though ``lagged``
+        is fixed per sampler).  Callers that vary ``num_steps`` freely — coprime cadences,
         adaptive loops — should decompose their schedule into a bounded set
         of lengths (e.g. power-of-two chunks, at most log2(K) programs; see
         ``experiments/covertype.py`` and ``experiments/logreg.py:
@@ -534,10 +596,24 @@ class DistSampler:
                     "drive update_rule='gauss_seidel' + W2 through make_step"
                 )
             return self._run_steps_w2(num_steps, step_size, h, record)
+        lagged = self._exchange_every > 1
+        if lagged:
+            if num_steps % self._exchange_every:
+                raise ValueError(
+                    f"num_steps ({num_steps}) must be a multiple of "
+                    f"exchange_every ({self._exchange_every})"
+                )
+            if record:
+                raise ValueError(
+                    "record=True is unsupported with exchange_every > 1 "
+                    "(history is defined per step, the lagged dispatch "
+                    "advances exchange_every steps at a time)"
+                )
         dtype = self._particles.dtype
-        run = self._scan_cache.get((num_steps, record))
+        run = self._scan_cache.get((num_steps, record, lagged))
         if run is None:
-            bound = self._bound_step
+            bound = self._bound_lagged if lagged else self._bound_step
+            stride = self._exchange_every if lagged else 1
 
             @jax.jit
             def run(particles, data, t0, batch_key, eps, h):
@@ -546,11 +622,15 @@ class DistSampler:
                                 jax.random.fold_in(batch_key, t), eps, h)
                     return new, (parts if record else None)
 
-                ts = t0 + 1 + jnp.arange(num_steps, dtype=jnp.int32)
+                # lagged: each scan iteration advances `stride` steps, `t`
+                # being the first sub-step's 1-based counter
+                ts = t0 + 1 + stride * jnp.arange(
+                    num_steps // stride, dtype=jnp.int32
+                )
                 out, hist = jax.lax.scan(body, particles, ts)
                 return (out, hist) if record else out
 
-            self._scan_cache[(num_steps, record)] = run
+            self._scan_cache[(num_steps, record, lagged)] = run
         out = run(
             self._particles,
             self._data,
@@ -656,6 +736,12 @@ class DistSampler:
         """Perform one distributed SVGD step — reference API
         (dsvgd/distsampler.py:172-205).  Returns the global particle array.
         """
+        if self._exchange_every > 1:
+            raise ValueError(
+                "exchange_every > 1 amortises one gather over a block of "
+                "steps; drive it through run_steps(num_steps) with "
+                "num_steps a multiple of exchange_every"
+            )
         self._t += 1
         dtype = self._particles.dtype
         if self._include_wasserstein and self._previous is not None:

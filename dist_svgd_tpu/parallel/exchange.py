@@ -171,6 +171,24 @@ def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, phi_fn, num_sh
     return acc / num_shards
 
 
+def _builder_prelude(logp, kernel, phi_impl, log_prior, batch_size,
+                     n_local_data):
+    """Shared construction of every step builder's numeric machinery —
+    one definition so the per-step, Gauss-Seidel, lagged, and W2 builders
+    cannot drift on score/prior/φ semantics."""
+    if batch_size is not None and not 0 < batch_size <= n_local_data:
+        raise ValueError(
+            f"batch_size {batch_size} not in (0, {n_local_data}] local rows"
+        )
+    phi_fn = resolve_phi_fn(kernel, phi_impl)
+    batched_score = jax.vmap(jax.grad(logp, argnums=0), in_axes=(0, None))
+    if log_prior is not None:
+        batched_prior = jax.vmap(jax.grad(log_prior))
+    else:
+        batched_prior = lambda thetas: jnp.zeros_like(thetas)
+    return phi_fn, batched_score, batched_prior
+
+
 def make_shard_step(
     logp: Callable,
     kernel,
@@ -287,13 +305,9 @@ def _build_gs_step(
     if shard_data and mode == PARTITIONS:
         raise ValueError("shard_data is unsupported in partitions mode")
 
-    phi_fn = resolve_phi_fn(kernel, phi_impl)
-    score_fn = jax.grad(logp, argnums=0)
-    batched_score = jax.vmap(score_fn, in_axes=(0, None))
-    if log_prior is not None:
-        batched_prior = jax.vmap(jax.grad(log_prior))
-    else:
-        batched_prior = lambda thetas: jnp.zeros_like(thetas)
+    phi_fn, batched_score, batched_prior = _builder_prelude(
+        logp, kernel, phi_impl, log_prior, batch_size, n_local_data
+    )
 
     resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
 
@@ -349,18 +363,9 @@ def _build_core(
         raise ValueError(f"unknown exchange mode {mode!r}")
     if shard_data and mode == PARTITIONS:
         raise ValueError("shard_data is unsupported in partitions mode")
-    if batch_size is not None and not 0 < batch_size <= n_local_data:
-        raise ValueError(
-            f"batch_size {batch_size} not in (0, {n_local_data}] local rows"
-        )
-
-    phi_fn = resolve_phi_fn(kernel, phi_impl)
-    score_fn = jax.grad(logp, argnums=0)
-    batched_score = jax.vmap(score_fn, in_axes=(0, None))
-    if log_prior is not None:
-        batched_prior = jax.vmap(jax.grad(log_prior))
-    else:
-        batched_prior = lambda thetas: jnp.zeros_like(thetas)
+    phi_fn, batched_score, batched_prior = _builder_prelude(
+        logp, kernel, phi_impl, log_prior, batch_size, n_local_data
+    )
 
     resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
 
@@ -405,6 +410,87 @@ def _build_core(
         return delta, interacting
 
     return core
+
+
+def make_shard_step_lagged(
+    logp: Callable,
+    kernel,
+    num_shards: int,
+    n_local_data: int,
+    score_scale: float,
+    exchange_every: int,
+    shard_data: bool = False,
+    batch_size: Optional[int] = None,
+    log_prior: Optional[Callable] = None,
+    phi_impl: str = "xla",
+) -> Callable:
+    """Lagged (stale) ``all_particles`` exchange: one ``lax.all_gather``
+    per ``exchange_every`` SVGD steps instead of per step.
+
+    The reference *timed* this variant ("8-laggedlocal", its ``notes.md:134``
+    — 226 s vs 59 s for the per-step exchange at its headline config) but
+    never shipped an implementation (SURVEY.md §2.3).  Semantics here (the
+    "lagged-remote, live-local" reading the name implies): at each refresh
+    the shard snapshots the gathered global set; for the following
+    ``exchange_every`` steps its interaction set is that stale snapshot
+    with the shard's **own block patched live** (``dynamic_update_slice``),
+    scores re-evaluated on local data each step at the current view.  The
+    collective count — the quantity lagging exists to cut — drops
+    ``exchange_every``-fold; between refreshes shards drift like the
+    reference's per-rank processes would between its hypothetical lagged
+    syncs.  Same fixed point as ``all_particles`` (stale and fresh sets
+    coincide once particles stop moving).
+
+    One call = ``exchange_every`` SVGD steps (a static inner ``lax.scan`` —
+    no data-dependent control flow, works identically under shard_map and
+    vmap emulation).  ``t`` is the first sub-step's 1-based counter; the
+    per-sub-step minibatch keys fold ``(key, i)`` so every sub-step draws a
+    fresh batch.  ``all_scores`` is excluded: its exchanged quantity *is*
+    the per-step psum, so a lagged variant would freeze scores at stale
+    positions — a different (and degenerate) algorithm.
+
+    Returns ``macro(block, data, w_grad_block, t, key, step_size, h) ->
+    new_block`` — the standard per-shard step signature (``w_grad_block``
+    must be zeros: the W2 term's previous-snapshot bookkeeping is defined
+    per step, not per refresh).
+    """
+    if exchange_every < 1:
+        raise ValueError(f"exchange_every must be >= 1, got {exchange_every}")
+    phi_fn, batched_score, batched_prior = _builder_prelude(
+        logp, kernel, phi_impl, log_prior, batch_size, n_local_data
+    )
+    resolve_data = _shard_data_resolver(
+        ALL_PARTICLES, num_shards, n_local_data, shard_data
+    )
+
+    def macro(block, data, w_grad_block, t, key, step_size, h):
+        del w_grad_block, h  # W2 is per-step bookkeeping; rejected upstream
+        r = lax.axis_index(AXIS)
+        s = block.shape[0]
+        stale = lax.all_gather(block, AXIS, tiled=True)  # the ONE collective
+        lo = r.astype(jnp.int32) * s
+        data_local = resolve_data(data, t, r)
+
+        def body(blk, i):
+            view = lax.dynamic_update_slice_in_dim(stale, blk, lo, axis=0)
+            dl, mb_scale = data_local, jnp.asarray(1.0, dtype=blk.dtype)
+            if batch_size is not None:
+                dl, scale = draw_minibatch(
+                    jax.random.fold_in(jax.random.fold_in(key, i), r),
+                    data_local, n_local_data, batch_size,
+                )
+                mb_scale = jnp.asarray(scale, dtype=blk.dtype)
+            scores = score_scale * mb_scale * batched_score(view, dl)
+            scores = scores + batched_prior(view)
+            delta = phi_fn(blk, view, scores)
+            return blk + step_size * delta, None
+
+        blk, _ = lax.scan(
+            body, block, jnp.arange(exchange_every, dtype=jnp.int32)
+        )
+        return blk
+
+    return macro
 
 
 def make_shard_step_sinkhorn_w2(

@@ -1,0 +1,159 @@
+"""Lagged (stale) exchange — ``DistSampler(exchange_every=T)``.
+
+The reference timed a "laggedlocal" variant (its notes.md:134, reproduced in
+BASELINE.md: 226 s vs 59 s for per-step exchange at its headline config) but
+never shipped an implementation (SURVEY.md §2.3).  These tests pin the
+semantics this framework defines for it (lagged-remote, live-local —
+``parallel/exchange.py:make_shard_step_lagged``).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dist_svgd_tpu import DistSampler, RBF
+from dist_svgd_tpu.models.gmm import gmm_logp
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(31)
+
+
+def _logp(th, _=None):
+    return gmm_logp(th)
+
+
+def _make(init, T, **kw):
+    return DistSampler(
+        4, _logp, None, init,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False, exchange_every=T, **kw,
+    )
+
+
+def test_exchange_every_one_macro_equals_standard_step(rng):
+    """The lagged macro itself at T=1 ≡ one per-step all_particles step.
+
+    ``DistSampler(exchange_every=1)`` deliberately never builds the lagged
+    path (the standard step IS the T=1 semantics), so this drives
+    ``make_shard_step_lagged`` directly to pin its base case."""
+    from dist_svgd_tpu.parallel.exchange import make_shard_step_lagged
+    from dist_svgd_tpu.parallel.mesh import bind_shard_fn, make_mesh
+
+    init = jnp.asarray(rng.normal(size=(16, 2)))
+    macro = make_shard_step_lagged(
+        logp=_logp, kernel=RBF(1.0),
+        num_shards=4, n_local_data=0, score_scale=1.0, exchange_every=1,
+    )
+    bound = bind_shard_fn(
+        macro, 4, make_mesh(4),
+        in_specs=(0, None, 0, None, None, None, None), out_specs=(0,),
+    )
+    key = jnp.zeros((2,), dtype=jnp.uint32)
+    got = np.asarray(bound(
+        init, None, jnp.zeros_like(init), jnp.int32(1), key,
+        jnp.float64(0.2), jnp.float64(0.0),
+    ))
+    ref = DistSampler(
+        4, _logp, None, init,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False,
+    )
+    want = np.asarray(ref.make_step(0.2))
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_lagged_matches_loop_oracle(rng):
+    """T=2: the scanned lagged trajectory equals a numpy/loop re-derivation
+    of the defined semantics — refresh the stale global set every T steps,
+    update each block against (stale set with own block live), data-free
+    target so scores are exact."""
+    S, n, d, T = 4, 16, 2, 2
+    init = rng.normal(size=(n, d))
+    ds = _make(jnp.asarray(init), T)
+    ds.run_steps(4, 0.1)
+    got = np.asarray(ds.particles)
+
+    # oracle: same math in explicit loops on float64
+    score = jax.vmap(jax.grad(gmm_logp))
+    blocks = [init[i * 4:(i + 1) * 4].copy() for i in range(S)]
+    h = 1.0
+    for refresh in range(2):  # 4 steps = 2 macro blocks of T=2
+        stale = np.concatenate(blocks)
+        for _ in range(T):
+            new_blocks = []
+            for r in range(S):
+                view = stale.copy()
+                view[r * 4:(r + 1) * 4] = blocks[r]
+                s = np.asarray(score(jnp.asarray(view)))
+                d2 = ((view[None, :, :] - blocks[r][:, None, :]) ** 2).sum(-1)
+                kt = np.exp(-d2 / h)
+                drive = kt @ s
+                repulse = (2 / h) * (blocks[r] * kt.sum(1, keepdims=True) - kt @ view)
+                new_blocks.append(blocks[r] + 0.1 * (drive + repulse) / n)
+            blocks = new_blocks
+    want = np.concatenate(blocks)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_lagged_differs_from_fresh_but_converges_same_fixpoint(rng):
+    """T=4 trajectories differ from per-step exchange, but both samplers
+    reach the same GMM spread (same fixed point)."""
+    init = jnp.asarray(rng.normal(size=(32, 1)))
+    lag = _make(init, 4)
+    lag.run_steps(200, 0.3)
+    fresh = DistSampler(
+        4, _logp, None, init,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False,
+    )
+    fresh.run_steps(200, 0.3)
+    a, b = np.asarray(lag.particles), np.asarray(fresh.particles)
+    assert not np.allclose(a, b)  # different trajectories
+    # both approximate the 1/3 N(-2,1) + 1/3 N(2,1) mixture spread (~2.24)
+    assert abs(a.std() - b.std()) < 0.25
+    assert 1.7 < a.std() < 2.8
+
+
+def test_lagged_minibatch_runs(rng):
+    """exchange_every composes with per-shard minibatched scores."""
+    init = jnp.asarray(rng.normal(size=(16, 2)))
+    x = jnp.asarray(rng.normal(size=(32, 2)))
+
+    def lik(th, data):
+        return -0.5 * jnp.sum((data[0] @ th) ** 2)
+
+    ds = DistSampler(
+        4, lik, None, init, data=(x,),
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False, exchange_every=2, batch_size=4,
+    )
+    out = ds.run_steps(4, 0.05)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_lagged_validation(rng):
+    init = jnp.asarray(rng.normal(size=(16, 2)))
+    with pytest.raises(ValueError, match="all_particles"):
+        DistSampler(4, _logp, None, init, exchange_particles=True,
+                    exchange_scores=True, include_wasserstein=False,
+                    exchange_every=2)
+    with pytest.raises(ValueError, match="gather"):
+        _make(init, 2, exchange_impl="ring")
+    with pytest.raises(ValueError, match="Wasserstein"):
+        DistSampler(4, _logp, None, init, exchange_particles=True,
+                    exchange_scores=False, include_wasserstein=True,
+                    wasserstein_solver="sinkhorn", exchange_every=2)
+    with pytest.raises(ValueError, match="jacobi"):
+        _make(init, 2, update_rule="gauss_seidel")
+    with pytest.raises(ValueError, match=">= 1"):
+        _make(init, 0)
+    ds = _make(init, 2)
+    with pytest.raises(ValueError, match="run_steps"):
+        ds.make_step(0.1)
+    with pytest.raises(ValueError, match="multiple"):
+        ds.run_steps(3, 0.1)
+    with pytest.raises(ValueError, match="record"):
+        ds.run_steps(4, 0.1, record=True)
