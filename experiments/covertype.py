@@ -30,7 +30,7 @@ from dist_svgd_tpu.utils.platform import select_backend
 
 def get_results_dir(
     nrows, nproc, nparticles, niter, stepsize, batch_size, exchange, shard_data,
-    seed, phi_impl="auto", bandwidth="1.0",
+    seed, phi_impl="auto", bandwidth="1.0", exchange_every=1,
 ):
     """Every run-changing CLI knob is in the name, so configurations never
     share results or checkpoints; non-default-only suffixes keep
@@ -43,6 +43,8 @@ def get_results_dir(
         name += f"-phi={phi_impl}"
     if bandwidth in ("median", "median_step") or float(bandwidth) != 1.0:
         name += f"-h={bandwidth}"
+    if exchange_every != 1:
+        name += f"-T={exchange_every}"
     path = os.path.join(RESULTS_DIR, name)
     os.makedirs(path, exist_ok=True)
     return path
@@ -66,6 +68,7 @@ def run(
     profile_dir=None,
     phi_impl="auto",
     bandwidth="1.0",
+    exchange_every=1,
 ):
     """Train; returns (final_particles, metrics dict).
 
@@ -112,6 +115,41 @@ def run(
     batch = min(batch_size, rows_per_shard) if batch_size else None
 
     start = 0  # resumed-from step (sharded path may overwrite)
+
+    def _finish(final, wall, niter, start):
+        acc = float(ensemble_test_accuracy(
+            final, jnp.asarray(x_test), jnp.asarray(t_test)
+        ))
+        metrics = {
+            "dataset": "covertype",
+            "nrows": nrows,
+            "nproc": nproc,
+            "nparticles": n_used,
+            "niter": niter,
+            "stepsize": stepsize,
+            "batch_size": batch,
+            "exchange": exchange,
+            "shard_data": shard_data,
+            "phi_impl": phi_impl,
+            "bandwidth": bandwidth,
+            "exchange_every": exchange_every,
+            "test_acc": acc,
+            "wall_s": round(wall, 3),
+            # throughput counts only the steps *this* process ran (resume
+            # skips the first `start` steps, so n_used*niter/wall would
+            # overstate it)
+            "steps_run": niter - start,
+            "resumed_from": start,
+            "updates_per_sec": round(n_used * max(niter - start, 0) / wall, 1)
+            if niter > start else 0.0,
+        }
+        return np.asarray(final), metrics
+
+    if exchange_every > 1 and nproc == 1:
+        raise ValueError(
+            "--exchange-every > 1 is a distributed exchange cadence; "
+            "it requires --nproc > 1"
+        )
     t0 = time.perf_counter()
     if nproc == 1:
         sampler = dt.Sampler(
@@ -136,8 +174,33 @@ def run(
             batch_size=batch,
             log_prior=prior,
             phi_impl=phi_impl,
+            exchange_every=exchange_every,
             seed=seed,
         )
+        if exchange_every > 1:
+            # the lagged macro amortises one gather over exchange_every
+            # steps and is driven exclusively through run_steps, so the
+            # per-step event schedule below (make_step at log/ckpt points)
+            # does not apply -- run the whole trajectory as one dispatch
+            if checkpoint_every or resume or log_every or profile_dir:
+                raise ValueError(
+                    "--exchange-every > 1 runs as one scanned dispatch; "
+                    "checkpointing/logging/profiling cadences are "
+                    "unsupported with it"
+                )
+            if niter % exchange_every:
+                raise ValueError(
+                    f"--niter ({niter}) must be a multiple of "
+                    f"--exchange-every ({exchange_every})"
+                )
+            state0 = sampler.state_dict()
+            jax.block_until_ready(sampler.run_steps(niter, stepsize))  # compile
+            sampler.load_state_dict(state0)
+            t0 = time.perf_counter()
+            sampler.run_steps(niter, stepsize)
+            final = jax.block_until_ready(sampler.particles)
+            wall = time.perf_counter() - t0
+            return _finish(final, wall, niter, 0)
         mgr = None
         if checkpoint_every or resume:
             from dist_svgd_tpu.utils.checkpoint import CheckpointManager
@@ -234,30 +297,7 @@ def run(
         final = sampler.particles
     final = jax.block_until_ready(final)
     wall = time.perf_counter() - t0
-
-    acc = float(ensemble_test_accuracy(final, jnp.asarray(x_test), jnp.asarray(t_test)))
-    metrics = {
-        "dataset": "covertype",
-        "nrows": nrows,
-        "nproc": nproc,
-        "nparticles": n_used,
-        "niter": niter,
-        "stepsize": stepsize,
-        "batch_size": batch,
-        "exchange": exchange,
-        "shard_data": shard_data,
-        "phi_impl": phi_impl,
-        "bandwidth": bandwidth,
-        "test_acc": acc,
-        "wall_s": round(wall, 3),
-        # throughput counts only the steps *this* process ran (resume skips
-        # the first `start` steps, so n_used·niter/wall would overstate it)
-        "steps_run": niter - start,
-        "resumed_from": start,
-        "updates_per_sec": round(n_used * max(niter - start, 0) / wall, 1)
-        if niter > start else 0.0,
-    }
-    return np.asarray(final), metrics
+    return _finish(final, wall, niter, start)
 
 
 @click.command()
@@ -291,20 +331,26 @@ def run(
               help="RBF bandwidth: a float (reference default 1.0), 'median' "
                    "(per-run heuristic), or 'median_step' (re-resolved from "
                    "the current particles every step, inside the scan)")
+@click.option("--exchange-every", type=int, default=1,
+              help="gather cadence T: T > 1 = lagged exchange (one all-gather "
+                   "per T steps, stale interactions with the live own block "
+                   "patched in; all_particles only, --nproc > 1, --niter a "
+                   "multiple of T, runs as one dispatch -- logging/"
+                   "checkpointing/profiling cadences are unsupported)")
 def cli(nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed, checkpoint_every, resume, log_every, profile_dir,
-        backend, phi_impl, bandwidth):
+        backend, phi_impl, bandwidth, exchange_every):
     select_backend(backend)
     results_dir = get_results_dir(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
-        shard_data, seed, phi_impl, bandwidth,
+        shard_data, seed, phi_impl, bandwidth, exchange_every,
     )
     ckpt_dir = results_dir + "-ckpt" if checkpoint_every else None
     final, metrics = run(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed, checkpoint_every, ckpt_dir, resume,
         log_every, os.path.join(results_dir, "metrics.jsonl") if log_every else None,
-        profile_dir, phi_impl, bandwidth,
+        profile_dir, phi_impl, bandwidth, exchange_every,
     )
     np.save(os.path.join(results_dir, "particles.npy"), final)
     with open(os.path.join(results_dir, "metrics.json"), "w") as fh:
