@@ -145,11 +145,23 @@ def run(
         }
         return np.asarray(final), metrics
 
-    if exchange_every > 1 and nproc == 1:
-        raise ValueError(
-            "--exchange-every > 1 is a distributed exchange cadence; "
-            "it requires --nproc > 1"
-        )
+    if exchange_every > 1:
+        if nproc == 1:
+            raise ValueError(
+                "--exchange-every > 1 is a distributed exchange cadence; "
+                "it requires --nproc > 1"
+            )
+        if checkpoint_every or resume or log_every or profile_dir:
+            raise ValueError(
+                "--exchange-every > 1 runs as one scanned dispatch; "
+                "checkpointing/logging/profiling cadences are "
+                "unsupported with it"
+            )
+        if niter % exchange_every:
+            raise ValueError(
+                f"--niter ({niter}) must be a multiple of "
+                f"--exchange-every ({exchange_every})"
+            )
     t0 = time.perf_counter()
     if nproc == 1:
         sampler = dt.Sampler(
@@ -182,17 +194,7 @@ def run(
             # steps and is driven exclusively through run_steps, so the
             # per-step event schedule below (make_step at log/ckpt points)
             # does not apply -- run the whole trajectory as one dispatch
-            if checkpoint_every or resume or log_every or profile_dir:
-                raise ValueError(
-                    "--exchange-every > 1 runs as one scanned dispatch; "
-                    "checkpointing/logging/profiling cadences are "
-                    "unsupported with it"
-                )
-            if niter % exchange_every:
-                raise ValueError(
-                    f"--niter ({niter}) must be a multiple of "
-                    f"--exchange-every ({exchange_every})"
-                )
+            # (argument validation happened before data load, top of run())
             state0 = sampler.state_dict()
             jax.block_until_ready(sampler.run_steps(niter, stepsize))  # compile
             sampler.load_state_dict(state0)
@@ -331,7 +333,7 @@ def run(
               help="RBF bandwidth: a float (reference default 1.0), 'median' "
                    "(per-run heuristic), or 'median_step' (re-resolved from "
                    "the current particles every step, inside the scan)")
-@click.option("--exchange-every", type=int, default=1,
+@click.option("--exchange-every", type=click.IntRange(1), default=1,
               help="gather cadence T: T > 1 = lagged exchange (one all-gather "
                    "per T steps, stale interactions with the live own block "
                    "patched in; all_particles only, --nproc > 1, --niter a "

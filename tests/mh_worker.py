@@ -72,6 +72,17 @@ def main():
     )
     save_local_rows(ring.run_steps(4, 0.1), f"ring_rows_{rank}.npy")
 
+    # --- lagged exchange (exchange_every): the mode exists precisely for
+    # multi-host meshes (one gather per T steps over DCN); run it in the
+    # real federation so its collective actually crosses the process
+    # boundary at every refresh
+    lag = dt.DistSampler(
+        mesh.size, lambda th, _: gmm_logp(th), None, particles,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False, exchange_every=2, mesh=mesh,
+    )
+    save_local_rows(lag.run_steps(4, 0.1), f"lagged_rows_{rank}.npy")
+
     # --- multi-host checkpoint/resume (VERDICT r1 item 7): save mid-run,
     # restore into a FRESH sampler in this same federation, finish, and
     # match the uninterrupted trajectory — with the W2 term on, so the

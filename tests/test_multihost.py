@@ -167,6 +167,20 @@ def test_two_process_federation_matches_oracle(tmp_path):
     want_p = np.asarray(ref_p.run_steps(4, 0.1))
     np.testing.assert_allclose(got_p, want_p, rtol=2e-6, atol=2e-7)
 
+    # lagged exchange across the process boundary (one gather per T=2 steps)
+    got_l = np.empty((n, d), dtype=np.float32)
+    for r in range(2):
+        start, count = np.load(tmp_path / f"range_{r}.npy")
+        got_l[start : start + count] = np.load(tmp_path / f"lagged_rows_{r}.npy")
+    ref_l = dt.DistSampler(
+        8, lambda th, _: gmm_logp(th), None, full,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False, exchange_every=2,
+        mesh=multihost.make_particle_mesh(8),
+    )
+    want_l = np.asarray(ref_l.run_steps(4, 0.1))
+    np.testing.assert_allclose(got_l, want_l, rtol=2e-6, atol=2e-7)
+
 
 def test_distsampler_runs_on_multihost_mesh():
     """The full driver recipe: build the granule-major mesh, assemble the global
