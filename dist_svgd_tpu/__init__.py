@@ -29,7 +29,7 @@ from dist_svgd_tpu.ops.kernels import (
     median_bandwidth_approx,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.0.1"  # matches pyproject.toml (reference packaging: setup.py v0.0.1)
 
 __all__ = [
     "Sampler",
