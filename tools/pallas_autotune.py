@@ -34,17 +34,21 @@ K = M = 10_000
 D = 3
 
 
-def timed(fn, x0, iters, reps=3):
-    """Chained scan timing with a trailing scalar fetch (bench.py protocol)."""
+def _make_run(fn, iters):
+    """One jitted length-``iters`` chained scan of ``fn`` — the shared step
+    wrapper of :func:`timed` and :func:`timed_group`."""
 
     @jax.jit
     def run(x):
-        def body(c, i):
-            return fn(c), None
-
-        out, _ = lax.scan(body, x, jnp.arange(iters))
+        out, _ = lax.scan(lambda c, i: (fn(c), None), x, jnp.arange(iters))
         return out
 
+    return run
+
+
+def timed(fn, x0, iters, reps=3):
+    """Chained scan timing with a trailing scalar fetch (bench.py protocol)."""
+    run = _make_run(fn, iters)
     np.asarray(run(x0))
     t0 = time.perf_counter()
     out = x0
@@ -52,6 +56,32 @@ def timed(fn, x0, iters, reps=3):
         out = run(out)
     np.asarray(out).ravel()[0]
     return (time.perf_counter() - t0) / (reps * iters)
+
+
+def timed_group(named_fns, x0, iters, samples=3):
+    """Interleaved min-of-samples timing of several step functions.
+
+    Two artifacts make naive A-then-B subtractions lie on the shared pool
+    (docs/notes.md timing protocol): session drift (a no-exp ablation once
+    printed a *negative* exp share that way), and an **idle-credit burst**
+    — the first dispatch sequence after any pause runs ~35% faster than
+    the sustained rate, so whichever variant is timed first wins for free.
+    Interleave the variants, and inside each sample run each program once
+    untimed immediately before its timed run, so every number is the
+    sustained rate."""
+    runs = []
+    for name, fn in named_fns:
+        run = _make_run(fn, iters)
+        np.asarray(run(x0)).ravel()[0]  # compile, untimed
+        runs.append((name, run))
+    best = {name: float("inf") for name, _ in runs}
+    for _ in range(samples):
+        for name, run in runs:
+            np.asarray(run(x0)).ravel()[0]  # saturate: burn the idle credit
+            t0 = time.perf_counter()
+            np.asarray(run(x0)).ravel()[0]
+            best[name] = min(best[name], (time.perf_counter() - t0) / iters)
+    return best
 
 
 def exp_roofline(iters):
@@ -92,26 +122,36 @@ def sweep(y, x, s, iters):
     return results
 
 
-def _noexp_kernel(y_ref, xT_ref, xs_ref, o_ref, acc_ref, ksum_ref, *,
-                  d_true, block_m, m_true, nm):
-    """The small-d φ kernel with ``exp`` replaced by identity — identical
-    memory traffic, broadcasts, mask, and MXU contractions, so
-    (T_full − T_noexp) isolates the VPU exp cost."""
+def _noexp_kernel(y_ref, xT_ref, xsT_ref, o_ref, acc_ref, ksum_ref, *,
+                  d_true, m_true, nm, d2_cap):
+    """The current small-d φ kernel (per-dim VPU broadcasts and drive,
+    ops/pallas_svgd.py:_phi_kernel_small_d) with ``exp`` replaced by
+    identity — same traffic and arithmetic otherwise (incl. the mask-free
+    sentinel padding: without the exp the sentinel columns feed huge-but-
+    finite garbage into the sums, which is timing-equivalent; a masked
+    variant measured *slower than the full kernel* — the iota/compare/
+    select cost exceeds the exp's, which is why the production kernel is
+    sentinel-padded), so (T_full − T_noexp) isolates the VPU exp cost.
+    Output values are garbage — timing only."""
     from jax.experimental import pallas as pl
 
     j = pl.program_id(1)
     y = y_ref[:]
     xT = xT_ref[:]
-    xs = xs_ref[:]
+    xsT = xsT_ref[:]
     d2 = None
     for c in range(d_true):
         diff = y[:, c:c + 1] - xT[c:c + 1, :]
         d2 = diff * diff if d2 is None else d2 + diff * diff
-    kt = -d2  # exp elided
-    col = jax.lax.broadcasted_iota(jnp.int32, kt.shape, dimension=1)
-    kt = jnp.where(col + j * block_m < m_true, kt, 0.0)
-    contrib = jnp.dot(kt, xs, preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST)
+    kt = -jnp.minimum(d2, d2_cap)  # exp elided (production clamp kept)
+    cols = [
+        jnp.sum(kt * xsT[c:c + 1, :], axis=1, keepdims=True)
+        for c in range(d_true)
+    ]
+    pad = y.shape[1] - d_true
+    contrib = jnp.concatenate(
+        cols + [jnp.zeros((y.shape[0], pad), jnp.float32)], axis=1
+    )
     rowsum = jnp.sum(kt, axis=1, keepdims=True)
 
     @pl.when(j == 0)
@@ -134,7 +174,9 @@ def phi_noexp(y, x, s, bk, bm):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    from dist_svgd_tpu.ops.pallas_svgd import SMALL_D, _pad_to, _round_up
+    from dist_svgd_tpu.ops.pallas_svgd import (
+        _D2_CAP, _FAR, SMALL_D, _pad_to, _round_up,
+    )
 
     k, d = y.shape
     m = x.shape[0]
@@ -142,10 +184,11 @@ def phi_noexp(y, x, s, bk, bm):
     dp = 128
     f32 = jnp.float32
     yp = _pad_to(y.astype(f32), kp, dp)
-    xs = _pad_to(s.astype(f32) - 2.0 * x.astype(f32), mp, dp)
-    xT = _pad_to(x.T.astype(f32), SMALL_D, mp)
+    xsT = _pad_to((s.astype(f32) - 2.0 * x.astype(f32)).T, SMALL_D, mp)
+    xT = _pad_to(x.T.astype(f32), SMALL_D, mp, value=_FAR)  # production sentinel
     nk, nm = kp // bk, mp // bm
-    kern = functools.partial(_noexp_kernel, d_true=d, block_m=bm, m_true=m, nm=nm)
+    kern = functools.partial(_noexp_kernel, d_true=d, m_true=m, nm=nm,
+                             d2_cap=_D2_CAP)
     out = pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((kp, dp), f32),
@@ -153,11 +196,11 @@ def phi_noexp(y, x, s, bk, bm):
         in_specs=[
             pl.BlockSpec((bk, dp), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm, dp), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((bk, dp), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((bk, dp), f32), pltpu.VMEM((bk, 128), f32)],
-    )(yp, xT, xs)
+    )(yp, xT, xsT)
     return out[:k, :d]
 
 
@@ -191,14 +234,16 @@ def main():
 
     eps = jnp.float32(1e-6)
     bk = bm = 1024
-    t_full = timed(lambda c: c + eps * phi_pallas(c, x, s, block_k=bk, block_m=bm),
-                   y, args.iters)
-    t_noexp = timed(lambda c: c + eps * phi_noexp(c, x, s, bk, bm), y, args.iters)
-    t_bf16 = timed(
-        lambda c: c + eps * phi_pallas(c, x, s, block_k=bk, block_m=bm,
-                                       gram_dtype=jnp.bfloat16),
-        y, args.iters,
-    )
+    best = timed_group([
+        ("full", lambda c: c + eps * phi_pallas(c, x, s, block_k=bk, block_m=bm)),
+        # clip: the exp-free output contains huge sentinel garbage, and
+        # feeding it back unclipped drives the chain into inf/NaN slow
+        # paths that dominate the timing
+        ("noexp", lambda c: c + eps * jnp.clip(phi_noexp(c, x, s, bk, bm), -1.0, 1.0)),
+        ("bf16", lambda c: c + eps * phi_pallas(c, x, s, block_k=bk, block_m=bm,
+                                                gram_dtype=jnp.bfloat16)),
+    ], y, args.iters)
+    t_full, t_noexp, t_bf16 = best["full"], best["noexp"], best["bf16"]
     print()
     print(f"φ full f32  (1024²): {t_full*1e3:7.3f} ms  ({K*M/t_full/1e9:6.1f} G pairs/s)")
     print(f"φ no-exp    (1024²): {t_noexp*1e3:7.3f} ms  → exp share ≈ "
