@@ -26,7 +26,7 @@ from dist_svgd_tpu.utils.platform import select_backend
 
 def get_results_dir(
     dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size,
-    exchange, seed, bandwidth="1.0", phi_impl="auto",
+    exchange, seed, bandwidth="1.0", phi_impl="auto", exchange_every=1,
 ):
     """Config-encoded results dir — every CLI knob that changes the run is in
     the name, so sweep configurations never overwrite each other (reference
@@ -41,6 +41,8 @@ def get_results_dir(
         name += f"-h={bandwidth}"
     if phi_impl != "auto":
         name += f"-phi={phi_impl}"
+    if exchange_every != 1:
+        name += f"-T={exchange_every}"
     path = os.path.join(RESULTS_DIR, name)
     os.makedirs(path, exist_ok=True)
     return path
@@ -76,6 +78,7 @@ def run(
     seed=0,
     bandwidth="1.0",
     phi_impl="auto",
+    exchange_every=1,
 ):
     """Train; returns (final_particles, metrics dict)."""
     import jax
@@ -84,6 +87,19 @@ def run(
     import dist_svgd_tpu as dt
     from dist_svgd_tpu.models import bnn
     from dist_svgd_tpu.utils.datasets import load_uci_regression
+
+    # pure-argument validation before any data load (as covertype.py)
+    if exchange_every > 1:
+        if nproc == 1:
+            raise ValueError(
+                "--exchange-every > 1 is a distributed exchange cadence; "
+                "it requires --nproc > 1"
+            )
+        if niter % exchange_every:
+            raise ValueError(
+                f"--niter ({niter}) must be a multiple of "
+                f"--exchange-every ({exchange_every})"
+            )
 
     sp = load_uci_regression(dataset, split, data_path=DATA_DIR)
     x_tr = jnp.asarray(sp.x_train)
@@ -121,6 +137,7 @@ def run(
             batch_size=batch,
             log_prior=prior,
             phi_impl=phi_impl,
+            exchange_every=exchange_every,
             seed=seed,
         )
         sampler.run_steps(niter, stepsize)  # one scanned dispatch
@@ -152,6 +169,7 @@ def run(
         "exchange": exchange,
         "bandwidth": bandwidth,
         "phi_impl": phi_impl,
+        "exchange_every": exchange_every,
         "resolved_bandwidth": (
             sampler._kernel.bandwidth
             if hasattr(sampler._kernel, "bandwidth") else None
@@ -186,16 +204,19 @@ def run(
 @click.option("--phi-impl", type=click.Choice(["auto", "xla", "pallas", "pallas_bf16"]),
               default="auto",
               help="phi backend (ops/pallas_svgd.py:resolve_phi_fn)")
+@click.option("--exchange-every", type=click.IntRange(1), default=1,
+              help="gather cadence T: T > 1 = lagged exchange (all_particles "
+                   "only, --nproc > 1, --niter a multiple of T)")
 def cli(dataset, split, nproc, nparticles, n_hidden, niter, stepsize, batch_size,
-        exchange, seed, bandwidth, backend, phi_impl):
+        exchange, seed, bandwidth, backend, phi_impl, exchange_every):
     select_backend(backend)
     final, metrics = run(
         dataset, split, nproc, nparticles, n_hidden, niter, stepsize,
-        batch_size, exchange, seed, bandwidth, phi_impl,
+        batch_size, exchange, seed, bandwidth, phi_impl, exchange_every,
     )
     results_dir = get_results_dir(
         dataset, split, nproc, nparticles, n_hidden, niter, stepsize,
-        batch_size, exchange, seed, bandwidth, phi_impl,
+        batch_size, exchange, seed, bandwidth, phi_impl, exchange_every,
     )
     np.save(os.path.join(results_dir, "particles.npy"), final)
     with open(os.path.join(results_dir, "metrics.json"), "w") as fh:
