@@ -95,6 +95,10 @@ def run(
                 "--exchange-every > 1 is a distributed exchange cadence; "
                 "it requires --nproc > 1"
             )
+        if exchange != "all_particles":
+            raise ValueError(
+                "--exchange-every > 1 requires --exchange all_particles"
+            )
         if niter % exchange_every:
             raise ValueError(
                 f"--niter ({niter}) must be a multiple of "

@@ -1,0 +1,67 @@
+"""Reproduce the 100k/1M-particle single-chip rows of docs/notes.md.
+
+Runs the full fused sampler step (Pallas φ + ``vmap(grad)`` banana scores)
+at large n on one chip, where the kernel's VMEM tile streaming is the whole
+story: the n² Gram matrix (4 TB f32 at n=1M) never exists.  Timing per the
+repo protocol: chained scanned dispatches, scalar-fetch fenced, best of
+``--samples``.
+
+Usage: ``python tools/large_n.py [--n 100000] [--steps 10] [--samples 3]``
+(n=1M takes ~6 s/step — budget a minute per sample).
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import dist_svgd_tpu as dt
+from dist_svgd_tpu.models.logreg import make_logreg_logp
+from dist_svgd_tpu.utils.datasets import load_benchmark
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--steps", type=int, default=10,
+                    help="steps per timed dispatch")
+    ap.add_argument("--samples", type=int, default=3)
+    args = ap.parse_args()
+
+    print("devices:", jax.devices(), flush=True)
+    fold = load_benchmark("banana", 42)
+    logp = make_logreg_logp(fold.x_train, fold.t_train.reshape(-1))
+    d = 1 + fold.x_train.shape[1]
+    n = args.n
+    sampler = dt.Sampler(d, logp)
+
+    def run_once(parts):
+        out, _ = sampler.run(
+            n, args.steps, 3e-3, record=False, initial_particles=parts
+        )
+        return out
+
+    parts = jax.random.normal(jax.random.PRNGKey(0), (n, d), dtype=jnp.float32)
+    out = run_once(parts)
+    np.asarray(out)[0, 0]  # compile + fence, untimed
+    best = float("inf")
+    for _ in range(args.samples):
+        t0 = time.perf_counter()
+        out = run_once(out)  # state-chained: no dispatch can be elided
+        np.asarray(out)[0, 0]
+        best = min(best, (time.perf_counter() - t0) / args.steps)
+    print(
+        f"n={n}: {best*1e3:.1f} ms/step  "
+        f"({n*n/best/1e9:.0f} G pairs/s, {n/best/1e6:.2f}M updates/s)",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,9 @@ from dist_svgd_tpu.ops.svgd import phi
 K = M = 10_000
 D = 3
 
+# --big-d: the covertype per-lane φ shape (docs/notes.md big-d section)
+BIG_K, BIG_M, BIG_D = 1250, 10_000, 55
+
 
 def _make_run(fn, iters):
     """One jitted length-``iters`` chained scan of ``fn`` — the shared step
@@ -204,21 +207,66 @@ def phi_noexp(y, x, s, bk, bm):
     return out[:k, :d]
 
 
-def f64_oracle_phi(y, x, s):
+def f64_oracle_phi(y, x, s, h=1.0):
     """Loopless f64 numpy φ for error budgets."""
     y64, x64, s64 = (np.asarray(a, np.float64) for a in (y, x, s))
     d2 = ((y64[:, None, :] - x64[None, :, :]) ** 2).sum(-1)
-    kt = np.exp(-d2)
+    kt = np.exp(-d2 / h)
     drive = kt @ s64
-    repulse = 2.0 * (y64 * kt.sum(1)[:, None] - kt @ x64)
+    repulse = (2.0 / h) * (y64 * kt.sum(1)[:, None] - kt @ x64)
     return (drive + repulse) / x64.shape[0]
+
+
+def big_d(iters):
+    """Big-d kernel measurements at the covertype per-lane shape: tile A/B
+    (256² round-1 default vs the 256×1024 asymmetric default) and the
+    bf16x3 fast tier, incumbents timed first (docs/notes.md protocol), plus
+    both error budgets vs the f64 oracle at a median-scale bandwidth."""
+    rng = np.random.default_rng(0)
+    y = jnp.asarray(rng.normal(size=(BIG_K, BIG_D)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(BIG_M, BIG_D)), jnp.float32)
+    s = jnp.asarray(rng.normal(size=(BIG_M, BIG_D)), jnp.float32)
+    h = float(2 * BIG_D)  # median-scale: h=1 underflows every kernel value
+    eps = jnp.float32(1e-6)
+
+    best = timed_group([
+        ("f32 256x256 (round-1 default)",
+         lambda c: c + eps * phi_pallas(c, x, s, bandwidth=h,
+                                        block_k=256, block_m=256)),
+        ("f32 256x1024 (current default)",
+         lambda c: c + eps * phi_pallas(c, x, s, bandwidth=h)),
+        ("bf16x3 default tiles",
+         lambda c: c + eps * phi_pallas(c, x, s, bandwidth=h,
+                                        gram_dtype=jnp.bfloat16)),
+        ("XLA fused",
+         lambda c: c + eps * phi(c, x, s, RBF(h))),
+    ], y, iters)
+    print(f"\nbig-d φ at ({BIG_K}, {BIG_M}, {BIG_D}), h={h}:")
+    for name, t in best.items():
+        print(f"  {name:32s} {t*1e3:7.3f} ms  "
+              f"({BIG_K*BIG_M/t/1e9:6.1f} G pairs/s)", flush=True)
+
+    sub = 200  # the full (1250, 10000, 55) f64 broadcast is ~5 GB transient
+    want = f64_oracle_phi(y[:sub], x, s, h=h)
+    scale = np.abs(want).max()
+    for name, gd in [("f32", None), ("bf16x3", jnp.bfloat16)]:
+        got = np.asarray(phi_pallas(y[:sub], x, s, bandwidth=h, gram_dtype=gd))
+        print(f"  max |φ_{name} − φ_f64| / max|φ| : "
+              f"{np.abs(got - want).max()/scale:.2e}", flush=True)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--skip-sweep", action="store_true")
+    ap.add_argument("--big-d", action="store_true",
+                    help="measure the big-d (covertype-shape) kernel instead "
+                         "of the small-d north star")
     args = ap.parse_args()
+
+    if args.big_d:
+        big_d(args.iters)
+        return
 
     rng = np.random.default_rng(0)
     y = jnp.asarray(rng.normal(size=(K, D)), jnp.float32)

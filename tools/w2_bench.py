@@ -1,0 +1,75 @@
+"""Reproduce the Sinkhorn-W2 cost table of docs/notes.md.
+
+Measures the scanned W2 trajectory (``DistSampler.run_steps`` with the
+carried-snapshot Sinkhorn term) at a given particle count, comparing the
+fixed-iteration-count loop against the adaptive ``sinkhorn_tol`` exit —
+the configuration pair behind the "438 → 186 → 74.5 ms/step" history in
+the notes (the absolute numbers shift with the shared pool's state; the
+ratios are the point).  Incumbent (fixed-count) timed first, so the
+adaptive challenger must beat the pool's idle-credit bias
+(docs/notes.md timing protocol).
+
+Usage: ``python tools/w2_bench.py [--n 10000] [--iters-per-dispatch 50]``.
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import dist_svgd_tpu as dt
+from dist_svgd_tpu.models.logreg import logreg_logp
+from dist_svgd_tpu.utils.datasets import load_benchmark
+from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10_000)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--iters-per-dispatch", type=int, default=50)
+    ap.add_argument("--sinkhorn-iters", type=int, default=200)
+    ap.add_argument("--samples", type=int, default=3)
+    args = ap.parse_args()
+
+    print("devices:", jax.devices(), flush=True)
+    fold = load_benchmark("banana", 42)
+    data = (jnp.asarray(fold.x_train), jnp.asarray(fold.t_train.reshape(-1)))
+    d = 1 + fold.x_train.shape[1]
+    K = args.iters_per_dispatch
+
+    def bench(tol, label):
+        parts = init_particles_per_shard(0, args.n, d, args.shards)
+        s = dt.DistSampler(
+            args.shards, logreg_logp, None, parts, data=data,
+            exchange_particles=True, exchange_scores=False,
+            include_wasserstein=True, wasserstein_solver="sinkhorn",
+            sinkhorn_iters=args.sinkhorn_iters, sinkhorn_tol=tol,
+        )
+        out = s.run_steps(K, 3e-3, h=10.0)
+        np.asarray(out)[0, 0]  # compile + fence, untimed
+        best = float("inf")
+        for _ in range(args.samples):
+            t0 = time.perf_counter()
+            out = s.run_steps(K, 3e-3, h=10.0)  # state-chained
+            np.asarray(out)[0, 0]
+            best = min(best, (time.perf_counter() - t0) / K)
+        print(f"{label:46s} {best*1e3:8.2f} ms/step", flush=True)
+        return best, np.asarray(s.particles)
+
+    t_fixed, traj_fixed = bench(
+        None, f"W2 fixed {args.sinkhorn_iters} iters (incumbent)"
+    )
+    t_tol, traj_tol = bench(1e-2, "W2 sinkhorn_tol=1e-2 (DistSampler default)")
+    print(f"speedup {t_fixed/t_tol:.2f}x; max final-particle deviation "
+          f"{np.max(np.abs(traj_fixed - traj_tol)):.2e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
