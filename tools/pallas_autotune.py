@@ -10,7 +10,8 @@ Three measurements at the north-star shape (k, m, d) = (10k, 10k, 3):
 3. **bf16-Gram variant**: φ with the Gram tile cast to bf16 before the MXU
    contraction — error budget vs the f64 numpy oracle and speed delta.
 
-Usage: ``python tools/pallas_autotune.py [--iters 50]``.
+Usage: ``python tools/pallas_autotune.py [--iters 50]``; add ``--big-d``
+for the covertype-shape big-d kernel table (tiles + bf16x3 + error budget).
 """
 
 import argparse
