@@ -105,7 +105,7 @@ def _timed_chain(fn, reps=2, samples=3):
     return best
 
 
-def _make_sharded(fold, phi_impl="auto"):
+def _make_sharded(fold, phi_impl="auto", wasserstein=False):
     import jax.numpy as jnp
 
     import dist_svgd_tpu as dt
@@ -118,7 +118,8 @@ def _make_sharded(fold, phi_impl="auto"):
     return dt.DistSampler(
         NUM_SHARDS, logreg_logp, None, particles, data=data,
         exchange_particles=True, exchange_scores=False,
-        include_wasserstein=False, phi_impl=phi_impl,
+        include_wasserstein=wasserstein, wasserstein_solver="sinkhorn",
+        phi_impl=phi_impl,
     )
 
 
@@ -213,6 +214,20 @@ def main():
         bf16_wall = _timed_chain(lambda: sharded16.run_steps(n_iters, 3e-3))
         bf16_ups = N_PARTICLES * n_iters / bf16_wall
 
+    # --- the reference's flagship optional term: --wasserstein (JKO) ------
+    # (dsvgd/distsampler.py:103-129).  Scanned Sinkhorn path with the
+    # warm-started duals (carried g in the scan state); 100 iters is enough
+    # to time a per-step cost that is ~25x the plain step's.  TPU only —
+    # the CPU fallback would time the backend, not the framework
+    w2_ups = w2_ms = None
+    if platform == "tpu":
+        w2_iters = 100
+        w2 = _make_sharded(fold, wasserstein=True)
+        _fence(w2.run_steps(w2_iters, 3e-3, h=10.0))  # compile, untimed
+        w2_wall = _timed_chain(lambda: w2.run_steps(w2_iters, 3e-3, h=10.0))
+        w2_ups = N_PARTICLES * w2_iters / w2_wall
+        w2_ms = w2_wall / w2_iters * 1e3
+
     # --- context: single-device unsharded step ---------------------------
     # reps chain through initial_particles so each run depends on the
     # previous one's output (_timed_chain's precondition: no rep can be
@@ -258,6 +273,8 @@ def main():
         "emulated_shards": len(devs) < NUM_SHARDS,
         "wall_s": round(wall, 3),
         "sharded_bf16_updates_per_sec": None if bf16_ups is None else round(bf16_ups, 1),
+        "w2_sinkhorn_updates_per_sec": None if w2_ups is None else round(w2_ups, 1),
+        "w2_sinkhorn_ms_per_step": None if w2_ms is None else round(w2_ms, 2),
         "single_device_updates_per_sec": round(single_ups, 1),
         "single_device_wall_s": round(single_wall, 3),
         "ref_headline_config_wall_s": round(small_wall, 3),

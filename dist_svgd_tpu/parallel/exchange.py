@@ -507,6 +507,7 @@ def make_shard_step_sinkhorn_w2(
     sinkhorn_eps: float = 0.05,
     sinkhorn_iters: int = 200,
     sinkhorn_tol: Optional[float] = None,
+    sinkhorn_warm_start: bool = True,
 ) -> Callable:
     """Per-shard SVGD step with the Wasserstein/JKO term computed **inside
     the step** from carried previous-snapshot state, so whole W2 trajectories
@@ -526,11 +527,27 @@ def make_shard_step_sinkhorn_w2(
     Gather implementation only: the exchanged-mode snapshot *is* the gathered
     set, which the ring implementation exists to avoid materialising.
 
-    Returns ``step(block, prev, data, t, key, step_size, h, w_on) ->
-    (new_block, new_prev)`` where ``prev``/``new_prev`` carry a leading
-    length-1 axis (the per-shard slice of the global ``(S, ., d)`` snapshot
-    stack) and ``w_on`` is 0.0 on a first-ever step (reference: no W2 until a
-    previous snapshot exists, dsvgd/distsampler.py:186-188) and 1.0 after.
+    Returns ``step(block, prev, g_dual, data, t, key, step_size, h, w_on) ->
+    (new_block, new_prev, new_g)`` where ``prev``/``new_prev`` and
+    ``g_dual``/``new_g`` carry a leading length-1 axis (the per-shard slice
+    of the global ``(S, ., d)`` snapshot / ``(S, .)`` dual stacks) and
+    ``w_on`` is 0.0 on a first-ever step (reference: no W2 until a previous
+    snapshot exists, dsvgd/distsampler.py:186-188) and 1.0 after.
+
+    ``g_dual`` is the previous step's Sinkhorn dual potential ``g``, fed as
+    the next solve's warm start (:func:`dist_svgd_tpu.ops.ot.sinkhorn_plan`:
+    particles move O(ε·φ) per step, so the carried ``g`` is near-optimal and
+    the ``tol`` exit terminates in a block or two).  The pairing each shard's
+    solve works on — its own evolving block against a fixed logical
+    snapshot slot (the mixed gathered snapshot in exchanged modes; block
+    ``(b+1) mod S``'s snapshot in ``partitions``, via the per-step
+    ``ppermute`` roll) — is the *same* every step, so the carried ``g``
+    always describes the measure it will warm-start against.  On a
+    ``w_on == 0`` step the solve's output duals are zeroed, so the first
+    real solve cold-starts instead of inheriting potentials fitted to the
+    zeros placeholder snapshot.  ``sinkhorn_warm_start=False`` restores the
+    cold c-transform start on every step (the A/B baseline —
+    tools/w2_bench.py).
     """
     from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
 
@@ -541,16 +558,19 @@ def make_shard_step_sinkhorn_w2(
     # prev_for[b] = previous[(b+1) % S]  (np.roll(prev, -1) device-side)
     roll_perm = [(j, (j - 1) % num_shards) for j in range(num_shards)]
 
-    def step(block, prev, data, t, key, step_size, h, w_on):
+    def step(block, prev, g_dual, data, t, key, step_size, h, w_on):
         prev = prev[0]
         if mode == PARTITIONS and num_shards > 1:
             prev_for = lax.ppermute(prev, AXIS, roll_perm)
         else:
             prev_for = prev
-        w_grad = w_on * wasserstein_grad_sinkhorn(
+        w_grad, g_out = wasserstein_grad_sinkhorn(
             block, prev_for, eps=sinkhorn_eps, iters=sinkhorn_iters,
             tol=sinkhorn_tol,
+            g_init=g_dual[0] if sinkhorn_warm_start else None,
+            return_g=True,
         )
+        w_grad = w_on * w_grad
         delta, interacting = core(block, data, t, key)
         new = block + step_size * (delta + h * w_grad)
         if mode == PARTITIONS:
@@ -560,6 +580,6 @@ def make_shard_step_sinkhorn_w2(
             new_prev = lax.dynamic_update_slice_in_dim(
                 interacting, new, r * block.shape[0], axis=0
             )
-        return new, new_prev[None]
+        return new, new_prev[None], (w_on * g_out)[None]
 
     return step

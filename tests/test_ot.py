@@ -114,6 +114,66 @@ def test_sinkhorn_outlier_row_keeps_its_mass(rng, tol):
     assert np.all(grad[0] > 0.5)
 
 
+def test_sinkhorn_warm_start_zero_matches_cold_at_convergence(rng):
+    """g_init of zeros (soft-c-transform start) and the default cold start
+    (hard-c-transform start) converge to the same plan — different inits,
+    one fixpoint."""
+    x = jnp.asarray(rng.normal(size=(6, 2)))
+    y = jnp.asarray(rng.normal(size=(5, 2)))
+    cold = np.asarray(sinkhorn_plan(x, y, eps=0.05, iters=500))
+    warm = np.asarray(
+        sinkhorn_plan(x, y, eps=0.05, iters=500, g_init=jnp.zeros(5))
+    )
+    np.testing.assert_allclose(cold, warm, atol=1e-7)
+
+
+def test_sinkhorn_warm_start_from_optimum_converges_immediately(rng):
+    """Warm-starting from a converged solve's own g reproduces that solve's
+    plan under the tol exit — the carried dual is a fixpoint, so the exit
+    fires on the first block."""
+    x = jnp.asarray(rng.normal(size=(8, 2)))
+    y = jnp.asarray(rng.normal(size=(6, 2)) + 0.4)
+    full, (_, g) = sinkhorn_plan(
+        x, y, eps=0.05, iters=2000, return_potentials=True
+    )
+    warm = np.asarray(
+        sinkhorn_plan(x, y, eps=0.05, iters=2000, tol=1e-6, g_init=g)
+    )
+    np.testing.assert_allclose(warm, np.asarray(full), atol=1e-6)
+
+
+def test_sinkhorn_warm_start_garbage_init_is_safe(rng):
+    """Any g_init — however wrong — yields a finite plan with correct
+    marginals: after the soft c-transform f0 update, every row of the
+    initial kernel exp((f0+g0−C)/reg) sums to exactly its marginal, so no
+    row can start underflowed (the soft-form analog of the cold start's
+    max-pinned-at-zero guarantee).  Uses the outlier configuration that
+    kills a zero-init run."""
+    x = np.asarray(rng.normal(size=(64, 2)))
+    x[0] = 40.0
+    y = jnp.asarray(rng.normal(size=(32, 2)))
+    garbage = jnp.asarray(rng.normal(size=32) * 1e6)
+    plan = np.asarray(
+        sinkhorn_plan(jnp.asarray(x), y, eps=0.01, iters=400, tol=1e-2,
+                      g_init=garbage)
+    )
+    assert np.all(np.isfinite(plan))
+    np.testing.assert_allclose(plan.sum(axis=1), np.full(64, 1 / 64), atol=1e-4)
+    np.testing.assert_allclose(plan.sum(axis=0), np.full(32, 1 / 32), atol=1e-4)
+
+
+def test_grad_sinkhorn_return_g_roundtrip(rng):
+    """return_g=True returns the dual that, fed back as g_init, reproduces
+    the gradient (the production warm-start loop's invariant)."""
+    x = jnp.asarray(rng.normal(size=(7, 3)))
+    y = jnp.asarray(rng.normal(size=(7, 3)) + 0.2)
+    grad, g = wasserstein_grad_sinkhorn(x, y, eps=0.05, iters=500, return_g=True)
+    again = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=500, tol=1e-6, g_init=g
+    )
+    np.testing.assert_allclose(np.asarray(again), np.asarray(grad), atol=1e-6)
+
+
 def test_sinkhorn_tol_respects_iteration_cap(rng):
     """tol far below reachable precision: the iters bound still terminates
     the loop and the result equals the fixed-count plan."""
