@@ -25,10 +25,13 @@ experiments/logreg_plots.py:37-57) and reports ``steps_to_target_acc`` /
 ``wall_to_target_acc_s``.  Compile time is excluded by warming the scan,
 then resetting the sampler state via ``state_dict``/``load_state_dict``.
 
-Timing is the best of 3 fenced samples, each the mean of 2 state-chained
-scan runs under one trailing fetch (the TPU pool behind the tunnel has
-±40% session variance with within-session spikes; per-call eager timing is
-dispatch-bound and useless — docs/notes.md and ``_timed_chain``).
+Timing is the best of 3 fenced samples, each the mean wall of an
+adaptively-sized chain of state-chained scan runs under one trailing fetch
+(~1 s of device work per sample, so the tunnel's fixed ~0.1 s per-sample
+round trip amortises away — the round-3 protocol; the TPU pool behind the
+tunnel has ±40% session variance with within-session spikes, and per-call
+eager timing is round-trip-bound and useless — docs/notes.md and
+``_timed_chain``).
 """
 
 import json
@@ -82,18 +85,45 @@ def _fence(x):
     np.asarray(x)[0, 0]
 
 
-def _timed_chain(fn, reps=2, samples=3):
+#: Fixed per-fenced-sample tunnel round trip (dispatch RPC + scalar fetch),
+#: measured ~0.06–0.1 s on the axon relay regardless of workload size
+#: (tools/profile_step_floor.py: an empty 1000-iter scan and a single
+#: elementwise op cost the same ~95 ms when fenced individually).
+_TUNNEL_RT_S = 0.08
+
+
+def _timed_chain(fn, reps=None, samples=3, target_s=1.0):
     """Best (min) of ``samples`` fenced timings, each the mean wall of
     ``reps`` state-chained runs with one trailing fetch.
 
     ``fn()`` must return an array whose value depends on the previous call's
     output (e.g. ``run_steps`` advancing sampler state), so the runs execute
-    sequentially and cannot be elided; the per-sample fetch amortises the
-    ~0.1 s tunnel round-trip over its reps.  Taking the min across samples
+    sequentially and cannot be elided.  ``reps=None`` sizes the chain so
+    each sample does ~``target_s`` of estimated device work: the tunnel's
+    *fixed* per-sample round trip (~0.1 s — dispatch RPC + scalar fetch,
+    the same for an empty scan and a 500-step trajectory,
+    tools/profile_step_floor.py) then amortises away and the per-rep
+    number reflects sustained device throughput rather than RPC latency.
+    Round-2 measured a 100-iter small-config dispatch at "0.56 ms/step"
+    that this decomposition shows was ≥95% fixed round trip (the marginal
+    per-dispatch cost is ~0.2 ms, per-step compute ~2 µs at config-1
+    scale).  Chained dispatches pipeline through the relay, so a rep costs
+    its execution, not a fresh round trip.  Taking the min across samples
     discards transient slowdowns of the shared TPU pool (±40% between
     sessions, spikes within one — docs/notes.md); the reported number is
     the best *sustained* throughput, still honest because every sample is
     multi-run and fenced."""
+    if reps is None:
+        # min of 2 estimation runs: a pool spike during a single estimate
+        # would mis-size the chain for every sample (the same
+        # spike-rejection the timed samples get from min-of-3)
+        est = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            _fence(fn())
+            est = min(est, time.perf_counter() - t0)  # run + fixed round trip
+        marginal = max(est - _TUNNEL_RT_S, 2e-3)
+        reps = max(2, min(512, round(target_s / marginal)))
     best = float("inf")
     for _ in range(samples):
         t0 = time.perf_counter()

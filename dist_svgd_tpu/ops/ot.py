@@ -173,7 +173,8 @@ def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200,
         # soft transform of an optimal g IS the fixpoint, so the tol exit
         # fires on the first block.  Safety matches the cold start: after
         # the f0 update every row of exp((f0+g−C)/reg) sums to exactly
-        # m·a_i = 1, so no row can start underflowed for any g_init.
+        # its marginal a_i = 1/m, so no row can start underflowed for any
+        # g_init.
         gi = g_init.astype(dt)
         lse = jax.nn.logsumexp
         f0 = reg * jnp.log(a) - reg * lse((gi[None, :] - cost) / reg, axis=1)
