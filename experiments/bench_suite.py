@@ -160,17 +160,22 @@ def bench_covertype_minibatch(iters, num_shards=8, n_particles=10_000,
     data = (jnp.asarray(x), jnp.asarray(t))
     d = 1 + x.shape[1]
     particles = init_particles_per_shard(0, n_particles, d, num_shards)
+    # the covertype driver's phi policy, shared (experiments/covertype.py:
+    # resolve_phi_impl): bf16x3 only when minibatched + TPU + Gram-bound
+    from covertype import resolve_phi_impl
+
+    phi_impl = resolve_phi_impl("auto", batch_size, n_particles, num_shards)
     sampler = dt.DistSampler(
         num_shards, logreg_likelihood, None, particles, data=data,
         exchange_particles=True, exchange_scores=False,
         include_wasserstein=False, shard_data=True,
-        batch_size=batch_size, log_prior=logreg_prior,
+        batch_size=batch_size, log_prior=logreg_prior, phi_impl=phi_impl,
     )
     wall = _time_dist_steps(sampler, iters, 1e-4)
     return _result(
         "4:covertype-minibatch-10kp", sampler.num_particles, iters, wall,
         num_shards=num_shards, emulated=_emulated(num_shards),
-        n_rows=n_rows, batch_size=batch_size,
+        n_rows=n_rows, batch_size=batch_size, phi_impl=phi_impl,
     )
 
 

@@ -50,6 +50,37 @@ def get_results_dir(
     return path
 
 
+def resolve_phi_impl(phi_impl, batch_size, nparticles, nproc):
+    """The covertype driver's φ policy: ``'auto'`` resolves to the bf16x3
+    fast tier (``'pallas_bf16'``) when — and only when — all three hold:
+
+    (a) the run is minibatched: the stochastic score's sampling noise
+        (~6% per entry at the B=256/6250 default) is ~40× the bf16x3 φ
+        tier's 1.4e-3 max rel error, so the config accepts far more noise
+        by design than the tier adds (measured 1.53× end-to-end at
+        identical test accuracy — docs/notes.md round-3 covertype section);
+    (b) a TPU is the backend (elsewhere Pallas runs the interpreter);
+    (c) the per-shard interaction size is Gram-bound (the library's
+        ``PALLAS_MIN_PAIRS`` gate — below it XLA's fused program is faster
+        than either Pallas tier, so forcing one would pessimise
+        smoke-scale runs).
+
+    Shared by the CLI (which resolves *before* deriving results/checkpoint
+    dir names, so a resolved run always carries the ``-phi=pallas_bf16``
+    suffix and never collides with an exact-f32 ``auto`` run's dirs or
+    checkpoints) and by ``bench_suite`` config 4.  Full-batch runs and the
+    library-level ``'auto'`` stay exact f32.
+    """
+    if phi_impl != "auto" or not batch_size:
+        return phi_impl
+    from dist_svgd_tpu.ops.pallas_svgd import PALLAS_MIN_PAIRS, pallas_available
+
+    n = (nparticles // nproc) * nproc
+    if pallas_available() and (n // nproc) * n >= PALLAS_MIN_PAIRS:
+        return "pallas_bf16"
+    return phi_impl
+
+
 def run(
     nrows=50_000,
     nproc=8,
@@ -91,6 +122,12 @@ def run(
     from dist_svgd_tpu.models.logreg import ensemble_test_accuracy, make_logreg_split
     from dist_svgd_tpu.utils.datasets import load_covertype
     from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    # φ policy (see resolve_phi_impl): idempotent here for programmatic
+    # callers; the CLI already resolved before deriving dir names, so the
+    # default checkpoint_dir below is keyed by the *resolved* backend and a
+    # bf16x3 run can never silently resume an exact-f32 checkpoint
+    phi_impl = resolve_phi_impl(phi_impl, batch_size, nparticles, nproc)
 
     x, t = load_covertype(nrows, seed=0)
     n_test = max(nrows // 10, 1)
@@ -135,6 +172,10 @@ def run(
             "exchange_every": exchange_every,
             "test_acc": acc,
             "wall_s": round(wall, 3),
+            # the sharded paths pre-compile and reset the clock; the
+            # nproc==1 path times one fused run including its XLA compile —
+            # this flag keeps cross-mode wall_s comparisons honest
+            "compile_excluded": nproc > 1,
             # throughput counts only the steps *this* process ran (resume
             # skips the first `start` steps, so n_used*niter/wall would
             # overstate it)
@@ -326,9 +367,11 @@ def run(
 @click.option("--backend", type=click.Choice(["auto", "tpu", "cpu"]), default="auto")
 @click.option("--phi-impl", type=click.Choice(["auto", "xla", "pallas", "pallas_bf16"]),
               default="auto",
-              help="phi backend (ops/pallas_svgd.py:resolve_phi_fn); "
-                   "pallas_bf16 = bf16x3-matmul fast tier, ~1.15-1.3x at "
-                   "~1.4e-3 phi error (docs/notes.md)")
+              help="phi backend (ops/pallas_svgd.py:resolve_phi_fn). THIS "
+                   "DRIVER's 'auto' resolves to pallas_bf16 on TPU when "
+                   "minibatching (stochastic-score noise ~40x the bf16x3 "
+                   "phi error; measured 1.53x — docs/notes.md); pass --phi-"
+                   "impl xla/pallas for the exact-f32 paths")
 @click.option("--bandwidth", default="1.0",
               help="RBF bandwidth: a float (reference default 1.0), 'median' "
                    "(per-run heuristic), or 'median_step' (re-resolved from "
@@ -343,6 +386,9 @@ def cli(nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed, checkpoint_every, resume, log_every, profile_dir,
         backend, phi_impl, bandwidth, exchange_every):
     select_backend(backend)
+    # resolve BEFORE dir-name derivation: results and checkpoint dirs are
+    # keyed by the effective backend (resolve_phi_impl docstring)
+    phi_impl = resolve_phi_impl(phi_impl, batch_size, nparticles, nproc)
     results_dir = get_results_dir(
         nrows, nproc, nparticles, niter, stepsize, batch_size, exchange,
         shard_data, seed, phi_impl, bandwidth, exchange_every,
