@@ -210,6 +210,10 @@ _FAR = 6e18
 #: (f32 and bf16) for any h > ~3e-9.
 _D2_CAP = 1e30
 
+#: Scoped-VMEM stack budget for the big-d tile-fit estimate (the v5e limit
+#: is 16 MB; leave headroom for Mosaic's own temporaries).
+_VMEM_BUDGET = 14 * 1024 * 1024
+
 
 def _pad_to(a: jax.Array, rows: int, cols: int, value: float = 0.0) -> jax.Array:
     return jnp.pad(
@@ -244,7 +248,12 @@ def phi_pallas(
             10k-particle north star: 1024² runs 1.56 ms vs 2.0 ms at the
             old 512² default; 2048-wide k-tiles overflow VMEM) and
             256×1024 in the big-d variant (covertype-shape sweep —
-            docs/notes.md).  Auto-shrunk per axis to keep padding ≤ ~10%.
+            docs/notes.md).  Auto-shrunk per axis to keep padding ≤ ~10%,
+            and — for big-d axes left unset — further shrunk to fit the
+            scoped-VMEM stack budget (:data:`_VMEM_BUDGET`; e.g. 256×512
+            at dp=768, where the default tiles fail to compile on a v5e).
+            An explicitly passed block size is taken as-is and may
+            overflow VMEM at large d.
         interpret: run under the Pallas interpreter (CPU testing).
         gram_dtype: ``None`` (f32, exact — the default) or ``jnp.bfloat16``,
             the fast reduced-precision tier.  Big-d variant: both MXU
@@ -279,6 +288,28 @@ def phi_pallas(
         default_k, default_m = 256, 1024
     bk = min(block_k or _auto_block(k, default_k), _round_up(k, 8))
     bm = min(block_m or _auto_block(m, default_m), _round_up(m, 8))
+    fit_m, fit_k = block_m is None, block_k is None
+    if d > SMALL_D and (fit_m or fit_k):
+        # VMEM-fit auto-shrink: at large dp the default tiles overflow the
+        # ~16 MB scoped-VMEM stack (measured: 256×1024 tiles at dp=768
+        # fail to compile with a 19.4 MB scoped allocation on a v5e).
+        # Estimate the stack — double-buffered input tiles (y, x, xs),
+        # the (bk, bm) Gram/distance temporaries (~3 live copies), output
+        # and scratch — and halve the wide axis first (bm, whose width is
+        # a per-tile-overhead optimisation, not a reuse win) until it
+        # fits.  Only axes the caller left unset are shrunk (an explicit
+        # block size is an expert override); halved sizes re-round to the
+        # sublane multiple of 8 that every tile-size path here preserves.
+        dp_est = _round_up(d, 128)
+
+        def stack_bytes(bk_, bm_):
+            return 4 * (2 * dp_est * (bk_ + 2 * bm_) + 4 * bk_ * bm_
+                        + bk_ * (dp_est + 128))
+
+        while stack_bytes(bk, bm) > _VMEM_BUDGET and fit_m and bm > 256:
+            bm = _round_up(bm // 2, 8)
+        while stack_bytes(bk, bm) > _VMEM_BUDGET and fit_k and bk > 128:
+            bk = _round_up(bk // 2, 8)
     kp, mp = _round_up(k, bk), _round_up(m, bm)
     dp = _round_up(d, 128)
     inv_h = 1.0 / float(bandwidth)
@@ -369,12 +400,21 @@ def pallas_available() -> bool:
         return False
 
 
-#: In 'auto' mode, use the Pallas kernel only at/above this many pairwise
-#: interactions (k·m).  Below it the Gram tile pressure the kernel exists to
-#: relieve isn't the bottleneck and XLA's fusion wins (measured on a v5e:
-#: XLA 1.7 ms vs Pallas 2.4 ms at (500, 500, 753); Pallas ahead from ~2048²
-#: up — re-validated after the VPU-drive change, docs/notes.md).
+#: In 'auto' mode with a SMALL-d shape (d ≤ SMALL_D), use the Pallas kernel
+#: only at/above this many pairwise interactions (k·m).  Below it the Gram
+#: tile pressure the kernel exists to relieve isn't the bottleneck and XLA's
+#: fusion wins (measured on a v5e at d=3: XLA ahead at n = 512–2048, Pallas
+#: from ~4096² — re-validated after the VPU-drive change, docs/notes.md).
 PALLAS_MIN_PAIRS = 1 << 22
+
+#: 'auto' threshold for BIG-d shapes (d > SMALL_D), where the distance and
+#: drive contractions are genuine MXU matmuls and the kernel's 3-vs-6-pass
+#: advantage plus VMEM-resident Gram win at every measured size: round-3
+#: interleaved A/B at d=753 (sustained chains, not round-trip-polluted like
+#: the round-2 parity reading) measured Pallas f32 over XLA 1.37× at 256²,
+#: 1.12× at 500², 1.11× at 2000², 1.23× at 10k² — so the gate is only a
+#: guard against trivial shapes (docs/notes.md round-3 big-d section).
+PALLAS_MIN_PAIRS_BIG_D = 1 << 16
 
 #: On the XLA path, switch from the one-shot ``phi`` (whole (m, k) Gram in
 #: memory) to the both-axes-chunked ``phi_blockwise`` at/above this many
@@ -395,10 +435,12 @@ def resolve_phi_fn(kernel, phi_impl: str):
 
     Returns ``phi_fn(updated, interacting, scores)``:
 
-    - ``'auto'``   — on TPU with an RBF kernel, this Pallas kernel for
-      Gram-bound problem sizes (``k·m ≥ PALLAS_MIN_PAIRS``, a static
-      trace-time shape test) and the fused XLA program (ops/svgd.py:phi) for
-      small ones; plain XLA everywhere else;
+    - ``'auto'``   — on TPU with an RBF kernel, this Pallas kernel above a
+      static trace-time pair-count threshold (``PALLAS_MIN_PAIRS`` for
+      d ≤ SMALL_D where XLA wins small shapes; the near-always
+      ``PALLAS_MIN_PAIRS_BIG_D`` for larger d, where the kernel measured
+      faster at every size) and the fused XLA program (ops/svgd.py:phi)
+      below it; plain XLA everywhere else;
     - ``'xla'``    — always the XLA program;
     - ``'pallas'`` — force this kernel (requires RBF); off-TPU it runs under
       the Pallas interpreter — slow but exact, for CPU testing;
@@ -442,7 +484,9 @@ def resolve_phi_fn(kernel, phi_impl: str):
             bw = kernel.bandwidth
 
             def auto_fn(y, x, s):
-                if y.shape[0] * x.shape[0] >= PALLAS_MIN_PAIRS:
+                thresh = (PALLAS_MIN_PAIRS if y.shape[1] <= SMALL_D
+                          else PALLAS_MIN_PAIRS_BIG_D)
+                if y.shape[0] * x.shape[0] >= thresh:
                     return phi_pallas(y, x, s, bandwidth=bw)
                 return phi(y, x, s, kernel)
 

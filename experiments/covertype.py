@@ -60,10 +60,11 @@ def resolve_phi_impl(phi_impl, batch_size, nparticles, nproc):
         by design than the tier adds (measured 1.53× end-to-end at
         identical test accuracy — docs/notes.md round-3 covertype section);
     (b) a TPU is the backend (elsewhere Pallas runs the interpreter);
-    (c) the per-shard interaction size is Gram-bound (the library's
-        ``PALLAS_MIN_PAIRS`` gate — below it XLA's fused program is faster
-        than either Pallas tier, so forcing one would pessimise
-        smoke-scale runs).
+    (c) the per-shard interaction size clears the library's big-d auto
+        gate (``PALLAS_MIN_PAIRS_BIG_D`` — covertype's d=55 is a big-d
+        shape, where the Pallas tiers win at every measured size and the
+        gate only guards trivial smoke-scale shapes; docs/notes.md
+        round-3 big-d section).
 
     Shared by the CLI (which resolves *before* deriving results/checkpoint
     dir names, so a resolved run always carries the ``-phi=pallas_bf16``
@@ -73,10 +74,13 @@ def resolve_phi_impl(phi_impl, batch_size, nparticles, nproc):
     """
     if phi_impl != "auto" or not batch_size:
         return phi_impl
-    from dist_svgd_tpu.ops.pallas_svgd import PALLAS_MIN_PAIRS, pallas_available
+    from dist_svgd_tpu.ops.pallas_svgd import (
+        PALLAS_MIN_PAIRS_BIG_D,
+        pallas_available,
+    )
 
     n = (nparticles // nproc) * nproc
-    if pallas_available() and (n // nproc) * n >= PALLAS_MIN_PAIRS:
+    if pallas_available() and (n // nproc) * n >= PALLAS_MIN_PAIRS_BIG_D:
         return "pallas_bf16"
     return phi_impl
 
