@@ -21,9 +21,14 @@ The unsharded single-device number is reported alongside for context.
 The convergence half of the metric runs the same 10k-particle config until
 the ensemble posterior-predictive accuracy reaches the sklearn
 LogisticRegression baseline − 0.01 (the reference's acceptance comparison,
-experiments/logreg_plots.py:37-57) and reports ``steps_to_target_acc`` /
-``wall_to_target_acc_s``.  Compile time is excluded by warming the scan,
-then resetting the sampler state via ``state_dict``/``load_state_dict``.
+experiments/logreg_plots.py:37-57).  Round-3 protocol: per dataset
+(banana/diabetis/waveform), the stepsize is tuned on a held-out seed and
+the reported ``steps_to_target_acc_median`` / ``_spread`` aggregate five
+*different* seeds — per-dataset rows in ``convergence``, the way the
+reference's acceptance comparison is per-fold.  ``wall_to_target_acc_s``
+times the flagship (banana) median-step trajectory as pure scanned
+dispatches.  Compile time is excluded by warming the scan, then resetting
+the sampler state via ``state_dict``/``load_state_dict``.
 
 Timing is the best of 3 fenced samples, each the mean wall of an
 adaptively-sized chain of state-chained scan runs under one trailing fetch
@@ -45,16 +50,24 @@ N_ITERS = 500
 NUM_SHARDS = 8
 
 TARGET_ACC_MARGIN = 0.01   # target = sklearn baseline − margin
-CONV_STEP_SIZE = 0.3       # fastest measured stepsize for this config: the
-                           # deterministic seed-0 trajectory reaches target
-                           # at step 10 (0.1 → 55, 0.2 → 20, 0.5 → 20 —
-                           # stability margin on both sides)
 CONV_EVAL_EVERY = 5        # steps between accuracy checks (one scan program).
                            # The detection loop only finds S = steps-to-
                            # target; wall_to_target is then re-measured as
                            # S-step scanned dispatches with no eval fetches
                            # (pure trajectory cost, _timed_chain protocol)
 CONV_MAX_STEPS = 2_000
+
+# Robust convergence protocol (round 3): the round-2 metric was one tuned
+# seed-0 banana trajectory — a sampler regression hurting only other
+# seeds/folds would have passed.  Now: per dataset, the stepsize is chosen
+# on a TUNING seed (grid below, fewest steps wins) and the reported numbers
+# are the median/spread of steps-to-target over five DIFFERENT seeds, per
+# dataset — mirroring the reference's per-fold acceptance comparison
+# (experiments/logreg_plots.py:27-57).
+CONV_DATASETS = (("banana", 42), ("diabetis", 1), ("waveform", 1))
+CONV_TUNE_SEED = 0
+CONV_SEEDS = (1, 2, 3, 4, 5)
+CONV_STEP_GRID = (0.05, 0.1, 0.2, 0.3, 0.5)
 
 
 def _init_platform():
@@ -153,64 +166,130 @@ def _make_sharded(fold, phi_impl="auto", wasserstein=False):
     )
 
 
-def _steps_to_target(fold) -> dict:
-    """Run the north-star config until ensemble accuracy ≥ sklearn − margin."""
+def _steps_to_target(_fold_unused=None) -> dict:
+    """Median steps-to-target over :data:`CONV_SEEDS` × :data:`CONV_DATASETS`
+    on the north-star config, stepsize tuned per dataset on the held-out
+    :data:`CONV_TUNE_SEED` (module docstring / CONV_DATASETS comment)."""
+    import statistics
+
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from dist_svgd_tpu.models.logreg import ensemble_test_accuracy
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
 
     try:
         from sklearn.linear_model import LogisticRegression
     except ImportError:  # pragma: no cover
-        return {"steps_to_target_acc": None, "note": "sklearn unavailable"}
+        return {"steps_to_target_acc_median": None, "note": "sklearn unavailable"}
 
-    clf = LogisticRegression()
-    clf.fit(fold.x_train, fold.t_train.reshape(-1))
-    baseline = float(clf.score(fold.x_test, fold.t_test.reshape(-1)))
-    target = baseline - TARGET_ACC_MARGIN
+    per_dataset = {}
+    all_steps = []
+    banana = None  # (sampler, state_for, best_eps, median) for the wall row
+    for name, fold_idx in CONV_DATASETS:
+        fold = load_benchmark(name, fold_idx)
+        clf = LogisticRegression()
+        clf.fit(fold.x_train, fold.t_train.reshape(-1))
+        baseline = float(clf.score(fold.x_test, fold.t_test.reshape(-1)))
+        target = baseline - TARGET_ACC_MARGIN
 
-    x_test = jnp.asarray(fold.x_test)
-    t_test = jnp.asarray(fold.t_test.reshape(-1))
-    acc_fn = jax.jit(lambda p: ensemble_test_accuracy(p, x_test, t_test))
+        x_test = jnp.asarray(fold.x_test)
+        t_test = jnp.asarray(fold.t_test.reshape(-1))
+        acc_fn = jax.jit(lambda p: ensemble_test_accuracy(p, x_test, t_test))
+        sampler = _make_sharded(fold)
+        d = 1 + fold.x_train.shape[1]
 
-    sampler = _make_sharded(fold)
-    state0 = sampler.state_dict()
-    # warm: compiles the length-CONV_EVAL_EVERY scan and the accuracy eval,
-    # then reset to the initial state so the timed loop pays execution only
-    sampler.run_steps(CONV_EVAL_EVERY, CONV_STEP_SIZE)
-    float(acc_fn(sampler.particles))
-    sampler.load_state_dict(state0)
+        def state_for(seed, d=d):
+            # fresh per-seed init through the resume path: one sampler (and
+            # one compiled scan program) serves every seed and stepsize.
+            # d bound by default arg: this closure escapes the dataset loop
+            # (the banana wall row below) and must not see a later d
+            return {
+                "particles": np.asarray(
+                    init_particles_per_shard(seed, N_PARTICLES, d, NUM_SHARDS)
+                ),
+                "t": 0,
+            }
 
-    steps = 0
-    acc = float(acc_fn(sampler.particles))
-    while steps < CONV_MAX_STEPS:
-        sampler.run_steps(CONV_EVAL_EVERY, CONV_STEP_SIZE)
-        steps += CONV_EVAL_EVERY
-        acc = float(acc_fn(sampler.particles))
-        if acc >= target:
-            break
-    reached = acc >= target
+        def run_to_target(seed, eps, max_steps=CONV_MAX_STEPS):
+            sampler.load_state_dict(state_for(seed))
+            steps = 0
+            while steps < max_steps:
+                sampler.run_steps(CONV_EVAL_EVERY, eps)
+                steps += CONV_EVAL_EVERY
+                if float(acc_fn(sampler.particles)) >= target:
+                    return steps
+            return None
 
-    # wall: S-step scanned dispatches (pure compute — the detection loop's
-    # per-eval tunnel fetches are not trajectory cost), _timed_chain
-    # protocol (each sample starts from evolving state, so no rep can be
-    # relay-cached)
+        # stepsize: fewest tuning-seed steps wins (ties → smaller stepsize);
+        # the tuning seed is NOT among the reported seeds.  Each grid point
+        # is capped at the current winner's step count — a stepsize that
+        # cannot beat it has nothing left to prove, and an early diverging
+        # candidate would otherwise burn CONV_MAX_STEPS of eval round trips
+        best_eps, best_steps = None, None
+        for eps in CONV_STEP_GRID:
+            cap = CONV_MAX_STEPS if best_steps is None else best_steps
+            s = run_to_target(CONV_TUNE_SEED, eps, max_steps=cap)
+            if s is not None and (best_steps is None or s < best_steps):
+                best_eps, best_steps = eps, s
+        if best_eps is None:
+            per_dataset[name] = {
+                "fold": fold_idx, "sklearn_acc": round(baseline, 4),
+                "target_acc": round(target, 4), "steps_median": None,
+                "note": "target unreached at every tuning stepsize",
+            }
+            continue
+
+        runs = [run_to_target(seed, best_eps) for seed in CONV_SEEDS]
+        reached = [s for s in runs if s is not None]
+        all_steps.extend(reached)
+        med = statistics.median(reached) if reached else None
+        per_dataset[name] = {
+            "fold": fold_idx,
+            "sklearn_acc": round(baseline, 4),
+            "target_acc": round(target, 4),
+            "stepsize": best_eps,
+            "seeds": len(CONV_SEEDS),
+            "unreached": len(runs) - len(reached),
+            "steps_median": med,
+            "steps_min": min(reached) if reached else None,
+            "steps_max": max(reached) if reached else None,
+        }
+        if name == "banana":
+            banana = (sampler, state_for, best_eps, med)
+
+    # wall for the flagship dataset at its median step count: S-step scanned
+    # dispatches with no eval fetches (pure trajectory cost — the detection
+    # loop's per-eval tunnel round trips are measurement, not trajectory)
     wall = None
-    if reached:
-        sampler.load_state_dict(state0)
-        run = lambda: sampler.run_steps(steps, CONV_STEP_SIZE)
+    if banana is not None and banana[3] is not None:
+        sampler, state_for, eps, med = banana
+        # a fractional median (even seed count reached) rounds to the
+        # CONV_EVAL_EVERY grid the detection ran on, never truncating below
+        steps_wall = max(
+            CONV_EVAL_EVERY,
+            int(round(med / CONV_EVAL_EVERY)) * CONV_EVAL_EVERY,
+        )
+        sampler.load_state_dict(state_for(CONV_SEEDS[0]))
+        run = lambda: sampler.run_steps(steps_wall, eps)
         _fence(run())  # compile, untimed
-        sampler.load_state_dict(state0)
+        sampler.load_state_dict(state_for(CONV_SEEDS[0]))
         wall = _timed_chain(run)
 
+    medians = [v["steps_median"] for v in per_dataset.values()
+               if v.get("steps_median") is not None]
     return {
-        "sklearn_acc": round(baseline, 4),
-        "target_acc": round(target, 4),
-        "final_acc": round(acc, 4),
-        "steps_to_target_acc": steps if reached else None,
+        "steps_to_target_acc_median": (
+            statistics.median(all_steps) if all_steps else None
+        ),
+        "steps_to_target_acc_spread": (
+            [min(all_steps), max(all_steps)] if all_steps else None
+        ),
+        "steps_to_target_acc_per_dataset_medians": medians,
         "wall_to_target_acc_s": None if wall is None else round(wall, 3),
-        "conv_step_size": CONV_STEP_SIZE,
+        "convergence": per_dataset,
     }
 
 
@@ -288,7 +367,7 @@ def main():
 
     # --- convergence half of the metric (TPU only — 10k particles on the
     # CPU fallback would take minutes and measure nothing new) ------------
-    conv = _steps_to_target(fold) if not on_cpu else {"steps_to_target_acc": None}
+    conv = _steps_to_target() if not on_cpu else {"steps_to_target_acc_median": None}
 
     out = {
         "metric": "particle_updates_per_sec (BayesLR banana, 10k particles, "

@@ -60,6 +60,13 @@ def _data_rows(data) -> int:
 class DistSampler:
     """Distributed SVGD sampler.
 
+    Option composition: most options combine freely; the full supported /
+    rejected matrix (mode × update_rule × exchange_impl × exchange_every ×
+    W2 × median_step × batch_size × shard_data) lives in one table in
+    ``docs/PARITY.md`` ("Feature-composition matrix") with the rationale
+    for every rejected cell — each rejection below also raises a clear
+    ``ValueError`` naming its constraint.
+
     Args:
         num_shards: mesh size S (the reference's world size).  The reference's
             per-process ``rank`` argument has no SPMD counterpart — one program

@@ -180,8 +180,10 @@ def _dot3(a, b):
     """``a @ b`` with f32 accumulation via a 3-pass bf16x3 split — the
     ``Precision.HIGH`` decomposition, hand-rolled because Mosaic's dot
     lowering accepts only DEFAULT and HIGHEST.  Each f32 operand splits into
-    a bf16 high part and a bf16 residual (exactly representable); the
-    ``lo·lo`` cross term (~2⁻³² relative) is dropped:
+    a bf16 high part and a bf16 residual; the residual captures only ~8 of
+    the remaining 16 mantissa bits, so the two-term split itself carries
+    ~2⁻¹⁶ relative representation error, and the dropped ``lo·lo`` cross
+    term is of the same ~2⁻¹⁶..2⁻¹⁸ order:
 
         a·b ≈ a_hi·b_hi + a_hi·b_lo + a_lo·b_hi
 
@@ -213,6 +215,16 @@ _D2_CAP = 1e30
 #: Scoped-VMEM stack budget for the big-d tile-fit estimate (the v5e limit
 #: is 16 MB; leave headroom for Mosaic's own temporaries).
 _VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def fits_vmem_big_d(d: int) -> bool:
+    """Whether the big-d kernel can fit the scoped-VMEM budget for feature
+    dim ``d`` at its minimum (128×256) tile floor — false beyond d ≈ 2400.
+    The ``'auto'`` dispatch checks this before choosing the kernel, so huge-d
+    models fall back to the XLA φ instead of hitting a compile failure."""
+    dp = _round_up(d, 128)
+    floor = 4 * (2 * dp * (128 + 2 * 256) + 4 * 128 * 256 + 128 * (dp + 128))
+    return floor <= _VMEM_BUDGET
 
 
 def _pad_to(a: jax.Array, rows: int, cols: int, value: float = 0.0) -> jax.Array:
@@ -310,6 +322,15 @@ def phi_pallas(
             bm = _round_up(bm // 2, 8)
         while stack_bytes(bk, bm) > _VMEM_BUDGET and fit_k and bk > 128:
             bk = _round_up(bk // 2, 8)
+        if fit_m and fit_k and not fits_vmem_big_d(d):
+            # even the floor tiles overflow (d beyond ~2400): fail with a
+            # clear message instead of a Mosaic scoped-vmem compile error.
+            # 'auto' never reaches here — it checks fits_vmem_big_d first
+            raise ValueError(
+                f"phi_pallas: d={d} needs more than the ~{_VMEM_BUDGET >> 20} MB "
+                "scoped-VMEM budget even at the minimum 128x256 tiles; use "
+                "the XLA phi (phi_impl='xla') for this shape"
+            )
     kp, mp = _round_up(k, bk), _round_up(m, bm)
     dp = _round_up(d, 128)
     inv_h = 1.0 / float(bandwidth)
@@ -484,9 +505,12 @@ def resolve_phi_fn(kernel, phi_impl: str):
             bw = kernel.bandwidth
 
             def auto_fn(y, x, s):
-                thresh = (PALLAS_MIN_PAIRS if y.shape[1] <= SMALL_D
-                          else PALLAS_MIN_PAIRS_BIG_D)
-                if y.shape[0] * x.shape[0] >= thresh:
+                d = y.shape[1]
+                if d <= SMALL_D:
+                    thresh, fits = PALLAS_MIN_PAIRS, True
+                else:
+                    thresh, fits = PALLAS_MIN_PAIRS_BIG_D, fits_vmem_big_d(d)
+                if fits and y.shape[0] * x.shape[0] >= thresh:
                     return phi_pallas(y, x, s, bandwidth=bw)
                 return phi(y, x, s, kernel)
 
