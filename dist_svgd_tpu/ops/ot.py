@@ -119,17 +119,18 @@ def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200,
     (cost units, shape ``(n,)``): the start is then the **soft (entropic)
     c-transform pair of** ``g_init`` — one exact log-domain Sinkhorn
     iteration, ``f⁰_i = reg·log a_i − reg·logsumexp_j((g_init_j − C_ij)/
-    reg)`` and ``g⁰`` likewise from ``f⁰``.  Two properties: (1) the soft
-    transform of an *optimal* ``g`` IS the entropic fixpoint (a hard min
-    would land O(reg·log n) off it — measured ~10 residual polish
-    iterations at the north star, vs ~0 soft), so from a near-optimal
-    carry the ``tol`` exit fires on the first block; (2) safety for *any*
-    ``g_init`` — after the ``f⁰`` update every row of
-    ``exp((f⁰+g⁰′−C)/reg)`` sums to exactly its marginal, so no row can
-    start underflowed (the guarantee the cold c-transform start provides,
-    in soft form).  Across consecutive SVGD steps the particles move by
-    O(ε·φ), making the previous step's ``g`` that near-optimal carry
-    (measured 4.4× over the cold start at the north star, docs/notes.md).
+    reg)`` and ``g⁰`` likewise from ``f⁰``; see :func:`_sinkhorn_start`.
+    Two properties: (1) the soft transform of an *optimal* ``g`` IS the
+    entropic fixpoint (a hard min would land O(reg·log n) off it —
+    measured ~10 residual polish iterations at the north star, vs ~0
+    soft), so from a near-optimal carry the ``tol`` exit fires on the
+    first block; (2) safety for *any* ``g_init`` — after the ``f⁰`` update
+    every row of ``exp((f⁰+g⁰′−C)/reg)`` sums to exactly its marginal,
+    so no row can start underflowed (the guarantee the cold c-transform
+    start provides, in soft form).  Across consecutive SVGD steps the
+    particles move by O(ε·φ), making the previous step's ``g`` that
+    near-optimal carry (measured 4.4× over the cold start at the north
+    star, docs/notes.md).
 
     ``return_potentials=True`` returns ``(plan, (f, g))`` — feed ``g`` back
     as the next solve's ``g_init``.
@@ -139,17 +140,85 @@ def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200,
     m, n = x.shape[0], y.shape[0]
     cost = squared_distances(x, y)
     dt = cost.dtype
+    if iters == 0:  # degenerate edge: the bare start, no scaling pass
+        f, g = _sinkhorn_start(cost, eps, g_init)
+        reg = eps * jnp.maximum(jnp.mean(cost), jnp.finfo(dt).tiny)
+        plan = jnp.exp((f[:, None] + g[None, :] - cost) / reg)
+        return (plan, (f, g)) if return_potentials else plan
+    f, g, kmat, u, v, _ = _sinkhorn_solve(
+        cost, m, n, eps, iters, tol, absorb_every, g_init
+    )
+    # the last block's kernel and scalings ARE the plan — rebuilding it as
+    # exp((f+g−C)/reg) would spend one more full exp pass over C for the
+    # same values (round-3 exp-pass accounting, docs/notes.md)
+    plan = u[:, None] * kmat * v[None, :]
+    if return_potentials:
+        return plan, (f, g)
+    return plan
+
+
+def _sinkhorn_start(cost, eps: float, g_init):
+    """Initial dual pair.  Cold (``g_init=None``): the hard c-transform
+    pair — ``f⁰_i = min_j C_ij``, ``g⁰_j = min_i (C_ij − f⁰_i)``.  Warm: the
+    SOFT (entropic) c-transform of the carried g — one exact log-domain
+    Sinkhorn half-iteration.  The hard min would land O(reg·log n) off the
+    entropic fixpoint even from a perfect ``g_init`` (measured ~10 polish
+    iterations at the north star); the soft transform of an optimal g IS
+    the fixpoint, so the ``tol`` exit fires on the first block.  Safety
+    matches the cold start: after the ``f⁰`` update every row of
+    ``exp((f⁰+g−C)/reg)`` sums to exactly its marginal ``a_i = 1/m``, so no
+    row can start underflowed for any ``g_init``.  The second (``g⁰``)
+    tightening pass is kept deliberately: skipping it was probed in round
+    3 (one fewer exp pass over C) but an *arbitrary* ``g_init`` — the
+    safety contract — can then drive the tol exit to fire at a
+    non-solution (the garbage-init regression test catches exactly this);
+    the column-side pin is what makes the start safe, not just warm."""
+    dt = cost.dtype
+    m, n = cost.shape
+    if g_init is None:
+        f0 = jnp.min(cost, axis=1)                # (m,) nearest-target cost
+        g0 = jnp.min(cost - f0[:, None], axis=0)  # (n,) c-transform of f0
+        return f0, g0
+    reg = eps * jnp.maximum(jnp.mean(cost), jnp.finfo(dt).tiny)
+    gi = g_init.astype(dt)
+    lse = jax.nn.logsumexp
+    f0 = reg * jnp.log(jnp.asarray(1.0 / m, dt)) - reg * lse(
+        (gi[None, :] - cost) / reg, axis=1
+    )
+    g0 = reg * jnp.log(jnp.asarray(1.0 / n, dt)) - reg * lse(
+        (f0[:, None] - cost) / reg, axis=0
+    )
+    return f0, g0
+
+
+def _sinkhorn_scaling_loop(f0, g0, build_kmat, fold_scale, m, n,
+                           iters, tol, absorb_every, dt):
+    """The absorbed-scaling loop shared by the XLA path (below) and the
+    fused Pallas path (ops/pallas_ot.py) — ONE copy of the block
+    structure, tol-exit statistic, and u/v clamps, parametrised over how
+    the absorbed kernel is built (dense exp over a materialised cost vs a
+    fused VMEM-streaming kernel) and the potential units (``fold_scale`` =
+    ``reg`` in cost units, ``1.0`` in reg-rescaled units).
+
+    Returns ``(f, g, kmat, u, v)``: the folded potentials plus the LAST
+    block's absorbed kernel and scalings — ``plan = u·kmat·v`` entrywise,
+    exactly (``f = f_pre + fold_scale·log u`` folds the same factors the
+    product applies), so consumers need no further pass over the cost.
+    Requires ``iters >= 1``.
+    """
+    if absorb_every <= 0:
+        raise ValueError(f"absorb_every must be positive, got {absorb_every}")
+    if iters < 1:
+        raise ValueError(f"the scaling loop needs iters >= 1, got {iters}")
     tiny = jnp.finfo(dt).tiny
-    mean_c = jnp.maximum(jnp.mean(cost), tiny)
-    reg = eps * mean_c
     a = jnp.asarray(1.0 / m, dt)
     b = jnp.asarray(1.0 / n, dt)
 
     def run_block(f, g, k_iters: int):
         """``k_iters`` scaling iterations against the absorbed kernel;
-        returns the new potentials and the last iteration's ``log v``
-        sup-change (the convergence statistic)."""
-        kmat = jnp.exp((f[:, None] + g[None, :] - cost) / reg)
+        returns folded potentials, the block's (kmat, u, v), and the last
+        iteration's ``log v`` sup-change (the convergence statistic)."""
+        kmat = build_kmat(f, g)
 
         def one(v):
             u = a / jnp.maximum(kmat @ v, tiny)
@@ -160,78 +229,140 @@ def sinkhorn_plan(x, y, eps: float = 0.05, iters: int = 200,
         )
         u, new_v = one(v)
         delta = jnp.max(jnp.abs(jnp.log(new_v) - jnp.log(v)))
-        return f + reg * jnp.log(u), g + reg * jnp.log(new_v), delta
+        return (f + fold_scale * jnp.log(u), g + fold_scale * jnp.log(new_v),
+                kmat, u, new_v, delta)
 
-    if g_init is None:
-        f0 = jnp.min(cost, axis=1)                # (m,) nearest-target cost
-        g0 = jnp.min(cost - f0[:, None], axis=0)  # (n,) c-transform of f0
-    else:
-        # SOFT (entropic) c-transform pair of the carried g — one exact
-        # log-domain Sinkhorn iteration.  The hard min would land
-        # O(reg·log n) off the entropic fixpoint even from a perfect
-        # g_init (measured ~10 polish iterations at the north star); the
-        # soft transform of an optimal g IS the fixpoint, so the tol exit
-        # fires on the first block.  Safety matches the cold start: after
-        # the f0 update every row of exp((f0+g−C)/reg) sums to exactly
-        # its marginal a_i = 1/m, so no row can start underflowed for any
-        # g_init.
-        gi = g_init.astype(dt)
-        lse = jax.nn.logsumexp
-        f0 = reg * jnp.log(a) - reg * lse((gi[None, :] - cost) / reg, axis=1)
-        g0 = reg * jnp.log(b) - reg * lse((f0[:, None] - cost) / reg, axis=0)
-    if iters:
-        absorb_every = min(absorb_every, iters)  # short runs stay exact
+    absorb_every = min(absorb_every, iters)  # short runs stay exact
     blocks, rem = divmod(iters, absorb_every)
+    kmat0 = jnp.zeros((m, n), dt)
+    u0 = jnp.ones((m,), dt)
+    v0 = jnp.ones((n,), dt)
     if tol is None:
         def body(_, carry):
-            f, g = carry
-            f, g, _ = run_block(f, g, absorb_every)
-            return f, g
+            f, g, *_ = carry
+            f, g, kmat, u, v, _ = run_block(f, g, absorb_every)
+            return f, g, kmat, u, v
 
-        f, g = lax.fori_loop(0, blocks, body, (f0, g0))
+        f, g, kmat, u, v = lax.fori_loop(
+            0, blocks, body, (f0, g0, kmat0, u0, v0)
+        )
         if rem:
-            f, g, _ = run_block(f, g, rem)
+            f, g, kmat, u, v, _ = run_block(f, g, rem)
     else:
         thresh = jnp.asarray(tol, dt)
         total = blocks + (1 if rem else 0)
 
         def cond(carry):
-            i, _, _, delta = carry
+            i, delta = carry[0], carry[-1]
             return (i < total) & (delta > thresh)
 
         def body(carry):
-            i, f, g, _ = carry
+            i, f, g, *_ = carry
             # uniform block length keeps one compiled body; the cap may
             # overshoot ``iters`` by < absorb_every on the last block
-            f, g, delta = run_block(f, g, absorb_every)
-            return i + 1, f, g, delta
+            f, g, kmat, u, v, delta = run_block(f, g, absorb_every)
+            return i + 1, f, g, kmat, u, v, delta
 
-        _, f, g, _ = lax.while_loop(
-            cond, body, (0, f0, g0, jnp.asarray(jnp.inf, dt))
+        _, f, g, kmat, u, v, _ = lax.while_loop(
+            cond, body,
+            (0, f0, g0, kmat0, u0, v0, jnp.asarray(jnp.inf, dt)),
         )
-    plan = jnp.exp((f[:, None] + g[None, :] - cost) / reg)
-    if return_potentials:
-        return plan, (f, g)
-    return plan
+    return f, g, kmat, u, v
+
+
+def _sinkhorn_solve(cost, m, n, eps, iters, tol, absorb_every, g_init):
+    """XLA-path solve over a materialised ``cost``: the shared scaling loop
+    with a dense-exp kernel builder, in cost units."""
+    dt = cost.dtype
+    tiny = jnp.finfo(dt).tiny
+    reg = eps * jnp.maximum(jnp.mean(cost), tiny)
+    f0, g0 = _sinkhorn_start(cost, eps, g_init)
+    f, g, kmat, u, v = _sinkhorn_scaling_loop(
+        f0, g0,
+        lambda f, g: jnp.exp((f[:, None] + g[None, :] - cost) / reg),
+        reg, m, n, iters, tol, absorb_every, dt,
+    )
+    return f, g, kmat, u, v, reg
+
+
+#: ``impl='auto'`` uses the fused Pallas solve (ops/pallas_ot.py) at/above
+#: this many pairwise cost entries on TPU small-d problems; below it the
+#: kernels' launch overheads aren't worth the one saved distance-build pass
+#: (the measured win at the north-star shard shape is 1.10× — docs/notes.md).
+FUSED_SINKHORN_MIN_PAIRS = 1 << 20
 
 
 def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
                               iters: int = 200, tol: float | None = None,
                               absorb_every: int = 10,
-                              g_init=None, return_g: bool = False):
+                              g_init=None, return_g: bool = False,
+                              impl: str = "auto"):
     """W2 gradient from the Sinkhorn plan — same formula as the LP path:
     ``grad_i = Σ_j P_ij (x_i − y_j) = x_i · rowsum_i − P @ y``, computed
-    without materialising the ``(m, n, d)`` difference tensor.
+    without materialising the ``(m, n, d)`` difference tensor *or the plan
+    itself*: with the last block's ``(kmat, u, v)`` the plan is
+    ``diag(u)·kmat·diag(v)``, so ``rowsum = u ⊙ (kmat @ v)`` and ``P @ y =
+    u ⊙ (kmat @ (v ⊙ y))`` are two cheap matvecs against the
+    already-materialised kernel instead of a fresh exp pass over ``C``
+    (round-3 exp-pass accounting, docs/notes.md).
 
     ``g_init`` / ``return_g`` thread the dual potential ``g`` through for
     warm-starting consecutive solves (see :func:`sinkhorn_plan`); only ``g``
-    needs carrying — ``f`` is re-derived as its c-transform each solve."""
-    out = sinkhorn_plan(particles, previous, eps=eps, iters=iters, tol=tol,
-                        absorb_every=absorb_every,
-                        g_init=g_init, return_potentials=return_g)
-    plan, pots = out if return_g else (out, None)
-    row = jnp.sum(plan, axis=1)
-    grad = particles * row[:, None] - plan @ previous
+    needs carrying — ``f`` is re-derived as its soft c-transform each
+    solve.
+
+    ``impl``: ``'auto'`` (the fused Pallas solve — cost tiles recomputed in
+    VMEM, no C matrix — on TPU for d ≤ SMALL_D at
+    ``FUSED_SINKHORN_MIN_PAIRS``+ sizes, measured 1.10× at the north star;
+    the XLA path otherwise), ``'xla'``, or ``'pallas'`` (force; runs the
+    Pallas interpreter off-TPU — slow, for testing).  Identical semantics
+    either way (tests/test_pallas_ot.py)."""
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown sinkhorn impl {impl!r}")
+    x = particles
+    y = previous
+    if iters == 0:
+        # bare-start edge: gradient from the start-pair plan (no block ran,
+        # so there is no (kmat, u, v) to finish from)
+        plan, (_, g) = sinkhorn_plan(
+            x, y, eps=eps, iters=0, absorb_every=absorb_every,
+            g_init=g_init, return_potentials=True,
+        )
+        grad = x * jnp.sum(plan, axis=1)[:, None] - plan @ y
+        return (grad, g) if return_g else grad
+    if impl != "xla":
+        from dist_svgd_tpu.ops.pallas_svgd import SMALL_D, pallas_available
+
+        on_tpu = pallas_available()
+        small_d = x.shape[1] <= SMALL_D
+        big = x.shape[0] * y.shape[0] >= FUSED_SINKHORN_MIN_PAIRS
+        # the fused path is f32-internal; honor other dtypes via XLA
+        f32 = (x.dtype == jnp.float32 and y.dtype == jnp.float32)
+        if impl == "pallas" or (on_tpu and small_d and big and f32):
+            if not small_d:
+                raise ValueError(
+                    f"impl='pallas' requires d <= {SMALL_D}, got {x.shape[1]}"
+                )
+            from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_fused
+
+            return sinkhorn_grad_fused(
+                x, y, eps=eps, iters=iters, tol=tol,
+                absorb_every=absorb_every, g_init=g_init, return_g=return_g,
+                interpret=not on_tpu,
+            )
+    cost = squared_distances(x, y)
+    _, g, kmat, u, v, _ = _sinkhorn_solve(
+        cost, x.shape[0], y.shape[0], eps, iters, tol, absorb_every, g_init
+    )
+    # both matvecs feed the gradient directly: HIGHEST, not the MXU's
+    # default bf16 passes (same reasoning as ops/kernels.py:squared_distances)
+    row = u * jnp.matmul(
+        kmat, v[:, None], precision=jax.lax.Precision.HIGHEST
+    )[:, 0]
+    py = u[:, None] * jnp.matmul(
+        kmat, v[:, None] * y, precision=jax.lax.Precision.HIGHEST
+    )
+    grad = x * row[:, None] - py
     if return_g:
-        return grad, pots[1]
+        return grad, g
     return grad

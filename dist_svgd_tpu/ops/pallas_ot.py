@@ -1,0 +1,417 @@
+"""Pallas TPU kernels for the Sinkhorn W2 solve — the flash-attention
+argument applied to entropic OT.
+
+Round-3 decomposition (docs/notes.md): with warm-started duals the W2
+solve's scaling iterations cost ~0.1 ms each — ~95% of the solve is the
+*fixed* passes, each of which materialises or re-reads an ``(n/S, n)``
+float32 matrix in HBM (50 MB per shard at the north star):
+
+- the cost-matrix build (``squared_distances``),
+- the two soft-c-transform ``logsumexp`` passes over it,
+- the absorbed-kernel rebuild per block,
+- the final plan build plus two plan-sized reads for the gradient.
+
+At d ≤ :data:`~dist_svgd_tpu.ops.pallas_svgd.SMALL_D` the cost entries are
+recomputable from O(n·d) data for a handful of VPU ops, so — exactly like
+the φ kernel (ops/pallas_svgd.py) — these passes can stream (bk, bm) cost
+tiles through VMEM and never materialise the matrix:
+
+- :func:`ctransform_reduce` — one fused pass producing a row-wise
+  ``min_j (C_ij − p_j)`` (hard c-transform) or a running-max-rescaled
+  ``logsumexp_j ((p_j − C_ij)/reg)`` (soft c-transform; the flash-softmax
+  accumulator) from the particle coordinates directly;
+- :func:`kexp` — the absorbed kernel ``exp((f_i + g_j − C_ij)/reg)``
+  materialised for the matvec block (the one matrix worth keeping: the
+  scaling iterations reuse it ~``absorb_every`` times);
+- :func:`plan_grad` — a fused one-pass gradient ``grad_i = x_i·Σ_j P_ij −
+  Σ_j P_ij·prev_j`` with the plan recomputed tile-by-tile (the same
+  rowsum + per-dim-contraction accumulator pattern as the φ kernel's
+  repulsive + drive terms).  Kept as a standalone utility: the production
+  finish instead reuses the last block's materialised ``(kmat, u, v)``
+  (``plan = diag(u)·kmat·diag(v)`` exactly), where the gradient is two
+  cheap matvecs and costs no exp pass at all.
+
+``mean(C)`` (for the relative ``eps``) needs no pass at all:
+``mean‖x_i − y_j‖² = mean‖x‖² + mean‖y‖² − 2·mean(x)·mean(y)``.
+
+:func:`sinkhorn_grad_fused` assembles the full W2 gradient with the same
+algorithm as ``ops/ot.py`` (absorption-stabilised scaling, uniform
+``absorb_every`` blocks, the same ``tol`` exit statistic and u/v clamps) —
+same math, different memory movement; pinned against the XLA path by
+``tests/test_pallas_ot.py``.
+
+Small-d (d ≤ SMALL_D), float32 only; callers fall back to the XLA path
+elsewhere (``ops/ot.py:wasserstein_grad_sinkhorn(impl=...)``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+# lax used only via jax.lax.Precision in the matvec finish
+from jax.experimental import pallas as pl
+
+from dist_svgd_tpu.ops.pallas_svgd import (
+    SMALL_D,
+    _D2_CAP,
+    _FAR,
+    _VMEM,
+    _auto_block,
+    _pad_to,
+    _round_up,
+    pltpu,
+)
+
+#: Default tile sizes — the φ kernel's small-d autotune result (1024² —
+#: docs/notes.md) applies to the accumulator kernels (ctransform_reduce,
+#: plan_grad), whose outputs are (bk, 128) slivers.  ``kexp`` writes full
+#: (bk, bm) tiles (4 MB at 1024², double-buffered) and needs a smaller k
+#: tile to fit scoped VMEM alongside its distance temporaries.
+_BLOCK_K = 1024
+_BLOCK_M = 1024
+_KEXP_BLOCK_K = 512
+
+
+def _blocks(k, m, default_k, default_m):
+    """Per-axis tiles with the φ kernel's ≤~10% padding rule (a 1250-row
+    shard axis pads 64% at 1024 tiles but 2.4% at 256 — _auto_block)."""
+    bk = min(_auto_block(k, default_k), _round_up(k, 8))
+    bm = min(_auto_block(m, default_m), _round_up(m, 8))
+    return bk, bm
+
+#: Finite stand-in for −inf in the running-max accumulator (f32 min is
+#: ~−3.4e38; exp(x − m) with both finite never NaNs, unlike −inf − −inf).
+_NEG_HUGE = -3.0e38
+
+
+def _d2_tile(y, xT, d_true):
+    """(bk, bm) squared distances via per-dim VPU broadcasts, clamped so
+    sentinel-padded columns stay finite (ops/pallas_svgd.py conventions)."""
+    d2 = None
+    for c in range(d_true):  # static unroll
+        diff = y[:, c:c + 1] - xT[c:c + 1, :]
+        d2 = diff * diff if d2 is None else d2 + diff * diff
+    return jnp.minimum(d2, _D2_CAP)
+
+
+def _ct_kernel(y_ref, xT_ref, p_ref, o_ref, m_ref, s_ref, *,
+               inv_reg: float, d_true: int, nm: int, soft: bool):
+    """One (i, j) grid step of :func:`ctransform_reduce`.
+
+    soft=True: running-max-rescaled sum of ``exp((p_j − C_ij)·inv_reg −
+    m_run)`` (flash-softmax); the output tile is ``m_run + log(s_run)``.
+    soft=False: running ``min_j (C_ij − p_j)``.
+    Padded columns carry the :data:`_FAR` sentinel ⇒ C ≈ 1e30 ⇒ they are
+    exp-zero / never-min without any mask.
+    """
+    j = pl.program_id(1)
+    d2 = _d2_tile(y_ref[:], xT_ref[:], d_true)
+    p = p_ref[:]  # (1, bm) column potentials
+
+    if soft:
+        e = (p - d2) * inv_reg  # (bk, bm)
+
+        @pl.when(j == 0)
+        def _():
+            m_ref[:] = jnp.full_like(m_ref, _NEG_HUGE)
+            s_ref[:] = jnp.zeros_like(s_ref)
+
+        m_run = m_ref[:, :1]
+        tile_max = jnp.max(e, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_run, tile_max)
+        scale = jnp.exp(m_run - m_new)
+        s_ref[:] = s_ref[:] * scale + jnp.sum(
+            jnp.exp(e - m_new), axis=1, keepdims=True
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+        @pl.when(j == nm - 1)
+        def _():
+            o_ref[:] = m_ref[:, :1] + jnp.log(s_ref[:, :1])
+    else:
+        e = d2 - p  # (bk, bm)
+
+        @pl.when(j == 0)
+        def _():
+            m_ref[:] = jnp.full_like(m_ref, jnp.asarray(3.0e38, m_ref.dtype))
+
+        m_ref[:] = jnp.minimum(
+            m_ref[:], jnp.min(e, axis=1, keepdims=True)
+        )
+
+        @pl.when(j == nm - 1)
+        def _():
+            o_ref[:] = m_ref[:, :1]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("inv_reg", "soft", "interpret"),
+)
+def ctransform_reduce(rows, cols, col_pot, inv_reg: float, soft: bool,
+                      interpret: bool = False):
+    """Row-wise c-transform reduction without materialising C.
+
+    Args:
+        rows: ``(k, d)`` points indexing the output rows.
+        cols: ``(m, d)`` points indexed by the reduction.
+        col_pot: ``(m,)`` column potentials ``p``.
+        inv_reg: ``1/reg`` (static; ignored for ``soft=False``).
+        soft: logsumexp (True) or hard min (False) — docstring above.
+
+    Returns ``(k,)``: ``LSE_j((p_j − C_ij)·inv_reg)`` or ``min_j (C_ij −
+    p_j)``.
+    """
+    k, d = rows.shape
+    m = cols.shape[0]
+    assert d <= SMALL_D, d
+    f32 = jnp.float32
+    bk, bm = _blocks(k, m, _BLOCK_K, _BLOCK_M)
+    kp, mp = _round_up(k, bk), _round_up(m, bm)
+    nk, nm = kp // bk, mp // bm
+
+    y = _pad_to(rows.astype(f32), kp, 128)
+    xT = _pad_to(cols.T.astype(f32), SMALL_D, mp, value=_FAR)
+    p = _pad_to(col_pot.astype(f32)[None, :], 1, mp)
+
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    scratch = (
+        [pltpu.VMEM((bk, 128), f32), pltpu.VMEM((bk, 128), f32)]
+        if pltpu is not None
+        else [jax.ShapeDtypeStruct((bk, 128), f32),
+              jax.ShapeDtypeStruct((bk, 128), f32)]
+    )
+    out = pl.pallas_call(
+        functools.partial(_ct_kernel, inv_reg=float(inv_reg), d_true=d,
+                          nm=nm, soft=soft),
+        out_shape=jax.ShapeDtypeStruct((kp, 1), f32),
+        grid=(nk, nm),
+        in_specs=[
+            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
+            pl.BlockSpec((1, bm), lambda i, j: (0, j), **vmem),
+        ],
+        out_specs=pl.BlockSpec((bk, 1), lambda i, j: (i, 0), **vmem),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(y, xT, p)
+    return out[:k, 0]
+
+
+def _kexp_kernel(y_ref, xT_ref, f_ref, g_ref, o_ref, *,
+                 inv_reg: float, d_true: int):
+    d2 = _d2_tile(y_ref[:], xT_ref[:], d_true)
+    e = (f_ref[:, :1] + g_ref[:] - d2) * inv_reg
+    o_ref[:] = jnp.exp(e)
+
+
+@functools.partial(jax.jit, static_argnames=("inv_reg", "interpret"))
+def kexp(rows, cols, f, g, inv_reg: float, interpret: bool = False):
+    """Absorbed kernel ``exp((f_i + g_j − C_ij)·inv_reg)`` as a ``(k, m)``
+    matrix, with C recomputed tile-by-tile (one write, no C read).  Padded
+    columns are exp-zero via the distance sentinel; padded rows are sliced
+    off."""
+    k, d = rows.shape
+    m = cols.shape[0]
+    assert d <= SMALL_D, d
+    f32 = jnp.float32
+    bk, bm = _blocks(k, m, _KEXP_BLOCK_K, _BLOCK_M)
+    kp, mp = _round_up(k, bk), _round_up(m, bm)
+
+    y = _pad_to(rows.astype(f32), kp, 128)
+    xT = _pad_to(cols.T.astype(f32), SMALL_D, mp, value=_FAR)
+    fp = _pad_to(f.astype(f32)[:, None], kp, 128)
+    gp = _pad_to(g.astype(f32)[None, :], 1, mp)
+
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    out = pl.pallas_call(
+        functools.partial(_kexp_kernel, inv_reg=float(inv_reg), d_true=d),
+        out_shape=jax.ShapeDtypeStruct((kp, mp), f32),
+        grid=(kp // bk, mp // bm),
+        in_specs=[
+            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
+            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((1, bm), lambda i, j: (0, j), **vmem),
+        ],
+        out_specs=pl.BlockSpec((bk, bm), lambda i, j: (i, j), **vmem),
+        interpret=interpret,
+    )(y, xT, fp, gp)
+    return out[:k, :m]
+
+
+def _plan_grad_kernel(y_ref, xT_ref, f_ref, g_ref, o_ref, acc_ref, ksum_ref,
+                      *, inv_reg: float, d_true: int, nm: int):
+    """φ-kernel-style accumulation: per tile, plan entries ``P = exp((f + g
+    − C)·inv_reg)`` feed a row-sum accumulator and d per-dim contractions
+    ``Σ_j P_ij·prevᵀ_cj``; the epilogue emits ``y·rowsum − acc``."""
+    j = pl.program_id(1)
+    y = y_ref[:]
+    xT = xT_ref[:]
+    d2 = _d2_tile(y, xT, d_true)
+    p = jnp.exp((f_ref[:, :1] + g_ref[:] - d2) * inv_reg)  # (bk, bm)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        ksum_ref[:] = jnp.zeros_like(ksum_ref)
+
+    cols = [
+        jnp.sum(p * xT[c:c + 1, :], axis=1, keepdims=True)
+        for c in range(d_true)
+    ]
+    pad = acc_ref.shape[1] - d_true
+    acc_ref[:] = acc_ref[:] + jnp.concatenate(
+        cols + [jnp.zeros((y.shape[0], pad), jnp.float32)], axis=1
+    )
+    ksum_ref[:] = ksum_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+
+    @pl.when(j == nm - 1)
+    def _():
+        o_ref[:] = y * ksum_ref[:, :1] - acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("inv_reg", "interpret"))
+def plan_grad(rows, cols, f, g, inv_reg: float, interpret: bool = False):
+    """Fused W2 gradient ``grad_i = rows_i·Σ_j P_ij − Σ_j P_ij·cols_j`` with
+    the plan ``P = exp((f_i + g_j − C_ij)·inv_reg)`` recomputed per tile —
+    the plan never exists in HBM."""
+    k, d = rows.shape
+    m = cols.shape[0]
+    assert d <= SMALL_D, d
+    f32 = jnp.float32
+    bk, bm = _blocks(k, m, _BLOCK_K, _BLOCK_M)
+    kp, mp = _round_up(k, bk), _round_up(m, bm)
+    nm = mp // bm
+
+    y = _pad_to(rows.astype(f32), kp, 128)
+    # padded columns contribute nothing because P underflows to an EXACT
+    # zero there (the clamped sentinel distance gives exp(−1e30·inv_reg)
+    # == 0.0 for any inv_reg ≳ 1e-28), and 0.0 · _FAR == 0.0 — the
+    # sentinel coordinate never reaches the accumulators
+    xT = _pad_to(cols.T.astype(f32), SMALL_D, mp, value=_FAR)
+    fp = _pad_to(f.astype(f32)[:, None], kp, 128)
+    gp = _pad_to(g.astype(f32)[None, :], 1, mp)
+
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    scratch = (
+        [pltpu.VMEM((bk, 128), f32), pltpu.VMEM((bk, 128), f32)]
+        if pltpu is not None
+        else [jax.ShapeDtypeStruct((bk, 128), f32),
+              jax.ShapeDtypeStruct((bk, 128), f32)]
+    )
+    out = pl.pallas_call(
+        functools.partial(_plan_grad_kernel, inv_reg=float(inv_reg),
+                          d_true=d, nm=nm),
+        out_shape=jax.ShapeDtypeStruct((kp, 128), f32),
+        grid=(kp // bk, nm),
+        in_specs=[
+            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
+            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((1, bm), lambda i, j: (0, j), **vmem),
+        ],
+        out_specs=pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(y, xT, fp, gp)
+    return out[:k, :d]
+
+
+def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
+                        iters: int = 200, tol=None, absorb_every: int = 10,
+                        g_init=None, return_g: bool = False,
+                        interpret: bool = False):
+    """W2 gradient via the fused kernels — same algorithm and exit
+    semantics as ``ops/ot.py:sinkhorn_plan`` + ``wasserstein_grad_sinkhorn``
+    (absorption-stabilised scaling, uniform ``absorb_every`` blocks, the
+    per-iteration ``log v`` sup-change exit, identical u/v clamps), with
+    the fixed passes fused:
+
+    - ``reg`` from the closed-form distance mean (module docstring);
+    - cold start: two hard-c-transform reductions; warm start
+      (``g_init``): two soft (logsumexp) reductions — both via
+      :func:`ctransform_reduce`, no C matrix;
+    - per block, the absorbed kernel comes from :func:`kexp` (one write;
+      the scaling loop itself is the SAME code as the XLA path —
+      ``ops/ot.py:_sinkhorn_scaling_loop`` with this kernel builder);
+    - the final gradient is the matvec finish against the last block's
+      ``(kmat, u, v)`` — no exp pass, and the plan is never materialised.
+
+    Returns ``grad`` or ``(grad, g)`` like the XLA path.  Numerically equal
+    to it up to f32 reduction-order roundoff (pinned by
+    tests/test_pallas_ot.py).
+    """
+    if absorb_every <= 0:
+        raise ValueError(f"absorb_every must be positive, got {absorb_every}")
+    x = jnp.asarray(particles, jnp.float32)
+    y = jnp.asarray(previous, jnp.float32)
+    m, d = x.shape
+    n = y.shape[0]
+    dt = jnp.float32
+    tiny = jnp.finfo(dt).tiny
+
+    # mean(C) without a C pass: E‖x−y‖² = E‖x‖² + E‖y‖² − 2·Ex·Ey
+    mean_c = (jnp.mean(jnp.sum(x * x, axis=1))
+              + jnp.mean(jnp.sum(y * y, axis=1))
+              - 2.0 * jnp.dot(jnp.mean(x, axis=0), jnp.mean(y, axis=0)))
+    mean_c = jnp.maximum(mean_c, tiny)
+    reg = eps * mean_c
+    a = jnp.asarray(1.0 / m, dt)
+    b = jnp.asarray(1.0 / n, dt)
+
+    # The Pallas kernels take inv_reg as a STATIC float, but reg is traced
+    # (it depends on the particle positions).  Rescale instead: with
+    # C' = C/reg, potentials in units of reg (f' = f/reg), every kernel
+    # runs at inv_reg == 1:  exp((f+g−C)/reg) == exp(f'+g'−C'), and
+    # C'(x', y') for x' = x/sqrt(reg) is exactly ‖x'−y'‖².  The same
+    # rescaling identity the adaptive-bandwidth φ path uses
+    # (ops/pallas_svgd.py:resolve_phi_fn).
+    sr = jnp.sqrt(reg)
+    xs_, ys_ = x / sr, y / sr
+
+    def ct(rows, cols, pot, soft):
+        return ctransform_reduce(rows, cols, pot, 1.0, soft,
+                                 interpret=interpret)
+
+    if g_init is None:
+        f0 = ct(xs_, ys_, jnp.zeros((n,), dt), soft=False)   # min_j C'_ij
+        g0 = ct(ys_, xs_, f0, soft=False)                    # c-transform
+    else:
+        # warm start: the soft c-transform pair of the carried g
+        # (ops/ot.py:_sinkhorn_start — both passes kept; the column-side
+        # tightening is the safety pin for arbitrary g_init)
+        gi = jnp.asarray(g_init, dt) / reg
+        f0 = jnp.log(a) - ct(xs_, ys_, gi, soft=True)
+        g0 = jnp.log(b) - ct(ys_, xs_, f0, soft=True)
+
+    # ONE copy of the absorbed-scaling loop, shared with the XLA path
+    # (ops/ot.py:_sinkhorn_scaling_loop): only the kernel builder differs
+    # (fused VMEM-streaming kexp vs dense exp over a materialised cost),
+    # plus the reg-rescaled units (fold_scale 1.0).
+    from dist_svgd_tpu.ops.ot import _sinkhorn_scaling_loop
+
+    f, g, kmat, u, v = _sinkhorn_scaling_loop(
+        f0, g0,
+        lambda f, g: kexp(xs_, ys_, f, g, 1.0, interpret=interpret),
+        1.0, m, n, iters, tol, absorb_every, dt,
+    )
+
+    # Gradient from the last block's (kmat, u, v) — the plan is
+    # diag(u)·kmat·diag(v) entrywise, so rowsum and P@y' are two cheap
+    # matvecs against the materialised kernel; no further exp pass
+    # (ops/ot.py:wasserstein_grad_sinkhorn, same finish; HIGHEST on both —
+    # they feed the gradient directly).  In rescaled coordinates the
+    # result is grad/√reg (P is scale-free), so the true gradient is √reg
+    # times it; the carried dual converts back to cost units as g·reg.
+    row = u * jnp.matmul(
+        kmat, v[:, None], precision=jax.lax.Precision.HIGHEST
+    )[:, 0]
+    py = u[:, None] * jnp.matmul(
+        kmat, v[:, None] * ys_, precision=jax.lax.Precision.HIGHEST
+    )
+    grad = (xs_ * row[:, None] - py) * sr
+    if return_g:
+        return grad.astype(particles.dtype), (g * reg).astype(particles.dtype)
+    return grad.astype(particles.dtype)

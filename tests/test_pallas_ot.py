@@ -1,0 +1,140 @@
+"""Fused Sinkhorn kernels (ops/pallas_ot.py) vs the XLA path (ops/ot.py).
+
+Runs under the Pallas interpreter on CPU — same kernels, exact semantics
+(the TPU leg is tools/w2_bench.py / tools/tpu_phi_check.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dist_svgd_tpu.ops.kernels import squared_distances
+from dist_svgd_tpu.ops.ot import sinkhorn_plan, wasserstein_grad_sinkhorn
+from dist_svgd_tpu.ops.pallas_ot import (
+    ctransform_reduce,
+    kexp,
+    plan_grad,
+    sinkhorn_grad_fused,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _pts(rng, k, m, d=3):
+    x = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(m, d)) + 0.3, jnp.float32)
+    return x, y
+
+
+def test_ctransform_min_matches_dense(rng):
+    x, y = _pts(rng, 37, 53)  # ragged: exercises sentinel-padded columns
+    p = jnp.asarray(rng.normal(size=53), jnp.float32)
+    got = np.asarray(ctransform_reduce(x, y, p, 1.0, soft=False, interpret=True))
+    want = np.min(np.asarray(squared_distances(x, y)) - np.asarray(p)[None, :], axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_ctransform_lse_matches_dense(rng):
+    import scipy.special
+
+    x, y = _pts(rng, 41, 29)
+    p = jnp.asarray(rng.normal(size=29), jnp.float32)
+    got = np.asarray(ctransform_reduce(x, y, p, 1.0, soft=True, interpret=True))
+    e = np.asarray(p)[None, :] - np.asarray(squared_distances(x, y))
+    want = scipy.special.logsumexp(e, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_kexp_matches_dense(rng):
+    x, y = _pts(rng, 21, 45)
+    f = jnp.asarray(rng.normal(size=21), jnp.float32)
+    g = jnp.asarray(rng.normal(size=45), jnp.float32)
+    got = np.asarray(kexp(x, y, f, g, 1.0, interpret=True))
+    c = np.asarray(squared_distances(x, y))
+    want = np.exp(np.asarray(f)[:, None] + np.asarray(g)[None, :] - c)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_plan_grad_matches_dense(rng):
+    x, y = _pts(rng, 33, 27)
+    f = jnp.asarray(rng.normal(size=33) * 0.5, jnp.float32)
+    g = jnp.asarray(rng.normal(size=27) * 0.5, jnp.float32)
+    got = np.asarray(plan_grad(x, y, f, g, 1.0, interpret=True))
+    c = np.asarray(squared_distances(x, y))
+    p = np.exp(np.asarray(f)[:, None] + np.asarray(g)[None, :] - c)
+    want = np.asarray(x) * p.sum(axis=1)[:, None] - p @ np.asarray(y)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("tol", [None, 1e-2])
+@pytest.mark.parametrize("warm", [False, True])
+def test_fused_grad_matches_xla_path(rng, tol, warm):
+    """End-to-end: the fused solve equals the XLA solve (same algorithm,
+    different memory movement) on cold and warm starts, fixed and tol
+    exits."""
+    x, y = _pts(rng, 24, 40)
+    g_init = None
+    if warm:
+        # a realistic warm carry: the converged dual of a nearby problem
+        _, g_init = wasserstein_grad_sinkhorn(
+            x + 0.01, y, eps=0.05, iters=100, return_g=True
+        )
+    want, want_g = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=60, tol=tol, g_init=g_init, return_g=True
+    )
+    got, got_g = sinkhorn_grad_fused(
+        x, y, eps=0.05, iters=60, tol=tol, g_init=g_init, return_g=True,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_grad_outlier_row_safe(rng):
+    """The outlier regression from tests/test_ot.py, on the fused path."""
+    x = np.asarray(rng.normal(size=(64, 2)))
+    x[0] = 40.0
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(rng.normal(size=(32, 2)), jnp.float32)
+    grad = np.asarray(sinkhorn_grad_fused(
+        x, y, eps=0.01, iters=400, tol=1e-2, interpret=True
+    ))
+    assert np.all(np.isfinite(grad))
+    assert np.all(grad[0] > 0.5)
+
+
+def test_public_impl_dispatch_matches(rng):
+    """wasserstein_grad_sinkhorn(impl='pallas') (interpreter off-TPU)
+    equals impl='xla' through the public API, including the carried g."""
+    x, y = _pts(rng, 20, 30)
+    want, want_g = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=80, tol=1e-3, return_g=True, impl="xla"
+    )
+    got, got_g = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=80, tol=1e-3, return_g=True, impl="pallas"
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
+                               rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        wasserstein_grad_sinkhorn(x, y, impl="nope")
+    with pytest.raises(ValueError):
+        big_d = jnp.asarray(np.zeros((4, 12)), jnp.float32)
+        wasserstein_grad_sinkhorn(big_d, big_d, impl="pallas")
+
+
+def test_fused_matches_plan_based_grad(rng):
+    """Cross-check against the plan route: grad from the materialised
+    sinkhorn_plan at identical settings."""
+    x, y = _pts(rng, 16, 16)
+    plan = np.asarray(sinkhorn_plan(x, y, eps=0.05, iters=200))
+    want = np.asarray(x) * plan.sum(axis=1)[:, None] - plan @ np.asarray(y)
+    got = np.asarray(sinkhorn_grad_fused(
+        x, y, eps=0.05, iters=200, interpret=True
+    ))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
