@@ -73,4 +73,51 @@ for name, fn in [("xla", xla_phi), ("pallas", phi_pallas)]:
     chained(y).block_until_ready()
     dt = (time.perf_counter() - t0) / K
     print(f"{name}: {dt*1e3:.3f} ms/phi @ (10k,10k,3), scanned x{K}", flush=True)
+
+# ---- fused Sinkhorn kernels (ops/pallas_ot.py) on real Mosaic ----------
+# the CPU interpreter tests (tests/test_pallas_ot.py) cover the math; this
+# covers the compiled flash-softmax accumulators, sentinel padding, and the
+# end-to-end fused solve vs the XLA solve on hardware, ragged shapes incl.
+import scipy.special
+
+from dist_svgd_tpu.ops.kernels import squared_distances
+from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
+from dist_svgd_tpu.ops.pallas_ot import (
+    ctransform_reduce,
+    kexp,
+    plan_grad,
+    sinkhorn_grad_fused,
+)
+
+for (k, m, d) in [(50, 37, 3), (1250, 10_000, 3)]:
+    x = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
+    yy = jnp.asarray(rng.normal(size=(m, d)) + 0.3, dtype=jnp.float32)
+    p = jnp.asarray(rng.normal(size=m), dtype=jnp.float32)
+    c = np.asarray(squared_distances(x, yy), dtype=np.float64)
+    got = np.asarray(ctransform_reduce(x, yy, p, 1.0, soft=False))
+    want = np.min(c - np.asarray(p)[None, :], axis=1)
+    err_min = np.max(np.abs(got - want))
+    got = np.asarray(ctransform_reduce(x, yy, p, 1.0, soft=True))
+    want = scipy.special.logsumexp(np.asarray(p)[None, :] - c, axis=1)
+    err_lse = np.max(np.abs(got - want))
+    f = jnp.asarray(rng.normal(size=k) * 0.5, dtype=jnp.float32)
+    g = jnp.asarray(rng.normal(size=m) * 0.5, dtype=jnp.float32)
+    pk = np.exp(np.asarray(f)[:, None] + np.asarray(g)[None, :] - c)
+    err_k = np.max(np.abs(np.asarray(kexp(x, yy, f, g, 1.0)) - pk))
+    wantg = np.asarray(x) * pk.sum(1)[:, None] - pk @ np.asarray(yy)
+    err_pg = np.max(np.abs(np.asarray(plan_grad(x, yy, f, g, 1.0)) - wantg)
+                    / np.maximum(np.abs(wantg), 1e-3))
+    print(f"({k},{m},{d}) ot-kernels: min {err_min:.2e} lse {err_lse:.2e} "
+          f"kexp {err_k:.2e} plan_grad {err_pg:.2e}", flush=True)
+    assert max(err_min, err_lse, err_k, err_pg) < 1e-3
+
+    # tol=None: both paths run exactly 60 iterations, so the comparison is
+    # deterministic up to roundoff — a tol exit could legitimately flip one
+    # path's exit block and make an O(tol) difference look like a failure
+    want = np.asarray(wasserstein_grad_sinkhorn(
+        x, yy, eps=0.05, iters=60, impl="xla"))
+    got = np.asarray(sinkhorn_grad_fused(x, yy, eps=0.05, iters=60))
+    err = np.max(np.abs(got - want) / np.maximum(np.abs(want), 1e-3))
+    print(f"({k},{m},{d}) fused-vs-xla W2 grad max relerr {err:.2e}", flush=True)
+    assert err < 1e-3, "fused solve diverged from XLA solve"
 print("TPU PALLAS CHECK OK", flush=True)
