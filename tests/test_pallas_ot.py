@@ -138,3 +138,37 @@ def test_fused_matches_plan_based_grad(rng):
         x, y, eps=0.05, iters=200, interpret=True
     ))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_sinkhorn_under_shard_map(rng):
+    """sinkhorn_grad_fused traced inside shard_map over a real (virtual-CPU)
+    mesh — the composition the scanned W2 path uses on a TPU mesh (the
+    production 'auto' dispatch picks XLA on CPU, so this forces the fused
+    path through the interpreter)."""
+    import jax
+
+    from dist_svgd_tpu.parallel.mesh import bind_shard_fn, make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a 4-device mesh")
+    S = 4
+    x = jnp.asarray(rng.normal(size=(S * 8, 3)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(S * 16, 3)) + 0.2, jnp.float32)
+    mesh = make_mesh(S)
+    assert mesh is not None
+
+    def shard_fn(block, prev):
+        return sinkhorn_grad_fused(
+            block, prev, eps=0.05, iters=40, interpret=True
+        )
+
+    bound = bind_shard_fn(shard_fn, S, mesh, in_specs=(0, 0), out_specs=(0,))
+    got = np.asarray(jax.jit(bound)(x, y))
+    want = np.concatenate([
+        np.asarray(wasserstein_grad_sinkhorn(
+            x[r * 8:(r + 1) * 8], y[r * 16:(r + 1) * 16],
+            eps=0.05, iters=40, impl="xla",
+        ))
+        for r in range(S)
+    ])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
