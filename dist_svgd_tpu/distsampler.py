@@ -302,6 +302,10 @@ class DistSampler:
             self._mode = PARTITIONS
 
         self._mesh = make_mesh(self._num_shards) if mesh == "auto" else mesh
+        # Under vmap emulation all S lanes run as ONE batched kernel, so the
+        # phi 'auto' thresholds should see S x the per-lane pair count; on a
+        # real mesh each device runs a single lane (resolve_phi_fn docstring)
+        self._phi_batch_hint = self._num_shards if self._mesh is None else 1
 
         if shard_data and self._data is not None:
             # truncate to divisible row count before the mesh split (the
@@ -322,6 +326,7 @@ class DistSampler:
             log_prior=log_prior,
             phi_impl=phi_impl,
             update_rule=update_rule,
+            phi_batch_hint=self._phi_batch_hint,
         )
         self._bound_step = bind_shard_fn(
             step,
@@ -347,6 +352,7 @@ class DistSampler:
                 batch_size=batch_size,
                 log_prior=log_prior,
                 phi_impl=phi_impl,
+                phi_batch_hint=self._phi_batch_hint,
             )
             self._bound_lagged = bind_shard_fn(
                 lagged,
@@ -727,6 +733,7 @@ class DistSampler:
                 sinkhorn_iters=self._sinkhorn_iters,
                 sinkhorn_tol=self._sinkhorn_tol,
                 sinkhorn_warm_start=self._sinkhorn_warm_start,
+                phi_batch_hint=self._phi_batch_hint,
             )
             self._bound_w2_step = bind_shard_fn(
                 step,

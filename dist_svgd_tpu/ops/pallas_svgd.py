@@ -445,9 +445,19 @@ PALLAS_MIN_PAIRS_BIG_D = 1 << 16
 XLA_BLOCKWISE_MIN_PAIRS = 1 << 31
 
 
-def resolve_phi_fn(kernel, phi_impl: str):
+def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1):
     """The framework-wide φ-backend policy, shared by ``Sampler``,
     ``DistSampler``, and ``parallel/exchange.py``.
+
+    ``batch_hint``: how many copies of the per-call shape run as one
+    batched kernel (``DistSampler`` passes its shard count under vmap
+    emulation, 1 on a real mesh where each device runs a single lane).
+    The ``'auto'`` thresholds compare ``k·m·batch_hint``: a vmapped
+    pallas_call runs all lanes as one batched grid, so an 8-lane
+    (1250, 1250) φ is one 12.5M-pair kernel — measured 1.31× over the
+    per-lane-XLA choice at the ws=8 partitions config, where the
+    per-call shape alone sits below the single-call crossover
+    (docs/notes.md round-3 scaling).
 
     An :class:`~dist_svgd_tpu.ops.kernels.AdaptiveRBF` kernel composes with
     every ``phi_impl`` below: the returned function first re-estimates the
@@ -488,7 +498,7 @@ def resolve_phi_fn(kernel, phi_impl: str):
         # term's 2/h factor becomes 2·(1/√h)² — algebra in docs/notes.md).
         # Every backend below stays compiled at the static bandwidth 1; the
         # traced h touches only elementwise scalings XLA fuses away.
-        base = resolve_phi_fn(RBF(1.0), phi_impl)
+        base = resolve_phi_fn(RBF(1.0), phi_impl, batch_hint)
         max_points = kernel.max_points
 
         def adaptive_fn(y, x, s):
@@ -510,7 +520,7 @@ def resolve_phi_fn(kernel, phi_impl: str):
                     thresh, fits = PALLAS_MIN_PAIRS, True
                 else:
                     thresh, fits = PALLAS_MIN_PAIRS_BIG_D, fits_vmem_big_d(d)
-                if fits and y.shape[0] * x.shape[0] >= thresh:
+                if fits and y.shape[0] * x.shape[0] * batch_hint >= thresh:
                     return phi_pallas(y, x, s, bandwidth=bw)
                 return phi(y, x, s, kernel)
 
@@ -520,7 +530,9 @@ def resolve_phi_fn(kernel, phi_impl: str):
         from dist_svgd_tpu.ops.svgd import phi, phi_blockwise
 
         def xla_fn(y, x, s):
-            if y.shape[0] * x.shape[0] >= XLA_BLOCKWISE_MIN_PAIRS:
+            # the memory-cliff gate must also see the batched total: a
+            # vmapped call materialises all lanes' Grams at once
+            if y.shape[0] * x.shape[0] * batch_hint >= XLA_BLOCKWISE_MIN_PAIRS:
                 return phi_blockwise(y, x, s, kernel)
             return phi(y, x, s, kernel)
 

@@ -172,15 +172,17 @@ def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, phi_fn, num_sh
 
 
 def _builder_prelude(logp, kernel, phi_impl, log_prior, batch_size,
-                     n_local_data):
+                     n_local_data, phi_batch_hint=1):
     """Shared construction of every step builder's numeric machinery —
     one definition so the per-step, Gauss-Seidel, lagged, and W2 builders
-    cannot drift on score/prior/φ semantics."""
+    cannot drift on score/prior/φ semantics.  ``phi_batch_hint`` feeds the
+    φ 'auto' thresholds (how many lanes run as one batched kernel —
+    ops/pallas_svgd.py:resolve_phi_fn)."""
     if batch_size is not None and not 0 < batch_size <= n_local_data:
         raise ValueError(
             f"batch_size {batch_size} not in (0, {n_local_data}] local rows"
         )
-    phi_fn = resolve_phi_fn(kernel, phi_impl)
+    phi_fn = resolve_phi_fn(kernel, phi_impl, phi_batch_hint)
     batched_score = jax.vmap(jax.grad(logp, argnums=0), in_axes=(0, None))
     if log_prior is not None:
         batched_prior = jax.vmap(jax.grad(log_prior))
@@ -202,6 +204,7 @@ def make_shard_step(
     log_prior: Optional[Callable] = None,
     phi_impl: str = "xla",
     update_rule: str = "jacobi",
+    phi_batch_hint: int = 1,
 ) -> Callable:
     """Build the per-shard SVGD step for one exchange strategy.
 
@@ -262,6 +265,11 @@ def make_shard_step(
         1-based step counter driving the ``partitions`` rotation.
     """
     if update_rule == "gauss_seidel":
+        # the GS sweep's phi calls are single-row (1, m) probes inside a
+        # lax.scan, not equal batched lane blocks -- the batching-amortised
+        # thresholds the hint encodes do not apply (and would route the
+        # degenerate shape to a 94%-padded pallas tile); keep the per-call
+        # gate
         return _build_gs_step(
             logp, kernel, mode, num_shards, n_local_data, score_scale,
             ring, shard_data, batch_size, log_prior, phi_impl,
@@ -270,7 +278,7 @@ def make_shard_step(
         raise ValueError(f"unknown update_rule {update_rule!r}")
     core = _build_core(
         logp, kernel, mode, num_shards, n_local_data, score_scale,
-        ring, shard_data, batch_size, log_prior, phi_impl,
+        ring, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint,
     )
 
     def step(block, data, w_grad_block, t, key, step_size, h):
@@ -283,7 +291,7 @@ def make_shard_step(
 
 def _build_gs_step(
     logp, kernel, mode, num_shards, n_local_data, score_scale,
-    ring, shard_data, batch_size, log_prior, phi_impl,
+    ring, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint=1,
 ):
     """The literal Gauss–Seidel per-shard step (see ``make_shard_step``).
 
@@ -306,7 +314,8 @@ def _build_gs_step(
         raise ValueError("shard_data is unsupported in partitions mode")
 
     phi_fn, batched_score, batched_prior = _builder_prelude(
-        logp, kernel, phi_impl, log_prior, batch_size, n_local_data
+        logp, kernel, phi_impl, log_prior, batch_size, n_local_data,
+        phi_batch_hint,
     )
 
     resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
@@ -352,7 +361,7 @@ def _build_gs_step(
 
 def _build_core(
     logp, kernel, mode, num_shards, n_local_data, score_scale,
-    ring, shard_data, batch_size, log_prior, phi_impl,
+    ring, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint=1,
 ):
     """Shared exchange+φ computation: ``core(block, data, t, key) ->
     (delta, interacting)`` where ``interacting`` is the pre-update all-gather
@@ -364,7 +373,8 @@ def _build_core(
     if shard_data and mode == PARTITIONS:
         raise ValueError("shard_data is unsupported in partitions mode")
     phi_fn, batched_score, batched_prior = _builder_prelude(
-        logp, kernel, phi_impl, log_prior, batch_size, n_local_data
+        logp, kernel, phi_impl, log_prior, batch_size, n_local_data,
+        phi_batch_hint,
     )
 
     resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
@@ -423,6 +433,7 @@ def make_shard_step_lagged(
     batch_size: Optional[int] = None,
     log_prior: Optional[Callable] = None,
     phi_impl: str = "xla",
+    phi_batch_hint: int = 1,
 ) -> Callable:
     """Lagged (stale) ``all_particles`` exchange: one ``lax.all_gather``
     per ``exchange_every`` SVGD steps instead of per step.
@@ -457,7 +468,8 @@ def make_shard_step_lagged(
     if exchange_every < 1:
         raise ValueError(f"exchange_every must be >= 1, got {exchange_every}")
     phi_fn, batched_score, batched_prior = _builder_prelude(
-        logp, kernel, phi_impl, log_prior, batch_size, n_local_data
+        logp, kernel, phi_impl, log_prior, batch_size, n_local_data,
+        phi_batch_hint,
     )
     resolve_data = _shard_data_resolver(
         ALL_PARTICLES, num_shards, n_local_data, shard_data
@@ -508,6 +520,7 @@ def make_shard_step_sinkhorn_w2(
     sinkhorn_iters: int = 200,
     sinkhorn_tol: Optional[float] = None,
     sinkhorn_warm_start: bool = True,
+    phi_batch_hint: int = 1,
 ) -> Callable:
     """Per-shard SVGD step with the Wasserstein/JKO term computed **inside
     the step** from carried previous-snapshot state, so whole W2 trajectories
@@ -553,7 +566,7 @@ def make_shard_step_sinkhorn_w2(
 
     core = _build_core(
         logp, kernel, mode, num_shards, n_local_data, score_scale,
-        False, shard_data, batch_size, log_prior, phi_impl,
+        False, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint,
     )
     # prev_for[b] = previous[(b+1) % S]  (np.roll(prev, -1) device-side)
     roll_perm = [(j, (j - 1) % num_shards) for j in range(num_shards)]

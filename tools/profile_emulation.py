@@ -75,7 +75,7 @@ def main():
     P0 = init_particles_per_shard(0, N, d, S)
     eps = jnp.float32(3e-3)
     kernel = RBF(1.0)
-    phi_auto = resolve_phi_fn(kernel, "auto")
+    phi_auto = resolve_phi_fn(kernel, "auto", S)  # DistSampler's emulation hint
 
     score_fn = jax.grad(logreg_logp, argnums=0)
     batched_score = jax.vmap(score_fn, in_axes=(0, None))
