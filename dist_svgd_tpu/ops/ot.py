@@ -291,6 +291,17 @@ def _sinkhorn_solve(cost, m, n, eps, iters, tol, absorb_every, g_init):
 #: (the measured win at the north-star shard shape is 1.10× — docs/notes.md).
 FUSED_SINKHORN_MIN_PAIRS = 1 << 20
 
+#: Above this many pairs ``impl='auto'`` switches to the O(n·d)-memory
+#: streaming solve (ops/pallas_ot.py:sinkhorn_grad_streaming): 2²⁸ pairs is
+#: a 1 GB f32 kernel matrix *per shard* — materialising one per vmap lane
+#: (8 GB at S=8) is the HBM cliff the streaming path exists to avoid; below
+#: it the materialised solvers are strictly faster.  The rescue applies to
+#: the streaming path's own domain only (f32, d ≤ SMALL_D); ineligible
+#: problems past the cliff fall through to the materialised XLA path with
+#: an explicit warning (they will likely OOM on a TPU — cast to f32 /
+#: reduce d, or force ``impl='xla'`` on a large-memory host).
+FUSED_SINKHORN_STREAM_MIN_PAIRS = 1 << 28
+
 
 def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
                               iters: int = 200, tol: float | None = None,
@@ -335,16 +346,40 @@ def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
 
         on_tpu = pallas_available()
         small_d = x.shape[1] <= SMALL_D
-        big = x.shape[0] * y.shape[0] >= FUSED_SINKHORN_MIN_PAIRS
+        pairs = x.shape[0] * y.shape[0]
+        big = pairs >= FUSED_SINKHORN_MIN_PAIRS
         # the fused path is f32-internal; honor other dtypes via XLA
         f32 = (x.dtype == jnp.float32 and y.dtype == jnp.float32)
+        if (on_tpu and pairs >= FUSED_SINKHORN_STREAM_MIN_PAIRS
+                and not (small_d and f32)):
+            import warnings
+
+            warnings.warn(
+                f"sinkhorn solve with {pairs:.2e} cost entries (dtype "
+                f"{x.dtype}, d={x.shape[1]}) is past the streaming-rescue "
+                "threshold but ineligible for the O(n*d) streaming path "
+                "(f32, d <= SMALL_D only); the materialised XLA solve "
+                "will likely exhaust TPU HBM — cast to float32 / reduce d, "
+                "or force impl='xla' deliberately on a large-memory host",
+                stacklevel=2,
+            )
         if impl == "pallas" or (on_tpu and small_d and big and f32):
             if not small_d:
                 raise ValueError(
                     f"impl='pallas' requires d <= {SMALL_D}, got {x.shape[1]}"
                 )
-            from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_fused
+            from dist_svgd_tpu.ops.pallas_ot import (
+                sinkhorn_grad_fused,
+                sinkhorn_grad_streaming,
+            )
 
+            if x.shape[0] * y.shape[0] >= FUSED_SINKHORN_STREAM_MIN_PAIRS:
+                # past the HBM cliff: never materialise the kernel matrix
+                return sinkhorn_grad_streaming(
+                    x, y, eps=eps, iters=iters, tol=tol,
+                    absorb_every=absorb_every, g_init=g_init,
+                    return_g=return_g, interpret=not on_tpu,
+                )
             return sinkhorn_grad_fused(
                 x, y, eps=eps, iters=iters, tol=tol,
                 absorb_every=absorb_every, g_init=g_init, return_g=return_g,

@@ -50,7 +50,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-# lax used only via jax.lax.Precision in the matvec finish
+from jax import lax
 from jax.experimental import pallas as pl
 
 from dist_svgd_tpu.ops.pallas_svgd import (
@@ -319,6 +319,56 @@ def plan_grad(rows, cols, f, g, inv_reg: float, interpret: bool = False):
     return out[:k, :d]
 
 
+def _solve_setup(particles, previous, eps, g_init, interpret):
+    """Shared preamble of the fused and streaming solves: f32 cast, the
+    closed-form distance mean (module docstring), the reg-rescaling to
+    inv_reg == 1 kernels, and the cold/warm dual start (the soft
+    c-transform pair of the carried g — ops/ot.py:_sinkhorn_start's
+    contract, in rescaled units).  One copy so the warm-start safety
+    semantics cannot drift between the two Pallas paths."""
+    x = jnp.asarray(particles, jnp.float32)
+    y = jnp.asarray(previous, jnp.float32)
+    m, d = x.shape
+    n = y.shape[0]
+    dt = jnp.float32
+    tiny = jnp.finfo(dt).tiny
+
+    # mean(C) without a C pass: E||x-y||^2 = E||x||^2 + E||y||^2 - 2*Ex.Ey
+    mean_c = (jnp.mean(jnp.sum(x * x, axis=1))
+              + jnp.mean(jnp.sum(y * y, axis=1))
+              - 2.0 * jnp.dot(jnp.mean(x, axis=0), jnp.mean(y, axis=0)))
+    mean_c = jnp.maximum(mean_c, tiny)
+    reg = eps * mean_c
+    a = jnp.asarray(1.0 / m, dt)
+    b = jnp.asarray(1.0 / n, dt)
+
+    # The Pallas kernels take inv_reg as a STATIC float, but reg is traced
+    # (it depends on the particle positions).  Rescale instead: with
+    # C' = C/reg, potentials in units of reg (f' = f/reg), every kernel
+    # runs at inv_reg == 1:  exp((f+g-C)/reg) == exp(f'+g'-C'), and
+    # C'(x', y') for x' = x/sqrt(reg) is exactly ||x'-y'||^2.  The same
+    # rescaling identity the adaptive-bandwidth phi path uses
+    # (ops/pallas_svgd.py:resolve_phi_fn).
+    sr = jnp.sqrt(reg)
+    xs_, ys_ = x / sr, y / sr
+
+    def ct(rows, cols, pot, soft):
+        return ctransform_reduce(rows, cols, pot, 1.0, soft,
+                                 interpret=interpret)
+
+    if g_init is None:
+        f0 = ct(xs_, ys_, jnp.zeros((n,), dt), soft=False)   # min_j C'_ij
+        g0 = ct(ys_, xs_, f0, soft=False)                    # c-transform
+    else:
+        # warm start: the soft c-transform pair of the carried g
+        # (ops/ot.py:_sinkhorn_start — both passes kept; the column-side
+        # tightening is the safety pin for arbitrary g_init)
+        gi = jnp.asarray(g_init, dt) / reg
+        f0 = jnp.log(a) - ct(xs_, ys_, gi, soft=True)
+        g0 = jnp.log(b) - ct(ys_, xs_, f0, soft=True)
+    return xs_, ys_, f0, g0, reg, sr, a, b, m, n, dt, tiny
+
+
 def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
                         iters: int = 200, tol=None, absorb_every: int = 10,
                         g_init=None, return_g: bool = False,
@@ -345,46 +395,9 @@ def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
     """
     if absorb_every <= 0:
         raise ValueError(f"absorb_every must be positive, got {absorb_every}")
-    x = jnp.asarray(particles, jnp.float32)
-    y = jnp.asarray(previous, jnp.float32)
-    m, d = x.shape
-    n = y.shape[0]
-    dt = jnp.float32
-    tiny = jnp.finfo(dt).tiny
-
-    # mean(C) without a C pass: E‖x−y‖² = E‖x‖² + E‖y‖² − 2·Ex·Ey
-    mean_c = (jnp.mean(jnp.sum(x * x, axis=1))
-              + jnp.mean(jnp.sum(y * y, axis=1))
-              - 2.0 * jnp.dot(jnp.mean(x, axis=0), jnp.mean(y, axis=0)))
-    mean_c = jnp.maximum(mean_c, tiny)
-    reg = eps * mean_c
-    a = jnp.asarray(1.0 / m, dt)
-    b = jnp.asarray(1.0 / n, dt)
-
-    # The Pallas kernels take inv_reg as a STATIC float, but reg is traced
-    # (it depends on the particle positions).  Rescale instead: with
-    # C' = C/reg, potentials in units of reg (f' = f/reg), every kernel
-    # runs at inv_reg == 1:  exp((f+g−C)/reg) == exp(f'+g'−C'), and
-    # C'(x', y') for x' = x/sqrt(reg) is exactly ‖x'−y'‖².  The same
-    # rescaling identity the adaptive-bandwidth φ path uses
-    # (ops/pallas_svgd.py:resolve_phi_fn).
-    sr = jnp.sqrt(reg)
-    xs_, ys_ = x / sr, y / sr
-
-    def ct(rows, cols, pot, soft):
-        return ctransform_reduce(rows, cols, pot, 1.0, soft,
-                                 interpret=interpret)
-
-    if g_init is None:
-        f0 = ct(xs_, ys_, jnp.zeros((n,), dt), soft=False)   # min_j C'_ij
-        g0 = ct(ys_, xs_, f0, soft=False)                    # c-transform
-    else:
-        # warm start: the soft c-transform pair of the carried g
-        # (ops/ot.py:_sinkhorn_start — both passes kept; the column-side
-        # tightening is the safety pin for arbitrary g_init)
-        gi = jnp.asarray(g_init, dt) / reg
-        f0 = jnp.log(a) - ct(xs_, ys_, gi, soft=True)
-        g0 = jnp.log(b) - ct(ys_, xs_, f0, soft=True)
+    (xs_, ys_, f0, g0, reg, sr, a, b,
+     m, n, dt, tiny) = _solve_setup(particles, previous, eps, g_init,
+                                    interpret)
 
     # ONE copy of the absorbed-scaling loop, shared with the XLA path
     # (ops/ot.py:_sinkhorn_scaling_loop): only the kernel builder differs
@@ -412,6 +425,171 @@ def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
         kmat, v[:, None] * ys_, precision=jax.lax.Precision.HIGHEST
     )
     grad = (xs_ * row[:, None] - py) * sr
+    if return_g:
+        return grad.astype(particles.dtype), (g * reg).astype(particles.dtype)
+    return grad.astype(particles.dtype)
+
+
+def _kmat_vec_kernel(y_ref, xT_ref, f_ref, g_ref, rT_ref, o_ref, acc_ref, *,
+                     inv_reg: float, d_true: int, r_true: int, nm: int):
+    """Accumulate ``Σ_j P_ij · R_jc`` per output tile without materialising
+    P: the absorbed-kernel tile is rebuilt from coordinates (the
+    :func:`_d2_tile` broadcast) and contracted against the RHS columns as
+    per-column VPU reductions — :func:`_plan_grad_kernel`'s pattern with an
+    arbitrary (small, static) RHS instead of the coordinates."""
+    j = pl.program_id(1)
+    d2 = _d2_tile(y_ref[:], xT_ref[:], d_true)
+    p = jnp.exp((f_ref[:, :1] + g_ref[:] - d2) * inv_reg)  # (bk, bm)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    cols = [
+        jnp.sum(p * rT_ref[c:c + 1, :], axis=1, keepdims=True)
+        for c in range(r_true)
+    ]
+    pad = acc_ref.shape[1] - r_true
+    acc_ref[:] = acc_ref[:] + jnp.concatenate(
+        cols + [jnp.zeros((p.shape[0], pad), jnp.float32)], axis=1
+    )
+
+    @pl.when(j == nm - 1)
+    def _():
+        o_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("inv_reg", "interpret"))
+def kmat_vec(rows, cols, f, g, rhs, inv_reg: float, interpret: bool = False):
+    """Streaming absorbed-kernel mat-vec/mat-mat: ``out = P @ rhs`` with
+    ``P_ij = exp((f_i + g_j − C_ij)·inv_reg)`` rebuilt tile-by-tile — O(n·d)
+    memory, no ``(k, m)`` matrix ever exists.  ``rhs`` is ``(m,)`` or
+    ``(m, r)`` with small static ``r`` (≤ :data:`SMALL_D`).  The transpose
+    product ``Pᵀ u`` is the same kernel with the roles (and potentials)
+    swapped: ``kmat_vec(cols, rows, g, f, u, inv_reg)``."""
+    squeeze = rhs.ndim == 1
+    if squeeze:
+        rhs = rhs[:, None]
+    k, d = rows.shape
+    m, r = rhs.shape
+    assert d <= SMALL_D and r <= SMALL_D, (d, r)
+    f32 = jnp.float32
+    bk, bm = _blocks(k, m, _BLOCK_K, _BLOCK_M)
+    kp, mp = _round_up(k, bk), _round_up(m, bm)
+    nm = mp // bm
+
+    y = _pad_to(rows.astype(f32), kp, 128)
+    # padded columns: P underflows to an exact 0.0 there (clamped sentinel
+    # distance), so the rhs pad value never reaches the accumulators
+    xT = _pad_to(cols.T.astype(f32), SMALL_D, mp, value=_FAR)
+    fp = _pad_to(f.astype(f32)[:, None], kp, 128)
+    gp = _pad_to(g.astype(f32)[None, :], 1, mp)
+    rT = _pad_to(rhs.T.astype(f32), SMALL_D, mp)
+
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    scratch = (
+        [pltpu.VMEM((bk, 128), f32)]
+        if pltpu is not None
+        else [jax.ShapeDtypeStruct((bk, 128), f32)]
+    )
+    out = pl.pallas_call(
+        functools.partial(_kmat_vec_kernel, inv_reg=float(inv_reg),
+                          d_true=d, r_true=r, nm=nm),
+        out_shape=jax.ShapeDtypeStruct((kp, 128), f32),
+        grid=(kp // bk, nm),
+        in_specs=[
+            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
+            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((1, bm), lambda i, j: (0, j), **vmem),
+            pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
+        ],
+        out_specs=pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(y, xT, fp, gp, rT)
+    out = out[:k, :r]
+    return out[:, 0] if squeeze else out
+
+
+def sinkhorn_grad_streaming(particles, previous, eps: float = 0.05,
+                            iters: int = 200, tol=None,
+                            absorb_every: int = 10, g_init=None,
+                            return_g: bool = False,
+                            interpret: bool = False):
+    """W2 gradient with O(n·d) memory — for particle counts where even ONE
+    ``(n/S, n)`` kernel matrix does not fit HBM (the exchanged-mode W2
+    snapshot pairs each block against the full previous set, so at n=100k
+    a per-shard kmat is 5 GB and the materialised solvers OOM; the plain
+    SVGD step handles 1M particles via the same streaming idea —
+    docs/notes.md large-n section).
+
+    Same algorithm and exit semantics as the other two paths, but every
+    scaling matvec rebuilds the absorbed kernel from coordinates
+    (:func:`kmat_vec`) instead of reusing a materialised block kernel —
+    trading ~``2·absorb_every`` extra tile-recompute passes per block for
+    never holding the matrix.  The finish is :func:`plan_grad` (one more
+    rebuild pass; there is no kmat to matvec against).  Use only when
+    memory demands it: at materialisable sizes the fused/XLA paths are
+    strictly faster (``FUSED_SINKHORN_STREAM_MIN_PAIRS`` in ops/ot.py
+    gates the auto choice).
+    """
+    (xs_, ys_, f0, g0, reg, sr, a, b,
+     m, n, dt, tiny) = _solve_setup(particles, previous, eps, g_init,
+                                    interpret)
+
+    # The shared loop's contract is a materialised kmat; here the matvecs
+    # stream instead, so the loop is restated with closure matvecs (same
+    # block structure, clamps, and exit statistic —
+    # ops/ot.py:_sinkhorn_scaling_loop).
+    def run_block(f, g, k_iters: int):
+        def one(v):
+            u = a / jnp.maximum(
+                kmat_vec(xs_, ys_, f, g, v, 1.0, interpret=interpret), tiny
+            )
+            vt = kmat_vec(ys_, xs_, g, f, u, 1.0, interpret=interpret)
+            return u, b / jnp.maximum(vt, tiny)
+
+        v = lax.fori_loop(
+            0, k_iters - 1, lambda _, v: one(v)[1], jnp.ones((n,), dt)
+        )
+        u, new_v = one(v)
+        delta = jnp.max(jnp.abs(jnp.log(new_v) - jnp.log(v)))
+        return f + jnp.log(u), g + jnp.log(new_v), delta
+
+    if iters < 1:
+        raise ValueError(f"the scaling loop needs iters >= 1, got {iters}")
+    if absorb_every <= 0:
+        raise ValueError(f"absorb_every must be positive, got {absorb_every}")
+    absorb_every = min(absorb_every, iters)
+    blocks, rem = divmod(iters, absorb_every)
+    if tol is None:
+        def body(_, carry):
+            f, g = carry
+            f, g, _ = run_block(f, g, absorb_every)
+            return f, g
+
+        f, g = lax.fori_loop(0, blocks, body, (f0, g0))
+        if rem:
+            f, g, _ = run_block(f, g, rem)
+    else:
+        thresh = jnp.asarray(tol, dt)
+        total = blocks + (1 if rem else 0)
+
+        def cond(carry):
+            i, _, _, delta = carry
+            return (i < total) & (delta > thresh)
+
+        def wbody(carry):
+            i, f, g, _ = carry
+            f, g, delta = run_block(f, g, absorb_every)
+            return i + 1, f, g, delta
+
+        _, f, g, _ = lax.while_loop(
+            cond, wbody, (0, f0, g0, jnp.asarray(jnp.inf, dt))
+        )
+
+    grad = plan_grad(xs_, ys_, f, g, 1.0, interpret=interpret) * sr
     if return_g:
         return grad.astype(particles.dtype), (g * reg).astype(particles.dtype)
     return grad.astype(particles.dtype)

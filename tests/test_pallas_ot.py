@@ -172,3 +172,122 @@ def test_fused_sinkhorn_under_shard_map(rng):
         for r in range(S)
     ])
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_kmat_vec_matches_dense(rng):
+    """Streaming P@rhs (vector and multi-column) vs the dense product,
+    including the transpose call convention."""
+    from dist_svgd_tpu.ops.pallas_ot import kmat_vec
+
+    x, y = _pts(rng, 23, 41)
+    f = jnp.asarray(rng.normal(size=23) * 0.5, jnp.float32)
+    g = jnp.asarray(rng.normal(size=41) * 0.5, jnp.float32)
+    c = np.asarray(squared_distances(x, y))
+    p = np.exp(np.asarray(f)[:, None] + np.asarray(g)[None, :] - c)
+    v = jnp.asarray(rng.normal(size=41), jnp.float32)
+    got = np.asarray(kmat_vec(x, y, f, g, v, 1.0, interpret=True))
+    np.testing.assert_allclose(got, p @ np.asarray(v), rtol=1e-5, atol=1e-5)
+    # multi-column rhs
+    R = jnp.asarray(rng.normal(size=(41, 3)), jnp.float32)
+    got = np.asarray(kmat_vec(x, y, f, g, R, 1.0, interpret=True))
+    np.testing.assert_allclose(got, p @ np.asarray(R), rtol=1e-5, atol=1e-5)
+    # transpose convention: P^T u via swapped roles and potentials
+    u = jnp.asarray(rng.normal(size=23), jnp.float32)
+    got = np.asarray(kmat_vec(y, x, g, f, u, 1.0, interpret=True))
+    np.testing.assert_allclose(got, p.T @ np.asarray(u), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("tol", [None, 1e-2])
+@pytest.mark.parametrize("warm", [False, True])
+def test_streaming_grad_matches_xla_path(rng, tol, warm):
+    """The O(n*d)-memory streaming solve equals the XLA solve — same
+    algorithm, the kernel matrix just never exists."""
+    from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_streaming
+
+    x, y = _pts(rng, 24, 40)
+    g_init = None
+    if warm:
+        _, g_init = wasserstein_grad_sinkhorn(
+            x + 0.01, y, eps=0.05, iters=100, return_g=True
+        )
+    want, want_g = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=60, tol=tol, g_init=g_init, return_g=True,
+        impl="xla",
+    )
+    got, got_g = sinkhorn_grad_streaming(
+        x, y, eps=0.05, iters=60, tol=tol, g_init=g_init, return_g=True,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_auto_dispatch_reaches_streaming_under_vmap(rng, monkeypatch):
+    """The production entry to the streaming solve: impl dispatch past the
+    (monkeypatched) HBM-cliff threshold, per-lane under jax.vmap — the
+    nested kmat_vec-inside-fori-inside-while structure a batching
+    regression would break."""
+    import jax
+
+    from dist_svgd_tpu.ops import ot
+    from dist_svgd_tpu.ops import pallas_ot
+
+    monkeypatch.setattr(ot, "FUSED_SINKHORN_STREAM_MIN_PAIRS", 1)
+    calls = []
+    orig = pallas_ot.sinkhorn_grad_streaming
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pallas_ot, "sinkhorn_grad_streaming", spy)
+    S = 3
+    x = jnp.asarray(rng.normal(size=(S, 10, 3)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(S, 20, 3)) + 0.2, jnp.float32)
+    got = np.asarray(jax.vmap(
+        lambda c, p: wasserstein_grad_sinkhorn(
+            c, p, eps=0.05, iters=40, tol=1e-2, impl="pallas"
+        )
+    )(x, y))
+    assert calls, "dispatch did not reach the streaming path"
+    want = np.stack([
+        np.asarray(wasserstein_grad_sinkhorn(
+            x[r], y[r], eps=0.05, iters=40, tol=1e-2, impl="xla"))
+        for r in range(S)
+    ])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_streaming_under_shard_map(rng):
+    """sinkhorn_grad_streaming traced inside shard_map over a real
+    (virtual-CPU) mesh — mirrors test_fused_sinkhorn_under_shard_map."""
+    import jax
+
+    from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_streaming
+    from dist_svgd_tpu.parallel.mesh import bind_shard_fn, make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a 4-device mesh")
+    S = 4
+    x = jnp.asarray(rng.normal(size=(S * 8, 3)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(S * 16, 3)) + 0.2, jnp.float32)
+    mesh = make_mesh(S)
+    assert mesh is not None
+
+    def shard_fn(block, prev):
+        return sinkhorn_grad_streaming(
+            block, prev, eps=0.05, iters=40, interpret=True
+        )
+
+    bound = bind_shard_fn(shard_fn, S, mesh, in_specs=(0, 0), out_specs=(0,))
+    got = np.asarray(jax.jit(bound)(x, y))
+    want = np.concatenate([
+        np.asarray(wasserstein_grad_sinkhorn(
+            x[r * 8:(r + 1) * 8], y[r * 16:(r + 1) * 16],
+            eps=0.05, iters=40, impl="xla",
+        ))
+        for r in range(S)
+    ])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
