@@ -191,20 +191,31 @@ def _sinkhorn_start(cost, eps: float, g_init):
     return f0, g0
 
 
-def _sinkhorn_scaling_loop(f0, g0, build_kmat, fold_scale, m, n,
-                           iters, tol, absorb_every, dt):
-    """The absorbed-scaling loop shared by the XLA path (below) and the
-    fused Pallas path (ops/pallas_ot.py) — ONE copy of the block
-    structure, tol-exit statistic, and u/v clamps, parametrised over how
-    the absorbed kernel is built (dense exp over a materialised cost vs a
-    fused VMEM-streaming kernel) and the potential units (``fold_scale`` =
-    ``reg`` in cost units, ``1.0`` in reg-rescaled units).
+def _sinkhorn_scaling_loop(f0, g0, make_kernel_ops, fold_scale, m, n,
+                           iters, tol, absorb_every, dt,
+                           carry_kmat: bool = True):
+    """The absorbed-scaling loop shared by the XLA path (below), the fused
+    Pallas path, and the streaming Pallas path (ops/pallas_ot.py) — ONE
+    copy of the block structure, tol-exit statistic, and u/v clamps,
+    parametrised over the absorbed-kernel matvecs:
 
-    Returns ``(f, g, kmat, u, v)``: the folded potentials plus the LAST
-    block's absorbed kernel and scalings — ``plan = u·kmat·v`` entrywise,
-    exactly (``f = f_pre + fold_scale·log u`` folds the same factors the
-    product applies), so consumers need no further pass over the cost.
-    Requires ``iters >= 1``.
+    ``make_kernel_ops(f, g) -> (mv, rmv, kmat)`` where ``mv(v) ≈ K @ v``
+    and ``rmv(u) ≈ Kᵀ @ u`` against the absorbed kernel
+    ``K = exp((f + g − C)·inv_reg)``.  ``kmat`` is the materialised kernel
+    when one exists (dense exp over a cost matrix, or the fused
+    VMEM-streaming ``kexp`` build) and is threaded through the loop carry
+    so the LAST block's kernel survives for the matvec-finish gradient;
+    streaming callers whose matvecs rebuild tiles from coordinates pass
+    ``kmat=None`` with ``carry_kmat=False`` and the loop carries only the
+    potentials (O(n·d) memory — no kernel-sized buffer ever exists).
+    ``fold_scale`` sets the potential units (``reg`` in cost units,
+    ``1.0`` in reg-rescaled units).
+
+    Returns ``(f, g, kmat, u, v)`` when ``carry_kmat`` — ``plan =
+    u·kmat·v`` entrywise, exactly (``f = f_pre + fold_scale·log u`` folds
+    the same factors the product applies), so consumers need no further
+    pass over the cost — and ``(f, g)`` otherwise.  Requires
+    ``iters >= 1``.
     """
     if absorb_every <= 0:
         raise ValueError(f"absorb_every must be positive, got {absorb_every}")
@@ -216,38 +227,40 @@ def _sinkhorn_scaling_loop(f0, g0, build_kmat, fold_scale, m, n,
 
     def run_block(f, g, k_iters: int):
         """``k_iters`` scaling iterations against the absorbed kernel;
-        returns folded potentials, the block's (kmat, u, v), and the last
-        iteration's ``log v`` sup-change (the convergence statistic)."""
-        kmat = build_kmat(f, g)
+        returns folded potentials, the block's (kmat, u, v) payload, and
+        the last iteration's ``log v`` sup-change (the convergence
+        statistic)."""
+        mv, rmv, kmat = make_kernel_ops(f, g)
 
         def one(v):
-            u = a / jnp.maximum(kmat @ v, tiny)
-            return u, b / jnp.maximum(kmat.T @ u, tiny)
+            u = a / jnp.maximum(mv(v), tiny)
+            return u, b / jnp.maximum(rmv(u), tiny)
 
         v = lax.fori_loop(
             0, k_iters - 1, lambda _, v: one(v)[1], jnp.ones((n,), dt)
         )
         u, new_v = one(v)
         delta = jnp.max(jnp.abs(jnp.log(new_v) - jnp.log(v)))
+        payload = (kmat, u, new_v) if carry_kmat else ()
         return (f + fold_scale * jnp.log(u), g + fold_scale * jnp.log(new_v),
-                kmat, u, new_v, delta)
+                payload, delta)
 
     absorb_every = min(absorb_every, iters)  # short runs stay exact
     blocks, rem = divmod(iters, absorb_every)
-    kmat0 = jnp.zeros((m, n), dt)
-    u0 = jnp.ones((m,), dt)
-    v0 = jnp.ones((n,), dt)
+    payload0 = (
+        (jnp.zeros((m, n), dt), jnp.ones((m,), dt), jnp.ones((n,), dt))
+        if carry_kmat
+        else ()
+    )
     if tol is None:
         def body(_, carry):
-            f, g, *_ = carry
-            f, g, kmat, u, v, _ = run_block(f, g, absorb_every)
-            return f, g, kmat, u, v
+            f, g, _ = carry
+            f, g, payload, _ = run_block(f, g, absorb_every)
+            return f, g, payload
 
-        f, g, kmat, u, v = lax.fori_loop(
-            0, blocks, body, (f0, g0, kmat0, u0, v0)
-        )
+        f, g, payload = lax.fori_loop(0, blocks, body, (f0, g0, payload0))
         if rem:
-            f, g, kmat, u, v, _ = run_block(f, g, rem)
+            f, g, payload, _ = run_block(f, g, rem)
     else:
         thresh = jnp.asarray(tol, dt)
         total = blocks + (1 if rem else 0)
@@ -257,17 +270,20 @@ def _sinkhorn_scaling_loop(f0, g0, build_kmat, fold_scale, m, n,
             return (i < total) & (delta > thresh)
 
         def body(carry):
-            i, f, g, *_ = carry
+            i, f, g, _, _ = carry
             # uniform block length keeps one compiled body; the cap may
             # overshoot ``iters`` by < absorb_every on the last block
-            f, g, kmat, u, v, delta = run_block(f, g, absorb_every)
-            return i + 1, f, g, kmat, u, v, delta
+            f, g, payload, delta = run_block(f, g, absorb_every)
+            return i + 1, f, g, payload, delta
 
-        _, f, g, kmat, u, v, _ = lax.while_loop(
+        _, f, g, payload, _ = lax.while_loop(
             cond, body,
-            (0, f0, g0, kmat0, u0, v0, jnp.asarray(jnp.inf, dt)),
+            (0, f0, g0, payload0, jnp.asarray(jnp.inf, dt)),
         )
-    return f, g, kmat, u, v
+    if carry_kmat:
+        kmat, u, v = payload
+        return f, g, kmat, u, v
+    return f, g
 
 
 def _sinkhorn_solve(cost, m, n, eps, iters, tol, absorb_every, g_init):
@@ -277,10 +293,13 @@ def _sinkhorn_solve(cost, m, n, eps, iters, tol, absorb_every, g_init):
     tiny = jnp.finfo(dt).tiny
     reg = eps * jnp.maximum(jnp.mean(cost), tiny)
     f0, g0 = _sinkhorn_start(cost, eps, g_init)
+
+    def make_ops(f, g):
+        kmat = jnp.exp((f[:, None] + g[None, :] - cost) / reg)
+        return (lambda v: kmat @ v), (lambda u: kmat.T @ u), kmat
+
     f, g, kmat, u, v = _sinkhorn_scaling_loop(
-        f0, g0,
-        lambda f, g: jnp.exp((f[:, None] + g[None, :] - cost) / reg),
-        reg, m, n, iters, tol, absorb_every, dt,
+        f0, g0, make_ops, reg, m, n, iters, tol, absorb_every, dt,
     )
     return f, g, kmat, u, v, reg
 
@@ -295,7 +314,12 @@ FUSED_SINKHORN_MIN_PAIRS = 1 << 20
 #: streaming solve (ops/pallas_ot.py:sinkhorn_grad_streaming): 2²⁸ pairs is
 #: a 1 GB f32 kernel matrix *per shard* — materialising one per vmap lane
 #: (8 GB at S=8) is the HBM cliff the streaming path exists to avoid; below
-#: it the materialised solvers are strictly faster.  The rescue applies to
+#: it the materialised solvers are strictly faster.  Note the materialised
+#: paths transiently hold ~2 kernel-sized buffers near a block boundary
+#: (the loop-carried kmat plus the newly built one, on top of the cost
+#: matrix), so their true OOM threshold sits somewhat below what a
+#: single-kmat estimate suggests — the cliff constant is deliberately
+#: conservative.  The rescue applies to
 #: the streaming path's own domain only (f32, d ≤ SMALL_D); ineligible
 #: problems past the cliff fall through to the materialised XLA path with
 #: an explicit warning (they will likely OOM on a TPU — cast to f32 /
@@ -327,7 +351,10 @@ def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
     ``FUSED_SINKHORN_MIN_PAIRS``+ sizes, measured 1.10× at the north star;
     the XLA path otherwise), ``'xla'``, or ``'pallas'`` (force; runs the
     Pallas interpreter off-TPU — slow, for testing).  Identical semantics
-    either way (tests/test_pallas_ot.py)."""
+    either way (tests/test_pallas_ot.py).  The Pallas solvers are
+    f32-internal: ``'auto'`` routes non-f32 inputs to the XLA path, but a
+    *forced* ``'pallas'`` computes in f32 and casts the result back —
+    a ``UserWarning`` flags the precision loss on f64 inputs."""
     if impl not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown sinkhorn impl {impl!r}")
     x = particles
@@ -350,8 +377,12 @@ def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
         big = pairs >= FUSED_SINKHORN_MIN_PAIRS
         # the fused path is f32-internal; honor other dtypes via XLA
         f32 = (x.dtype == jnp.float32 and y.dtype == jnp.float32)
-        if (on_tpu and pairs >= FUSED_SINKHORN_STREAM_MIN_PAIRS
+        if (impl != "pallas" and on_tpu
+                and pairs >= FUSED_SINKHORN_STREAM_MIN_PAIRS
                 and not (small_d and f32)):
+            # forced 'pallas' is exempt: it routes small-d inputs to the
+            # streaming path itself (f32-internal), so the materialised-XLA
+            # OOM prediction below would be wrong guidance there
             import warnings
 
             warnings.warn(
@@ -367,6 +398,23 @@ def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
             if not small_d:
                 raise ValueError(
                     f"impl='pallas' requires d <= {SMALL_D}, got {x.shape[1]}"
+                )
+            wider_than_f32 = any(
+                jnp.issubdtype(a.dtype, jnp.floating)
+                and jnp.finfo(a.dtype).bits > 32
+                for a in (x, y)
+            )
+            if impl == "pallas" and wider_than_f32:
+                # sub-f32 inputs (bf16/f16) lose nothing to the f32-internal
+                # solve — only genuinely wider dtypes warrant the warning
+                import warnings
+
+                warnings.warn(
+                    f"impl='pallas' computes internally in float32 but got "
+                    f"{x.dtype}/{y.dtype} inputs; the result is cast back "
+                    "but carries f32 precision — use impl='xla' (or 'auto', "
+                    "which routes non-f32 there) for full-precision solves",
+                    stacklevel=2,
                 )
             from dist_svgd_tpu.ops.pallas_ot import (
                 sinkhorn_grad_fused,

@@ -50,7 +50,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 
 from dist_svgd_tpu.ops.pallas_svgd import (
@@ -405,10 +404,12 @@ def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
     # plus the reg-rescaled units (fold_scale 1.0).
     from dist_svgd_tpu.ops.ot import _sinkhorn_scaling_loop
 
+    def make_ops(f, g):
+        kmat = kexp(xs_, ys_, f, g, 1.0, interpret=interpret)
+        return (lambda v: kmat @ v), (lambda u: kmat.T @ u), kmat
+
     f, g, kmat, u, v = _sinkhorn_scaling_loop(
-        f0, g0,
-        lambda f, g: kexp(xs_, ys_, f, g, 1.0, interpret=interpret),
-        1.0, m, n, iters, tol, absorb_every, dt,
+        f0, g0, make_ops, 1.0, m, n, iters, tol, absorb_every, dt,
     )
 
     # Gradient from the last block's (kmat, u, v) — the plan is
@@ -538,56 +539,22 @@ def sinkhorn_grad_streaming(particles, previous, eps: float = 0.05,
      m, n, dt, tiny) = _solve_setup(particles, previous, eps, g_init,
                                     interpret)
 
-    # The shared loop's contract is a materialised kmat; here the matvecs
-    # stream instead, so the loop is restated with closure matvecs (same
-    # block structure, clamps, and exit statistic —
-    # ops/ot.py:_sinkhorn_scaling_loop).
-    def run_block(f, g, k_iters: int):
-        def one(v):
-            u = a / jnp.maximum(
-                kmat_vec(xs_, ys_, f, g, v, 1.0, interpret=interpret), tiny
-            )
-            vt = kmat_vec(ys_, xs_, g, f, u, 1.0, interpret=interpret)
-            return u, b / jnp.maximum(vt, tiny)
+    # The SAME absorbed-scaling loop as the other two paths
+    # (ops/ot.py:_sinkhorn_scaling_loop), with closure matvecs that rebuild
+    # kernel tiles from coordinates and ``carry_kmat=False`` — the loop
+    # then carries only the potentials, so no kernel-sized buffer ever
+    # exists (the whole point of this path).
+    from dist_svgd_tpu.ops.ot import _sinkhorn_scaling_loop
 
-        v = lax.fori_loop(
-            0, k_iters - 1, lambda _, v: one(v)[1], jnp.ones((n,), dt)
-        )
-        u, new_v = one(v)
-        delta = jnp.max(jnp.abs(jnp.log(new_v) - jnp.log(v)))
-        return f + jnp.log(u), g + jnp.log(new_v), delta
+    def make_ops(f, g):
+        mv = lambda v: kmat_vec(xs_, ys_, f, g, v, 1.0, interpret=interpret)
+        rmv = lambda u: kmat_vec(ys_, xs_, g, f, u, 1.0, interpret=interpret)
+        return mv, rmv, None
 
-    if iters < 1:
-        raise ValueError(f"the scaling loop needs iters >= 1, got {iters}")
-    if absorb_every <= 0:
-        raise ValueError(f"absorb_every must be positive, got {absorb_every}")
-    absorb_every = min(absorb_every, iters)
-    blocks, rem = divmod(iters, absorb_every)
-    if tol is None:
-        def body(_, carry):
-            f, g = carry
-            f, g, _ = run_block(f, g, absorb_every)
-            return f, g
-
-        f, g = lax.fori_loop(0, blocks, body, (f0, g0))
-        if rem:
-            f, g, _ = run_block(f, g, rem)
-    else:
-        thresh = jnp.asarray(tol, dt)
-        total = blocks + (1 if rem else 0)
-
-        def cond(carry):
-            i, _, _, delta = carry
-            return (i < total) & (delta > thresh)
-
-        def wbody(carry):
-            i, f, g, _ = carry
-            f, g, delta = run_block(f, g, absorb_every)
-            return i + 1, f, g, delta
-
-        _, f, g, _ = lax.while_loop(
-            cond, wbody, (0, f0, g0, jnp.asarray(jnp.inf, dt))
-        )
+    f, g = _sinkhorn_scaling_loop(
+        f0, g0, make_ops, 1.0, m, n, iters, tol, absorb_every, dt,
+        carry_kmat=False,
+    )
 
     grad = plan_grad(xs_, ys_, f, g, 1.0, interpret=interpret) * sr
     if return_g:

@@ -557,8 +557,9 @@ def make_shard_step_sinkhorn_w2(
     ``ppermute`` roll) — is the *same* every step, so the carried ``g``
     always describes the measure it will warm-start against.  On a
     ``w_on == 0`` step the solve's output duals are zeroed, so the first
-    real solve cold-starts instead of inheriting potentials fitted to the
-    zeros placeholder snapshot.  ``sinkhorn_warm_start=False`` restores the
+    real solve starts from zeroed duals (the safe soft-transform start)
+    instead of inheriting potentials fitted to the zeros placeholder
+    snapshot.  ``sinkhorn_warm_start=False`` restores the
     cold c-transform start on every step (the A/B baseline —
     tools/w2_bench.py).
     """
