@@ -84,7 +84,11 @@ class DistSampler:
             AdaptiveRBF`) re-resolves the bandwidth from each step's
             interaction set *inside* the jitted step (the gathered global
             set in the ``all_*`` modes — identical on every shard — or the
-            owned block in ``partitions``); Jacobi + ``'gather'`` only.
+            owned block in ``partitions``); Jacobi only.  Under
+            ``exchange_impl='ring'`` the same bandwidth is resolved from a
+            gathered ≤``max_points``-row strided subsample (the gather
+            path's exact subsample, so ring ≡ gather holds) without
+            materialising the global set.
         particles: ``(n, d)`` global initial particle array.  Truncated to
             ``S · (n // S)`` rows (reference drop policy).
         data: optional pytree of arrays with a common leading data axis.
@@ -107,9 +111,10 @@ class DistSampler:
             sweep (dsvgd/distsampler.py:194-200), each shard sweeping its own
             block inside its private view via ``lax.scan``; small-n parity
             verification mode (see ``parallel/exchange.py:make_shard_step``).
-            Requires ``exchange_impl='gather'`` and no ``batch_size``; the
-            scanned W2 path (``run_steps`` with the Wasserstein term) stays
-            Jacobi-only — use :meth:`make_step` for GS+W2.
+            Requires ``exchange_impl='gather'`` and no ``batch_size``;
+            composes with the scanned Sinkhorn-W2 path (``run_steps``
+            carries the snapshot through the GS sweep the same way the
+            eager path does) as well as :meth:`make_step`.
         wasserstein_solver: ``'lp'`` (host LP, exact reference parity) or
             ``'sinkhorn'`` (on-device entropic OT, jit-fused fast path;
             ``sinkhorn_eps`` / ``sinkhorn_iters`` configure it, and
@@ -240,20 +245,16 @@ class DistSampler:
         if kernel == "median_step":
             kernel = AdaptiveRBF()
         if isinstance(kernel, AdaptiveRBF):
-            # per-step median of the interaction set: well-defined for the
-            # Jacobi gather paths (and partitions, where the interaction set
-            # *is* the owned block and exchange_impl is ignored); a per-hop
-            # median would silently break the ring implementation's gather
-            # equivalence, and the literal GS sweep exists for reference
-            # parity (fixed bandwidth)
+            # per-step median of the interaction set: the gather paths (and
+            # partitions, where the interaction set *is* the owned block)
+            # resolve it per φ call; the ring implementation resolves the
+            # SAME value once per step from a gathered strided subsample
+            # (parallel/exchange.py:_ring_median_bandwidth — the gather
+            # path's exact subsample, so ring ≡ gather still holds).  The
+            # literal GS sweep exists for reference parity (fixed bandwidth)
             if update_rule != "jacobi":
                 raise ValueError(
                     "kernel='median_step' requires update_rule='jacobi'"
-                )
-            if exchange_impl == "ring" and exchange_particles:
-                raise ValueError(
-                    "kernel='median_step' requires exchange_impl='gather' "
-                    "in the all_* modes"
                 )
         self._kernel = kernel if kernel is not None else RBF(1.0)
         self._exchange_particles = exchange_particles
@@ -338,29 +339,9 @@ class DistSampler:
         self._step = jax.jit(self._bound_step)
         self._exchange_every = int(exchange_every)
         self._bound_lagged = None
+        self._bound_lagged_record = None  # built lazily on first record run
         if self._exchange_every > 1:
-            from dist_svgd_tpu.parallel.exchange import make_shard_step_lagged
-
-            lagged = make_shard_step_lagged(
-                logp=self._logp,
-                kernel=self._kernel,
-                num_shards=self._num_shards,
-                n_local_data=self._rows_per_shard,
-                score_scale=self._score_scale,
-                exchange_every=self._exchange_every,
-                shard_data=shard_data,
-                batch_size=batch_size,
-                log_prior=log_prior,
-                phi_impl=phi_impl,
-                phi_batch_hint=self._phi_batch_hint,
-            )
-            self._bound_lagged = bind_shard_fn(
-                lagged,
-                self._num_shards,
-                self._mesh,
-                in_specs=(0, 0 if shard_data else None, 0, None, None, None, None),
-                out_specs=(0,),
-            )
+            self._bound_lagged = self._bind_lagged(record=False)
         self._scan_cache = {}
         self._bound_w2_step = None  # lazily built by _run_steps_w2
         self._batch_key = minibatch_key(seed)
@@ -379,6 +360,34 @@ class DistSampler:
         # sinkhorn_plan docstring).  None until the first solve; zeros are
         # the cold start.
         self._w2_g = None
+
+    def _bind_lagged(self, record: bool):
+        """Bind the lagged macro-step (``record=True`` additionally emits the
+        per-sub-step pre-update history stack, sharded along its particle
+        axis)."""
+        from dist_svgd_tpu.parallel.exchange import make_shard_step_lagged
+
+        lagged = make_shard_step_lagged(
+            logp=self._logp,
+            kernel=self._kernel,
+            num_shards=self._num_shards,
+            n_local_data=self._rows_per_shard,
+            score_scale=self._score_scale,
+            exchange_every=self._exchange_every,
+            shard_data=self._shard_data,
+            batch_size=self._batch_size,
+            log_prior=self._log_prior,
+            phi_impl=self._phi_impl,
+            phi_batch_hint=self._phi_batch_hint,
+            record=record,
+        )
+        return bind_shard_fn(
+            lagged,
+            self._num_shards,
+            self._mesh,
+            in_specs=(0, 0 if self._shard_data else None, 0, None, None, None, None),
+            out_specs=(0, 1) if record else (0,),
+        )
 
     # ------------------------------------------------------------------ #
     # State views
@@ -618,9 +627,10 @@ class DistSampler:
         and the per-step minibatch key fold advance exactly as the eager path
         does.  Exception: with ``exchange_every > 1`` this method is the
         *only* driver (``make_step`` raises — one gather is amortised over a
-        block of steps, so ``num_steps`` must be a multiple of the cadence
-        and ``record`` is unsupported; sub-step minibatch keys fold
-        ``(key, i)`` within each block).
+        block of steps, so ``num_steps`` must be a multiple of the cadence;
+        sub-step minibatch keys fold ``(key, i)`` within each block;
+        ``record=True`` emits the inner scan's per-sub-step pre-update
+        snapshots, so the history keeps the per-step convention).
 
         With ``record=True`` returns ``(final, history)`` where ``history`` is
         the ``(num_steps, n, d)`` device array of pre-update snapshots (the
@@ -656,11 +666,6 @@ class DistSampler:
                     "wasserstein_solver='sinkhorn' and exchange_impl='gather' "
                     "(the host-LP snapshot path is make_step-only)"
                 )
-            if self._update_rule != "jacobi":
-                raise ValueError(
-                    "run_steps with the Wasserstein term is Jacobi-only; "
-                    "drive update_rule='gauss_seidel' + W2 through make_step"
-                )
             return self._run_steps_w2(num_steps, step_size, h, record)
         lagged = self._exchange_every > 1
         if lagged:
@@ -669,16 +674,15 @@ class DistSampler:
                     f"num_steps ({num_steps}) must be a multiple of "
                     f"exchange_every ({self._exchange_every})"
                 )
-            if record:
-                raise ValueError(
-                    "record=True is unsupported with exchange_every > 1 "
-                    "(history is defined per step, the lagged dispatch "
-                    "advances exchange_every steps at a time)"
-                )
+            if record and self._bound_lagged_record is None:
+                self._bound_lagged_record = self._bind_lagged(record=True)
         dtype = self._particles.dtype
         run = self._scan_cache.get((num_steps, record, lagged))
         if run is None:
-            bound = self._bound_lagged if lagged else self._bound_step
+            if lagged:
+                bound = self._bound_lagged_record if record else self._bound_lagged
+            else:
+                bound = self._bound_step
             stride = self._exchange_every if lagged else 1
 
             @jax.jit
@@ -686,6 +690,10 @@ class DistSampler:
                 def body(parts, t):
                     new = bound(parts, data, jnp.zeros_like(parts), t,
                                 jax.random.fold_in(batch_key, t), eps, h)
+                    if lagged and record:
+                        # the macro emits the per-sub-step history itself
+                        # ((stride, n, d) pre-update snapshots)
+                        return new
                     return new, (parts if record else None)
 
                 # lagged: each scan iteration advances `stride` steps, `t`
@@ -694,6 +702,9 @@ class DistSampler:
                     num_steps // stride, dtype=jnp.int32
                 )
                 out, hist = jax.lax.scan(body, particles, ts)
+                if lagged and record:
+                    # (num_steps/stride, stride, n, d) → per-step history
+                    hist = hist.reshape((num_steps,) + particles.shape)
                 return (out, hist) if record else out
 
             self._scan_cache[(num_steps, record, lagged)] = run
@@ -734,6 +745,7 @@ class DistSampler:
                 sinkhorn_tol=self._sinkhorn_tol,
                 sinkhorn_warm_start=self._sinkhorn_warm_start,
                 phi_batch_hint=self._phi_batch_hint,
+                update_rule=self._update_rule,
             )
             self._bound_w2_step = bind_shard_fn(
                 step,

@@ -151,18 +151,62 @@ def median_bandwidth_approx(
     # diagonal zeros always fall below any positive threshold, so they are
     # simply added to the target rank instead of being masked out
     target = p + (p * p - p + 1) // 2
+    med_sq = _median_bracket(sq, target, probes)
+    return med_sq / math.log(full_n + 1.0)
+
+
+def _median_bracket(sq, target: int, probes: int, pair=None):
+    """The four-pass counting bracket shared by the plain and masked median
+    estimators — ONE copy of the thresholds, rank comparison, midpoint, and
+    floor, so the ring ≡ gather bandwidth guarantee cannot drift between
+    the twins.  ``pair`` (optional boolean matrix) restricts both the
+    counts and the initial width to valid entries; ``None`` keeps the
+    unmasked hot path free of mask arithmetic."""
     ks = jnp.arange(1, probes + 1, dtype=sq.dtype)
 
     def refine(lo, width):
-        t = lo + width * ks / probes                              # (probes,)
-        cnt = jnp.sum(sq[None] <= t[:, None, None], axis=(1, 2))  # (probes,)
+        t = lo + width * ks / probes                      # (probes,)
+        hit = sq[None] <= t[:, None, None]
+        if pair is not None:
+            hit = hit & pair[None]
+        cnt = jnp.sum(hit, axis=(1, 2))                   # (probes,)
         i = jnp.argmax(cnt >= target)  # first bucket reaching the rank
         return lo + width * i.astype(sq.dtype) / probes, width / probes
 
-    lo, w = refine(jnp.zeros((), sq.dtype), jnp.max(sq))
+    w0 = jnp.max(sq) if pair is None else jnp.max(jnp.where(pair, sq, 0.0))
+    lo, w = refine(jnp.zeros((), sq.dtype), w0)
     for _ in range(3):
         lo, w = refine(lo, w)
-    med_sq = jnp.maximum(lo + 0.5 * w, 1e-12)  # probes⁻⁴ ≈ 1.5e-5 of range
+    return jnp.maximum(lo + 0.5 * w, 1e-12)  # probes⁻⁴ ≈ 1.5e-5 of range
+
+
+def median_bandwidth_approx_masked(
+    points: jax.Array,
+    valid: jax.Array,
+    n_valid: int,
+    full_n: int,
+    probes: int = 16,
+) -> jax.Array:
+    """:func:`median_bandwidth_approx` over the ``valid`` rows of an
+    already-subsampled, possibly padded point set — the SPMD form used by
+    the ring exchange's ``median_step`` path (``parallel/exchange.py``),
+    where each shard contributes its (ragged, padded-to-uniform) slice of
+    the global strided subsample via ``lax.all_gather``.
+
+    ``n_valid`` (static) is the true subsample size and ``full_n`` (static)
+    the full particle count feeding the ``log(n + 1)`` normaliser.  Counting
+    only valid×valid pairs against the same thresholds makes this numerically
+    identical to ``median_bandwidth_approx`` run on the compacted subsample:
+    the bracket thresholds, target rank, and per-pair distances all match
+    (padded rows never enter a count or the initial width).
+    """
+    sq = squared_distances(points, points)
+    pair = valid[:, None] & valid[None, :]
+    # rank bookkeeping as in median_bandwidth_approx: the n_valid diagonal
+    # zeros always fall below any positive threshold, so they are added to
+    # the target rank rather than masked out
+    target = n_valid + (n_valid * n_valid - n_valid + 1) // 2
+    med_sq = _median_bracket(sq, target, probes, pair=pair)
     return med_sq / math.log(full_n + 1.0)
 
 
@@ -178,9 +222,11 @@ class AdaptiveRBF:
     outside the kernel, so the same Pallas/XLA programs serve every traced
     bandwidth value (docs/notes.md).
 
-    Jacobi gather/partitions paths only: a per-hop median would break the
-    ring implementation's gather equivalence, and the literal Gauss–Seidel
-    sweep exists for reference parity, which has no adaptive bandwidth.
+    Jacobi paths only (the literal Gauss–Seidel sweep exists for reference
+    parity, which has no adaptive bandwidth).  The ring exchange resolves
+    the bandwidth once per step from a gathered strided subsample — the
+    gather path's exact subsample, so ring ≡ gather holds
+    (``parallel/exchange.py:_ring_median_bandwidth``).
     """
 
     def __init__(self, max_points: int = 1024):

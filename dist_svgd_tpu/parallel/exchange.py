@@ -59,6 +59,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dist_svgd_tpu.ops.kernels import (
+    RBF,
+    AdaptiveRBF,
+    median_bandwidth_approx_masked,
+)
 from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
 from dist_svgd_tpu.parallel.mesh import AXIS
 from dist_svgd_tpu.utils.rng import draw_minibatch
@@ -169,6 +174,38 @@ def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, phi_fn, num_sh
     )
     acc = acc + phi_fn(y_block, visiting, vscores)
     return acc / num_shards
+
+
+def _ring_median_bandwidth(block, num_shards: int, max_points: int):
+    """The gather path's per-step median bandwidth, computed under the ring
+    exchange without materialising the global set.
+
+    ``median_bandwidth_approx`` on the gathered global array subsamples
+    ``global[::stride]`` with ``stride = ceil(n / max_points)`` — and the
+    rows of shard ``r``'s block whose *global* index ``r·s + j`` is a
+    multiple of ``stride`` are exactly that set's slice through the shard.
+    Each shard gathers its (ragged, padded-to-``cap``) slice with a validity
+    mask and every shard computes the same masked median
+    (:func:`~dist_svgd_tpu.ops.kernels.median_bandwidth_approx_masked`) —
+    identical point set, thresholds, and rank as the gather path, so
+    ring ≡ gather holds for ``median_step`` exactly, at O(max_points·d)
+    per-device memory instead of O(n·d).
+    """
+    s = block.shape[0]
+    n = s * num_shards
+    stride = -(-n // max_points) if n > max_points else 1
+    p = -(-n // stride)          # global subsample size (static)
+    cap = -(-s // stride)        # max rows any one shard contributes
+    r = lax.axis_index(AXIS)
+    # first local row whose global index is a stride multiple: (−r·s) mod
+    off = (-r * s) % stride
+    idx = off + stride * jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < s
+    rows = jnp.take(block, jnp.minimum(idx, s - 1), axis=0)
+    rows = jnp.where(valid[:, None], rows, jnp.zeros((), block.dtype))
+    sub = lax.all_gather(rows, AXIS, tiled=True)      # (S·cap, d)
+    vmask = lax.all_gather(valid, AXIS, tiled=True)   # (S·cap,)
+    return median_bandwidth_approx_masked(sub, vmask, p, n)
 
 
 def _builder_prelude(logp, kernel, phi_impl, log_prior, batch_size,
@@ -372,9 +409,16 @@ def _build_core(
         raise ValueError(f"unknown exchange mode {mode!r}")
     if shard_data and mode == PARTITIONS:
         raise ValueError("shard_data is unsupported in partitions mode")
+    # ring + median_step: the per-call adaptive φ would take a per-hop
+    # median (each hop sees only the visiting block) — instead resolve the
+    # bandwidth ONCE per step from the gathered strided subsample
+    # (_ring_median_bandwidth: the gather path's exact subsample, so
+    # ring ≡ gather holds) and wrap the bandwidth-1 backend in the same
+    # rescaling identity resolve_phi_fn applies.
+    ring_adaptive = ring and isinstance(kernel, AdaptiveRBF) and mode != PARTITIONS
     phi_fn, batched_score, batched_prior = _builder_prelude(
-        logp, kernel, phi_impl, log_prior, batch_size, n_local_data,
-        phi_batch_hint,
+        logp, RBF(1.0) if ring_adaptive else kernel, phi_impl, log_prior,
+        batch_size, n_local_data, phi_batch_hint,
     )
 
     resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
@@ -400,13 +444,23 @@ def _build_core(
             scores = score_scale * lik_score_of(block) + batched_prior(block)
             delta = phi_fn(block, block, scores)
         elif ring:
+            hop_phi = phi_fn
+            if ring_adaptive:
+                h = _ring_median_bandwidth(
+                    block, num_shards, kernel.max_points
+                )
+                sh = jnp.sqrt(h.astype(block.dtype))
+                # φ_h(y; x, s) = φ₁(y/√h; x/√h, √h·s)/√h, per hop — linear
+                # in the hop accumulation, so the summed ring φ carries the
+                # same identity (resolve_phi_fn's AdaptiveRBF wrapper)
+                hop_phi = lambda y, x, s_: phi_fn(y / sh, x / sh, s_ * sh) / sh
             if mode == ALL_SCORES:
                 delta = _ring_phi_exact_scores(
-                    block, lik_score_of, batched_prior, phi_fn, num_shards
+                    block, lik_score_of, batched_prior, hop_phi, num_shards
                 )
             else:
                 score_of = lambda th: score_scale * lik_score_of(th) + batched_prior(th)
-                delta = _ring_phi_local_scores(block, score_of, phi_fn, num_shards)
+                delta = _ring_phi_local_scores(block, score_of, hop_phi, num_shards)
         else:
             interacting = lax.all_gather(block, AXIS, tiled=True)
             local_scores = lik_score_of(interacting)
@@ -434,6 +488,7 @@ def make_shard_step_lagged(
     log_prior: Optional[Callable] = None,
     phi_impl: str = "xla",
     phi_batch_hint: int = 1,
+    record: bool = False,
 ) -> Callable:
     """Lagged (stale) ``all_particles`` exchange: one ``lax.all_gather``
     per ``exchange_every`` SVGD steps instead of per step.
@@ -464,6 +519,15 @@ def make_shard_step_lagged(
     new_block`` — the standard per-shard step signature (``w_grad_block``
     must be zeros: the W2 term's previous-snapshot bookkeeping is defined
     per step, not per refresh).
+
+    ``record=True`` instead returns ``(new_block, hist)`` with ``hist`` the
+    ``(exchange_every, s, d)`` stack of this shard's **pre-update** block
+    per sub-step (the reference history convention, SURVEY.md §7.4) — the
+    inner scan's per-iteration carry, emitted for free.  Stacked across
+    shards this is the exact global pre-update state at every sub-step:
+    each shard's live block IS the authoritative value of its rows (the
+    stale gathered copies other shards hold are interaction inputs, not
+    state).
     """
     if exchange_every < 1:
         raise ValueError(f"exchange_every must be >= 1, got {exchange_every}")
@@ -495,11 +559,13 @@ def make_shard_step_lagged(
             scores = score_scale * mb_scale * batched_score(view, dl)
             scores = scores + batched_prior(view)
             delta = phi_fn(blk, view, scores)
-            return blk + step_size * delta, None
+            return blk + step_size * delta, (blk if record else None)
 
-        blk, _ = lax.scan(
+        blk, hist = lax.scan(
             body, block, jnp.arange(exchange_every, dtype=jnp.int32)
         )
+        if record:
+            return blk, hist  # (exchange_every, s, d) pre-update snapshots
         return blk
 
     return macro
@@ -521,6 +587,7 @@ def make_shard_step_sinkhorn_w2(
     sinkhorn_tol: Optional[float] = None,
     sinkhorn_warm_start: bool = True,
     phi_batch_hint: int = 1,
+    update_rule: str = "jacobi",
 ) -> Callable:
     """Per-shard SVGD step with the Wasserstein/JKO term computed **inside
     the step** from carried previous-snapshot state, so whole W2 trajectories
@@ -562,13 +629,33 @@ def make_shard_step_sinkhorn_w2(
     snapshot.  ``sinkhorn_warm_start=False`` restores the
     cold c-transform start on every step (the A/B baseline —
     tools/w2_bench.py).
+
+    ``update_rule='gauss_seidel'`` composes the W2 term with the literal
+    GS sweep exactly as the eager path does (``DistSampler.make_step``):
+    the W2 gradient is solved once per step from the pre-sweep block
+    against the carried snapshot and held fixed while the sweep applies it
+    row by row (``δ_i = φ(..) + h·w_grad_i`` — the reference's placement,
+    dsvgd/distsampler.py:194-200); the snapshot is then built from the
+    pre-sweep gather with the swept own block patched in, the same warty
+    rule.  Gather implementation, no minibatch (the GS builder's own
+    constraints).
     """
     from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
 
-    core = _build_core(
-        logp, kernel, mode, num_shards, n_local_data, score_scale,
-        False, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint,
-    )
+    if update_rule == "gauss_seidel":
+        gs_step = _build_gs_step(
+            logp, kernel, mode, num_shards, n_local_data, score_scale,
+            False, shard_data, batch_size, log_prior, phi_impl,
+        )
+        core = None
+    elif update_rule == "jacobi":
+        gs_step = None
+        core = _build_core(
+            logp, kernel, mode, num_shards, n_local_data, score_scale,
+            False, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint,
+        )
+    else:
+        raise ValueError(f"unknown update_rule {update_rule!r}")
     # prev_for[b] = previous[(b+1) % S]  (np.roll(prev, -1) device-side)
     roll_perm = [(j, (j - 1) % num_shards) for j in range(num_shards)]
 
@@ -585,8 +672,18 @@ def make_shard_step_sinkhorn_w2(
             return_g=True,
         )
         w_grad = w_on * w_grad
-        delta, interacting = core(block, data, t, key)
-        new = block + step_size * (delta + h * w_grad)
+        if gs_step is not None:
+            # the sweep applies h·w_grad per row itself; the snapshot needs
+            # the pre-sweep gather (the sweep's internal gather of the same
+            # pre-update block — XLA CSEs the duplicate collective)
+            interacting = (
+                None if mode == PARTITIONS
+                else lax.all_gather(block, AXIS, tiled=True)
+            )
+            new = gs_step(block, data, w_grad, t, key, step_size, h)
+        else:
+            delta, interacting = core(block, data, t, key)
+            new = block + step_size * (delta + h * w_grad)
         if mode == PARTITIONS:
             new_prev = new
         else:

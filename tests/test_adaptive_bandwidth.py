@@ -186,7 +186,7 @@ def test_distsampler_median_step_composes_with_sinkhorn_w2(rng):
     )
 
 
-def test_median_step_rejected_outside_jacobi_gather(rng):
+def test_median_step_rejected_outside_jacobi(rng):
     init = jnp.asarray(rng.normal(size=(16, 2)))
     logp = lambda th, _=None: gmm_logp(th)
     with pytest.raises(ValueError, match="median_step"):
@@ -196,12 +196,6 @@ def test_median_step_rejected_outside_jacobi_gather(rng):
             4, logp, "median_step", init,
             include_wasserstein=False, update_rule="gauss_seidel",
         )
-    with pytest.raises(ValueError, match="median_step"):
-        DistSampler(
-            4, logp, "median_step", init,
-            exchange_particles=True, exchange_scores=False,
-            include_wasserstein=False, exchange_impl="ring",
-        )
     # partitions mode ignores exchange_impl entirely (constructor docstring),
     # so ring + median_step is accepted there
     ds = DistSampler(
@@ -210,3 +204,51 @@ def test_median_step_rejected_outside_jacobi_gather(rng):
         include_wasserstein=False, exchange_impl="ring",
     )
     assert np.all(np.isfinite(np.asarray(ds.make_step(0.2))))
+
+
+@pytest.mark.parametrize("exch_s", [False, True])
+@pytest.mark.parametrize("n", [16, 24])
+def test_median_step_ring_matches_gather(rng, exch_s, n):
+    """Ring + median_step resolves the bandwidth from the gather path's
+    exact strided subsample (``_ring_median_bandwidth``), so the ring
+    trajectory equals the gather one in both ``all_*`` modes — including at
+    n=24, where the 4 shards' subsample slices are ragged and the masked
+    estimator's padding is exercised (max_points=5 forces stride 5 against
+    s=6 blocks)."""
+    init = jnp.asarray(rng.normal(size=(n, 2)))
+    logp = lambda th, _=None: gmm_logp(th)
+    from dist_svgd_tpu.ops.kernels import AdaptiveRBF
+
+    kern = AdaptiveRBF(max_points=5)  # force subsampling at tiny n
+
+    def make(impl):
+        return DistSampler(
+            4, logp, kern, init,
+            exchange_particles=True, exchange_scores=exch_s,
+            include_wasserstein=False, exchange_impl=impl,
+        )
+
+    g, r = make("gather"), make("ring")
+    g.run_steps(4, 0.2)
+    r.run_steps(4, 0.2)
+    np.testing.assert_allclose(
+        np.asarray(r.particles), np.asarray(g.particles), rtol=1e-8
+    )
+
+
+def test_masked_median_matches_compacted(rng):
+    """The masked estimator on a padded point set equals the plain estimator
+    on the compacted valid rows (same thresholds, ranks, distances)."""
+    from dist_svgd_tpu.ops.kernels import (
+        median_bandwidth_approx,
+        median_bandwidth_approx_masked,
+    )
+
+    pts = jnp.asarray(rng.normal(size=(20, 3)))
+    valid = jnp.asarray([True] * 13 + [False] * 7)
+    # garbage in the padded rows must not leak into the estimate
+    pts = pts.at[13:].set(1e6)
+    want = float(median_bandwidth_approx(pts[:13], max_points=13))
+    # full_n = 13 so the log(n+1) normaliser matches the compacted call
+    got = float(median_bandwidth_approx_masked(pts, valid, 13, 13))
+    assert got == pytest.approx(want, rel=1e-12)

@@ -222,10 +222,46 @@ def test_gauss_seidel_constructor_constraints():
     with pytest.raises(ValueError, match="update_rule"):
         DistSampler(2, gmm_logp, None, parts, include_wasserstein=False,
                     update_rule="typo")
-    ds = DistSampler(2, gmm_logp, None, parts, include_wasserstein=True,
-                     wasserstein_solver="sinkhorn", update_rule="gauss_seidel")
-    with pytest.raises(ValueError, match="Jacobi-only"):
-        ds.run_steps(2, 0.05)
+
+
+@pytest.mark.parametrize(
+    "name,exch_p,exch_s",
+    [("all_scores", True, True), ("all_particles", True, False),
+     ("partitions", False, False)],
+)
+def test_run_steps_wasserstein_gauss_seidel_matches_eager(name, exch_p, exch_s):
+    """Scanned GS+W2: the carried-snapshot Sinkhorn path composes with the
+    literal Gauss–Seidel sweep, and the scanned trajectory equals the eager
+    make_step one (whose GS+W2 semantics are oracle-pinned above) in every
+    mode."""
+    rng = np.random.default_rng(37)
+    S = 2
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, n_rows=8, num_shards=S)
+
+    def build():
+        return DistSampler(
+            S, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=exch_p, exchange_scores=exch_s,
+            include_wasserstein=True, wasserstein_solver="sinkhorn",
+            sinkhorn_eps=0.05, sinkhorn_iters=50,
+            update_rule="gauss_seidel",
+        )
+
+    eager = build()
+    for _ in range(4):
+        want = eager.make_step(0.05, h=0.5)
+    scanned = build()
+    got = scanned.run_steps(4, 0.05, h=0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-6)
+    np.testing.assert_allclose(
+        scanned._previous, eager._previous, rtol=2e-6, atol=1e-12
+    )
+    # mixing afterwards (scan → eager) stays on-trajectory
+    np.testing.assert_allclose(
+        np.asarray(scanned.make_step(0.05, h=0.5)),
+        np.asarray(eager.make_step(0.05, h=0.5)),
+        rtol=2e-6,
+    )
 
 
 def test_run_steps_equals_eager_gauss_seidel():
