@@ -569,7 +569,89 @@ class DistSampler:
             )
         return multihost.make_global_from_local(local, self._mesh, want)
 
+    def _reshard_previous(self, prev_arr: np.ndarray) -> np.ndarray:
+        """Convert a single-process checkpoint's Wasserstein ``previous``
+        stack saved under a **different** shard count (or exchange-mode
+        family) to this sampler's layout — exactly, by reconstructing the
+        shard-independent pre/post-update global states the stacks encode:
+
+        - the post-update global is the concatenation of each shard's own
+          block (exchanged stacks carry it inside the mixed snapshots;
+          ``partitions`` stacks ARE it);
+        - exchanged stacks at ``S_old ≥ 2`` additionally carry every
+          pre-update row (each block's pre value sits in any *other*
+          shard's snapshot), so the ``S_new`` mixed stack can be rebuilt
+          verbatim.
+
+        A target layout needing pre-update rows that the save does not
+        contain (``partitions``/S=1 save → exchanged S>1 restore) raises.
+        The carried dual cannot be resharded (its pairing is per-block) —
+        the caller zeroes it instead.
+        """
+        n, d = self._num_particles, self._d
+        want = self._prev_shape()
+        if prev_arr.shape == want:
+            return prev_arr
+        if prev_arr.ndim != 3 or prev_arr.shape[2] != d:
+            raise ValueError(
+                f"checkpoint 'previous' snapshot {prev_arr.shape} is not a "
+                f"snapshot stack for {n} particles of dim {d}"
+            )
+        S_old, rows = prev_arr.shape[0], prev_arr.shape[1]
+        exch_save = rows == n              # mixed per-shard snapshots
+        part_save = rows * S_old == n      # owned-block stacks (S_old == 1:
+        if not (exch_save or part_save):   # both — the post-update global)
+            raise ValueError(
+                f"checkpoint 'previous' snapshot {prev_arr.shape} matches "
+                f"neither a mixed (S, {n}, {d}) nor an owned-block "
+                f"(S, {n}//S, {d}) stack for {n} particles"
+            )
+        if exch_save:
+            s_old = n // S_old
+            post = np.concatenate(
+                [prev_arr[b, b * s_old:(b + 1) * s_old] for b in range(S_old)]
+            )
+        else:
+            post = prev_arr.reshape(n, d)
+        S_new = self._num_shards
+        if len(want) == 3 and want[1] != n:
+            # partitions target: owned-block (post-update) stacks
+            return post.reshape(want)
+        if S_new == 1:
+            # the (1, n, d) stack is just the post-update global, whichever
+            # mode family wrote the save
+            return post.reshape(1, n, d)
+        # exchanged target at S_new > 1: needs the pre-update rows
+        if not exch_save or S_old < 2:
+            raise ValueError(
+                f"cannot reshard 'previous' {prev_arr.shape} to {want}: the "
+                "save holds only post-update blocks (partitions-mode or "
+                "single-shard save), but an exchanged-mode stack at "
+                f"num_shards={S_new} needs the pre-update rows it never "
+                "recorded"
+            )
+        s_old = n // S_old
+        pre = np.empty_like(post)
+        for b in range(S_old):
+            # block b's pre-update rows live in any OTHER shard's snapshot
+            pre[b * s_old:(b + 1) * s_old] = (
+                prev_arr[(b + 1) % S_old, b * s_old:(b + 1) * s_old]
+            )
+        out = np.broadcast_to(pre, (S_new, n, d)).copy()
+        s_new = n // S_new
+        for r in range(S_new):
+            out[r, r * s_new:(r + 1) * s_new] = post[r * s_new:(r + 1) * s_new]
+        return out
+
     def load_state_dict(self, state: dict) -> None:
+        """Restore :meth:`state_dict` state.  Single-process restores accept
+        checkpoints saved under a different ``num_shards`` (reshard-on-
+        restore): the ``previous`` snapshot stack is rebuilt exactly for the
+        new layout (:meth:`_reshard_previous`) and the carried Sinkhorn dual
+        — whose per-block pairing does not survive a layout change — is
+        dropped, so the first resumed W2 solve starts from zeroed duals (the
+        safe soft-transform start; trajectory within the solver's tol band).
+        Multi-host restores still require the saving layout."""
         self._particles = self._restore_global(
             "particles",
             np.asarray(state["particles"]),
@@ -577,6 +659,7 @@ class DistSampler:
             (self._num_particles, self._d),
         )
         prev = state.get("previous")
+        resharded = False
         if prev is not None:
             want = self._prev_shape()
             prev_arr = np.asarray(prev)
@@ -584,13 +667,11 @@ class DistSampler:
                 prev = self._restore_global(
                     "previous", prev_arr, int(state.get("previous_start", 0)), want
                 )
-            elif prev_arr.shape != want:
-                raise ValueError(
-                    f"checkpoint 'previous' snapshot {prev_arr.shape} != expected "
-                    f"{want} (was it saved with a different num_shards?)"
-                )
             else:
-                prev = prev_arr  # host array, as the eager LP path keeps it
+                # host array, as the eager LP path keeps it; rebuilt when the
+                # save used a different shard layout
+                resharded = prev_arr.shape != want
+                prev = self._reshard_previous(prev_arr)
         self._previous = prev
         g = state.get("w2_g")  # absent in pre-warm-start checkpoints → cold
         if g is not None:
@@ -600,10 +681,16 @@ class DistSampler:
                 g = self._restore_global(
                     "w2_g", g_arr, int(state.get("w2_g_start", 0)), want
                 )
+            elif resharded:
+                # the dual's per-block pairing does not survive a reshard:
+                # cold-start the first solve instead (load_state_dict doc)
+                g = None
             elif g_arr.shape != want:
+                # NOT a reshard (the snapshot matched) — a mismatched dual
+                # alone means a corrupt/mixed-up checkpoint: fail fast
                 raise ValueError(
                     f"checkpoint 'w2_g' dual {g_arr.shape} != expected {want} "
-                    "(was it saved with a different num_shards?)"
+                    "(corrupt or mismatched checkpoint?)"
                 )
             else:
                 g = g_arr

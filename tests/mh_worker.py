@@ -1,11 +1,15 @@
-"""Worker process for the two-process multi-host federation test
-(test_multihost.py::test_two_process_federation_matches_oracle).
+"""Worker process for the multi-process multi-host federation tests
+(test_multihost.py::test_two_process_federation_matches_oracle and
+::test_four_process_federation_matches_oracle).
 
 Not a test module.  Invoked as:
-    python mh_worker.py <rank> <nprocs> <coordinator> <outdir>
-Each process owns 4 virtual CPU devices; the federation forms one 8-device
-mesh.  Runs 5 scanned DistSampler steps on a deterministically-initialised
-global particle array and saves this process's resulting rows.
+    python mh_worker.py <rank> <nprocs> <coordinator> <outdir> <devcount> <legs>
+Each process owns ``devcount`` virtual CPU devices; the federation forms one
+``nprocs * devcount``-device mesh.  ``legs`` is a comma-separated subset of
+{gather, ring, lagged, ckpt, subset} selecting which exchange paths to run
+(the 4-process test keeps a lighter set to bound rendezvous wall-clock).
+Runs scanned DistSampler steps on a deterministically-initialised global
+particle array and saves this process's resulting rows.
 """
 
 import os
@@ -16,12 +20,15 @@ def main():
     rank, nprocs, coordinator, outdir = (
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     )
+    devcount = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    legs = set((sys.argv[6] if len(sys.argv) > 6 else
+                "gather,ring,lagged,ckpt").split(","))
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import _jax_env
 
     # x64 on, matching conftest: the oracle in the pytest process runs under
     # x64, and the comparison must not straddle two precision regimes
-    _jax_env.setup_cpu(device_count=4)
+    _jax_env.setup_cpu(device_count=devcount)
 
     import jax
     import numpy as np
@@ -53,68 +60,93 @@ def main():
         )
         np.save(os.path.join(outdir, name), rows)
 
-    ds = dt.DistSampler(
-        mesh.size, lambda th, _: gmm_logp(th), None, particles,
-        exchange_particles=True, exchange_scores=True,
-        include_wasserstein=False, mesh=mesh,
-    )
-    save_local_rows(ds.run_steps(5, 0.1), f"rows_{rank}.npy")
     np.save(os.path.join(outdir, f"range_{rank}.npy"), np.array([start, count]))
 
-    # --- ppermute-ring exchange implementation: blockwise φ accumulation
-    # whose per-hop rotations genuinely cross the process boundary every
-    # step (unlike the gather mode above, whose collectives XLA may fuse,
-    # this is S explicit ring hops per pass — the long-context motif)
-    ring = dt.DistSampler(
-        mesh.size, lambda th, _: gmm_logp(th), None, particles,
-        exchange_particles=True, exchange_scores=True,
-        include_wasserstein=False, exchange_impl="ring", mesh=mesh,
-    )
-    save_local_rows(ring.run_steps(4, 0.1), f"ring_rows_{rank}.npy")
-
-    # --- lagged exchange (exchange_every): the mode exists precisely for
-    # multi-host meshes (one gather per T steps over DCN); run it in the
-    # real federation so its collective actually crosses the process
-    # boundary at every refresh
-    lag = dt.DistSampler(
-        mesh.size, lambda th, _: gmm_logp(th), None, particles,
-        exchange_particles=True, exchange_scores=False,
-        include_wasserstein=False, exchange_every=2, mesh=mesh,
-    )
-    save_local_rows(lag.run_steps(4, 0.1), f"lagged_rows_{rank}.npy")
-
-    # --- multi-host checkpoint/resume (VERDICT r1 item 7): save mid-run,
-    # restore into a FRESH sampler in this same federation, finish, and
-    # match the uninterrupted trajectory — with the W2 term on, so the
-    # non-fully-addressable `previous` snapshot stack round-trips too.
-    from dist_svgd_tpu.utils.checkpoint import load_state, save_state
-
-    def make_w2_sampler():
-        return dt.DistSampler(
+    if "gather" in legs:
+        ds = dt.DistSampler(
             mesh.size, lambda th, _: gmm_logp(th), None, particles,
             exchange_particles=True, exchange_scores=True,
-            include_wasserstein=True, wasserstein_solver="sinkhorn",
-            sinkhorn_iters=50, mesh=mesh,
+            include_wasserstein=False, mesh=mesh,
         )
+        save_local_rows(ds.run_steps(5, 0.1), f"rows_{rank}.npy")
 
-    # One sampler plays both roles: run 3, checkpoint, run 2 more — its
-    # final state IS the uninterrupted trajectory (the save is read-only).
-    straight = make_w2_sampler()
-    straight.run_steps(3, 0.1, h=0.5)
-    ckpt = os.path.join(outdir, f"ckpt_rank{rank}")
-    # per-process path: each process persists only its own addressable block
-    save_state(ckpt, straight.state_dict())
-    straight.run_steps(2, 0.1, h=0.5)
-    want_rows, _ = multihost.host_addressable_block(straight.particles)
+    if "ring" in legs:
+        # --- ppermute-ring exchange implementation: blockwise φ accumulation
+        # whose per-hop rotations genuinely cross the process boundary every
+        # step (unlike the gather mode above, whose collectives XLA may fuse,
+        # this is S explicit ring hops per pass — the long-context motif)
+        ring = dt.DistSampler(
+            mesh.size, lambda th, _: gmm_logp(th), None, particles,
+            exchange_particles=True, exchange_scores=True,
+            include_wasserstein=False, exchange_impl="ring", mesh=mesh,
+        )
+        save_local_rows(ring.run_steps(4, 0.1), f"ring_rows_{rank}.npy")
 
-    state = load_state(ckpt)
-    assert state["particles"].shape[0] == count, (
-        state["particles"].shape, count)
-    resumed = make_w2_sampler()
-    resumed.load_state_dict(state)
-    resumed.run_steps(2, 0.1, h=0.5)
-    got_rows, _ = multihost.host_addressable_block(resumed.particles)
-    np.testing.assert_allclose(got_rows, want_rows, rtol=1e-6, atol=1e-7)
+    if "lagged" in legs:
+        # --- lagged exchange (exchange_every): the mode exists precisely for
+        # multi-host meshes (one gather per T steps over DCN); run it in the
+        # real federation so its collective actually crosses the process
+        # boundary at every refresh
+        lag = dt.DistSampler(
+            mesh.size, lambda th, _: gmm_logp(th), None, particles,
+            exchange_particles=True, exchange_scores=False,
+            include_wasserstein=False, exchange_every=2, mesh=mesh,
+        )
+        save_local_rows(lag.run_steps(4, 0.1), f"lagged_rows_{rank}.npy")
+
+    if "subset" in legs:
+        # --- subset mesh over the federation: fewer shards than devices, so
+        # make_particle_mesh's equal-per-granule `take()` path picks an
+        # equal share of every process's devices (the branch a full-size
+        # mesh never exercises)
+        sub_shards = mesh.size // devcount  # one shard per process
+        sub_mesh = multihost.make_particle_mesh(sub_shards)
+        s_start, s_count = multihost.process_local_rows(n, sub_mesh)
+        sub_particles = multihost.make_global_particles(
+            full[s_start : s_start + s_count], sub_mesh, n_global=n
+        )
+        sub = dt.DistSampler(
+            sub_shards, lambda th, _: gmm_logp(th), None, sub_particles,
+            exchange_particles=True, exchange_scores=False,
+            include_wasserstein=False, mesh=sub_mesh,
+        )
+        save_local_rows(sub.run_steps(4, 0.1), f"subset_rows_{rank}.npy")
+        np.save(os.path.join(outdir, f"subset_range_{rank}.npy"),
+                np.array([s_start, s_count]))
+
+    if "ckpt" in legs:
+        # --- multi-host checkpoint/resume (VERDICT r1 item 7): save mid-run,
+        # restore into a FRESH sampler in this same federation, finish, and
+        # match the uninterrupted trajectory — with the W2 term on, so the
+        # non-fully-addressable `previous` snapshot stack round-trips too.
+        from dist_svgd_tpu.utils.checkpoint import load_state, save_state
+
+        def make_w2_sampler():
+            return dt.DistSampler(
+                mesh.size, lambda th, _: gmm_logp(th), None, particles,
+                exchange_particles=True, exchange_scores=True,
+                include_wasserstein=True, wasserstein_solver="sinkhorn",
+                sinkhorn_iters=50, mesh=mesh,
+            )
+
+        # One sampler plays both roles: run 3, checkpoint, run 2 more — its
+        # final state IS the uninterrupted trajectory (the save is read-only).
+        straight = make_w2_sampler()
+        straight.run_steps(3, 0.1, h=0.5)
+        ckpt = os.path.join(outdir, f"ckpt_rank{rank}")
+        # per-process path: each process persists only its own addressable block
+        save_state(ckpt, straight.state_dict())
+        straight.run_steps(2, 0.1, h=0.5)
+        want_rows, _ = multihost.host_addressable_block(straight.particles)
+
+        state = load_state(ckpt)
+        assert state["particles"].shape[0] == count, (
+            state["particles"].shape, count)
+        resumed = make_w2_sampler()
+        resumed.load_state_dict(state)
+        resumed.run_steps(2, 0.1, h=0.5)
+        got_rows, _ = multihost.host_addressable_block(resumed.particles)
+        np.testing.assert_allclose(got_rows, want_rows, rtol=1e-6, atol=1e-7)
 
 
 if __name__ == "__main__":

@@ -104,13 +104,9 @@ def test_importing_framework_does_not_start_backend():
     assert proc.returncode == 0, proc.stderr.decode()[-2000:]
 
 
-def test_two_process_federation_matches_oracle(tmp_path):
-    """REAL multi-process coverage: two OS processes, 4 virtual CPU devices
-    each, federated by ``jax.distributed`` into one 8-shard mesh.  Exercises
-    the branches a single process cannot — cross-process rendezvous,
-    ``make_array_from_process_local_data``, per-process ``process_local_rows``
-    — and checks the distributed trajectory against a single-process oracle.
-    """
+def _run_federation(tmp_path, nprocs: int, devcount: int, legs: str):
+    """Spawn ``nprocs`` mh_worker.py processes federated over a fresh local
+    coordinator port; assert they all exit cleanly."""
     import socket
     import subprocess
 
@@ -121,10 +117,11 @@ def test_two_process_federation_matches_oracle(tmp_path):
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(r), "2", f"127.0.0.1:{port}", str(tmp_path)],
+            [sys.executable, worker, str(r), str(nprocs),
+             f"127.0.0.1:{port}", str(tmp_path), str(devcount), legs],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
-        for r in range(2)
+        for r in range(nprocs)
     ]
     try:
         logs = [p.communicate(timeout=540)[0].decode() for p in procs]
@@ -137,11 +134,27 @@ def test_two_process_federation_matches_oracle(tmp_path):
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"worker failed:\n{log[-2000:]}"
 
-    n, d = 32, 2
+
+def _assemble(tmp_path, nprocs: int, n: int, d: int, rows_tpl: str,
+              range_tpl: str = "range_{}.npy") -> np.ndarray:
     got = np.empty((n, d), dtype=np.float32)
-    for r in range(2):
-        start, count = np.load(tmp_path / f"range_{r}.npy")
-        got[start : start + count] = np.load(tmp_path / f"rows_{r}.npy")
+    for r in range(nprocs):
+        start, count = np.load(tmp_path / range_tpl.format(r))
+        got[start : start + count] = np.load(tmp_path / rows_tpl.format(r))
+    return got
+
+
+def test_two_process_federation_matches_oracle(tmp_path):
+    """REAL multi-process coverage: two OS processes, 4 virtual CPU devices
+    each, federated by ``jax.distributed`` into one 8-shard mesh.  Exercises
+    the branches a single process cannot — cross-process rendezvous,
+    ``make_array_from_process_local_data``, per-process ``process_local_rows``
+    — and checks the distributed trajectory against a single-process oracle.
+    """
+    _run_federation(tmp_path, 2, 4, "gather,ring,lagged,ckpt")
+
+    n, d = 32, 2
+    got = _assemble(tmp_path, 2, n, d, "rows_{}.npy")
 
     full = np.random.default_rng(0).normal(size=(n, d)).astype(np.float32)
     ref = dt.DistSampler(
@@ -154,10 +167,7 @@ def test_two_process_federation_matches_oracle(tmp_path):
 
     # ppermute-ring exchange across the process boundary: every hop of the
     # two-pass all_scores ring rotates blocks between the two processes
-    got_p = np.empty((n, d), dtype=np.float32)
-    for r in range(2):
-        start, count = np.load(tmp_path / f"range_{r}.npy")
-        got_p[start : start + count] = np.load(tmp_path / f"ring_rows_{r}.npy")
+    got_p = _assemble(tmp_path, 2, n, d, "ring_rows_{}.npy")
     ref_p = dt.DistSampler(
         8, lambda th, _: gmm_logp(th), None, full,
         exchange_particles=True, exchange_scores=True,
@@ -168,10 +178,7 @@ def test_two_process_federation_matches_oracle(tmp_path):
     np.testing.assert_allclose(got_p, want_p, rtol=2e-6, atol=2e-7)
 
     # lagged exchange across the process boundary (one gather per T=2 steps)
-    got_l = np.empty((n, d), dtype=np.float32)
-    for r in range(2):
-        start, count = np.load(tmp_path / f"range_{r}.npy")
-        got_l[start : start + count] = np.load(tmp_path / f"lagged_rows_{r}.npy")
+    got_l = _assemble(tmp_path, 2, n, d, "lagged_rows_{}.npy")
     ref_l = dt.DistSampler(
         8, lambda th, _: gmm_logp(th), None, full,
         exchange_particles=True, exchange_scores=False,
@@ -180,6 +187,39 @@ def test_two_process_federation_matches_oracle(tmp_path):
     )
     want_l = np.asarray(ref_l.run_steps(4, 0.1))
     np.testing.assert_allclose(got_l, want_l, rtol=2e-6, atol=2e-7)
+
+
+def test_four_process_federation_matches_oracle(tmp_path):
+    """4-process federation, 2 virtual CPU devices per process — the
+    granule-major hybrid mesh with >1 device per granule
+    (``make_particle_mesh``'s ``create_hybrid_device_mesh`` branch, which
+    the 2×4 fixture also hits but never at this granule count), plus a
+    subset mesh (4 shards over 8 devices) exercising the equal-per-process
+    ``take()`` selection.  Both trajectories must equal the single-process
+    oracle — mesh layout is an execution detail, not semantics."""
+    _run_federation(tmp_path, 4, 2, "gather,subset")
+
+    n, d = 32, 2
+    full = np.random.default_rng(0).normal(size=(n, d)).astype(np.float32)
+
+    got = _assemble(tmp_path, 4, n, d, "rows_{}.npy")
+    ref = dt.DistSampler(
+        8, lambda th, _: gmm_logp(th), None, full,
+        exchange_particles=True, exchange_scores=True,
+        include_wasserstein=False, mesh=multihost.make_particle_mesh(8),
+    )
+    want = np.asarray(ref.run_steps(5, 0.1))
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-7)
+
+    got_s = _assemble(tmp_path, 4, n, d, "subset_rows_{}.npy",
+                      "subset_range_{}.npy")
+    ref_s = dt.DistSampler(
+        4, lambda th, _: gmm_logp(th), None, full,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False, mesh=multihost.make_particle_mesh(4),
+    )
+    want_s = np.asarray(ref_s.run_steps(4, 0.1))
+    np.testing.assert_allclose(got_s, want_s, rtol=2e-6, atol=2e-7)
 
 
 def test_distsampler_runs_on_multihost_mesh():
