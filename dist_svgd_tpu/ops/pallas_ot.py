@@ -50,6 +50,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 from dist_svgd_tpu.ops.pallas_svgd import (
@@ -324,7 +325,16 @@ def _solve_setup(particles, previous, eps, g_init, interpret):
     inv_reg == 1 kernels, and the cold/warm dual start (the soft
     c-transform pair of the carried g — ops/ot.py:_sinkhorn_start's
     contract, in rescaled units).  One copy so the warm-start safety
-    semantics cannot drift between the two Pallas paths."""
+    semantics cannot drift between the two Pallas paths.
+
+    The returned ``delta0`` (warm starts only; ``None`` cold) is the exit
+    statistic of the start itself: the soft c-transform pair is one exact
+    log-domain Sinkhorn iteration from the carried ``g``, so
+    ``max|g⁰ − g_init|`` (rescaled units — the same log-scaling units the
+    scaling loop's per-iteration exit measures) IS that iteration's
+    sup-change.  A ``tol`` consumer can therefore skip the scaling loop
+    outright when ``delta0 ≤ tol`` — the start pair already satisfies the
+    exit the loop would be polling for."""
     x = jnp.asarray(particles, jnp.float32)
     y = jnp.asarray(previous, jnp.float32)
     m, d = x.shape
@@ -358,6 +368,7 @@ def _solve_setup(particles, previous, eps, g_init, interpret):
     if g_init is None:
         f0 = ct(xs_, ys_, jnp.zeros((n,), dt), soft=False)   # min_j C'_ij
         g0 = ct(ys_, xs_, f0, soft=False)                    # c-transform
+        delta0 = None
     else:
         # warm start: the soft c-transform pair of the carried g
         # (ops/ot.py:_sinkhorn_start — both passes kept; the column-side
@@ -365,7 +376,8 @@ def _solve_setup(particles, previous, eps, g_init, interpret):
         gi = jnp.asarray(g_init, dt) / reg
         f0 = jnp.log(a) - ct(xs_, ys_, gi, soft=True)
         g0 = jnp.log(b) - ct(ys_, xs_, f0, soft=True)
-    return xs_, ys_, f0, g0, reg, sr, a, b, m, n, dt, tiny
+        delta0 = jnp.max(jnp.abs(g0 - gi))  # the start's own exit statistic
+    return xs_, ys_, f0, g0, delta0, reg, sr, a, b, m, n, dt, tiny
 
 
 def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
@@ -394,7 +406,7 @@ def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
     """
     if absorb_every <= 0:
         raise ValueError(f"absorb_every must be positive, got {absorb_every}")
-    (xs_, ys_, f0, g0, reg, sr, a, b,
+    (xs_, ys_, f0, g0, _, reg, sr, a, b,
      m, n, dt, tiny) = _solve_setup(particles, previous, eps, g_init,
                                     interpret)
 
@@ -534,8 +546,21 @@ def sinkhorn_grad_streaming(particles, previous, eps: float = 0.05,
     memory demands it: at materialisable sizes the fused/XLA paths are
     strictly faster (``FUSED_SINKHORN_STREAM_MIN_PAIRS`` in ops/ot.py
     gates the auto choice).
+
+    **Block size**: the materialised paths amortise one kernel build over
+    ``absorb_every`` cheap matvecs, so big blocks win there — but here
+    every matvec rebuilds tiles regardless, making the block size pure
+    *exit-granularity* loss: the ``tol`` exit fires only at block ends, so
+    a warm-started solve whose dual is 1–2 iterations from the fixpoint
+    still pays the full ``absorb_every`` iterations (measured 1.87 s/step
+    warm at the 100k-particle 8-shard config with blocks of 10, vs the
+    per-iteration cost implying ~2 iterations needed).  The scaling loop
+    therefore runs with ``absorb_every=1`` — plain log-domain iteration,
+    the finest exit granularity, identical semantics — whenever a ``tol``
+    exit is active; the argument is honored for fixed-count runs (where
+    there is no exit to granulate and fewer folds save a few O(n) passes).
     """
-    (xs_, ys_, f0, g0, reg, sr, a, b,
+    (xs_, ys_, f0, g0, delta0, reg, sr, a, b,
      m, n, dt, tiny) = _solve_setup(particles, previous, eps, g_init,
                                     interpret)
 
@@ -551,10 +576,26 @@ def sinkhorn_grad_streaming(particles, previous, eps: float = 0.05,
         rmv = lambda u: kmat_vec(ys_, xs_, g, f, u, 1.0, interpret=interpret)
         return mv, rmv, None
 
-    f, g = _sinkhorn_scaling_loop(
-        f0, g0, make_ops, 1.0, m, n, iters, tol, absorb_every, dt,
-        carry_kmat=False,
-    )
+    def run_loop(fg):
+        return _sinkhorn_scaling_loop(
+            fg[0], fg[1], make_ops, 1.0, m, n, iters, tol,
+            1 if tol is not None else absorb_every,  # docstring: block size
+            dt,                                      # is pure exit
+            carry_kmat=False,                        # granularity here
+        )
+
+    if tol is not None and delta0 is not None:
+        # warm + tol: the start pair is one exact log-domain iteration from
+        # the carried g, and delta0 is that iteration's sup-change — when it
+        # is already within tol the loop has nothing to do and a warm solve
+        # collapses to the two soft-transform passes plus the finish
+        # (_solve_setup docstring; the dominant term of the 100k-particle
+        # streaming W2 step, docs/notes.md round-4 section)
+        f, g = lax.cond(
+            delta0 <= jnp.asarray(tol, dt), lambda fg: fg, run_loop, (f0, g0)
+        )
+    else:
+        f, g = run_loop((f0, g0))
 
     grad = plan_grad(xs_, ys_, f, g, 1.0, interpret=interpret) * sr
     if return_g:

@@ -201,7 +201,12 @@ def test_kmat_vec_matches_dense(rng):
 @pytest.mark.parametrize("warm", [False, True])
 def test_streaming_grad_matches_xla_path(rng, tol, warm):
     """The O(n*d)-memory streaming solve equals the XLA solve — same
-    algorithm, the kernel matrix just never exists."""
+    algorithm, the kernel matrix just never exists.  With a ``tol`` exit
+    the streaming loop runs at ``absorb_every=1`` (blocks are pure exit-
+    granularity loss when every matvec rebuilds tiles —
+    sinkhorn_grad_streaming docstring), so the matching XLA reference is
+    the ``absorb_every=1`` solve; fixed-count runs honor the argument and
+    match the default-block reference."""
     from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_streaming
 
     x, y = _pts(rng, 24, 40)
@@ -210,9 +215,10 @@ def test_streaming_grad_matches_xla_path(rng, tol, warm):
         _, g_init = wasserstein_grad_sinkhorn(
             x + 0.01, y, eps=0.05, iters=100, return_g=True
         )
+    ref_absorb = 1 if tol is not None else 10
     want, want_g = wasserstein_grad_sinkhorn(
         x, y, eps=0.05, iters=60, tol=tol, g_init=g_init, return_g=True,
-        impl="xla",
+        impl="xla", absorb_every=ref_absorb,
     )
     got, got_g = sinkhorn_grad_streaming(
         x, y, eps=0.05, iters=60, tol=tol, g_init=g_init, return_g=True,
@@ -222,6 +228,26 @@ def test_streaming_grad_matches_xla_path(rng, tol, warm):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_streaming_warm_early_exit_at_converged_dual(rng):
+    """A carried dual whose soft-transform change is already within tol
+    skips the scaling loop entirely (the start pair is one exact log-domain
+    iteration and delta0 IS its exit statistic — _solve_setup docstring):
+    the result equals the start-pair gradient, i.e. the XLA ``iters=0``
+    warm gradient from the same carried dual."""
+    from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_streaming
+
+    x, y = _pts(rng, 24, 40)
+    _, g = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=400, tol=1e-5, return_g=True
+    )  # converged dual for this exact pairing
+    got = sinkhorn_grad_streaming(
+        x, y, eps=0.05, iters=60, tol=1e-2, g_init=g, interpret=True
+    )
+    want = wasserstein_grad_sinkhorn(x, y, eps=0.05, iters=0, g_init=g)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_auto_dispatch_reaches_streaming_under_vmap(rng, monkeypatch):
@@ -254,7 +280,9 @@ def test_auto_dispatch_reaches_streaming_under_vmap(rng, monkeypatch):
     assert calls, "dispatch did not reach the streaming path"
     want = np.stack([
         np.asarray(wasserstein_grad_sinkhorn(
-            x[r], y[r], eps=0.05, iters=40, tol=1e-2, impl="xla"))
+            x[r], y[r], eps=0.05, iters=40, tol=1e-2, impl="xla",
+            absorb_every=1,  # the streaming tol-exit granularity (see
+        ))                   # test_streaming_grad_matches_xla_path)
         for r in range(S)
     ])
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

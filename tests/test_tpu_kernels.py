@@ -1,0 +1,260 @@
+"""Real-hardware (Mosaic) pinning of the Pallas kernels — the `tpu` tier.
+
+Every other Pallas test runs ``interpret=True`` on CPU, which checks the
+kernel *logic* but not Mosaic lowering (tile padding, VMEM budgets, MXU
+precision modes, bf16x3 splits).  This module runs the same
+kernel-vs-XLA-path comparisons on the real chip, so a Mosaic-only
+regression is a red test instead of a bench-reading exercise
+(round-3 verdict item 3; SURVEY.md §4 test-pyramid mandate).
+
+Run with ``DSVGD_TPU_TESTS=1 python -m pytest tests -m tpu`` on a TPU host
+(see conftest.py — the default CPU-mesh run auto-skips these).  Tolerances
+are relative to ``max|want|``: both sides are f32 programs whose reduction
+orders differ, so elementwise rtol on near-zero entries is the wrong
+yardstick; the documented error budgets are 4.4e-4 (exact-f32 φ floor at
+the covertype shape), 1.4e-3 (bf16x3 big-d tier), ~3e-4 (small-d bf16 exp)
+— docs/notes.md.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_tpu():
+    import jax
+
+    try:
+        ok = jax.default_backend() == "tpu"
+    except Exception:  # backend init failure (pool unavailable)
+        ok = False
+    if not ok:
+        pytest.skip("no TPU backend available")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(47)
+
+
+def _close(got, want, rel, what=""):
+    got, want = np.asarray(got), np.asarray(want)
+    assert np.isfinite(got).all(), f"{what}: non-finite entries"
+    err = np.abs(got - want).max()
+    scale = np.abs(want).max()
+    assert err <= rel * scale, (
+        f"{what}: max|Δ| {err:.3e} > {rel:g} · max|want| {scale:.3e}"
+    )
+
+
+# --------------------------------------------------------------------- #
+# φ kernel (ops/pallas_svgd.py) vs the XLA φ (ops/svgd.py)
+
+
+@pytest.mark.parametrize(
+    "k,m,d",
+    [
+        (1250, 10_000, 3),   # the north-star shard shape (small-d VPU drive)
+        (300, 999, 3),       # ragged both axes → edge-tile padding + sentinel
+        (130, 257, 7),       # multi-tile ragged at the SMALL_D boundary
+        (1250, 10_000, 55),  # big-d variant (MXU distance + drive contractions)
+        (200, 500, 200),     # big-d with d padded to 256 lanes
+    ],
+)
+def test_phi_pallas_f32_matches_xla_on_mosaic(rng, k, m, d):
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu.ops.kernels import RBF
+    from dist_svgd_tpu.ops.pallas_svgd import phi_pallas
+    from dist_svgd_tpu.ops.svgd import phi
+
+    h = 1.0 if d <= 8 else float(2 * d)  # keep kernel values O(1) at big d
+    y = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    s = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    want = phi(y, x, s, RBF(h))
+    got = phi_pallas(y, x, s, bandwidth=h)
+    _close(got, want, 1e-3, f"phi f32 ({k},{m},{d})")
+
+
+@pytest.mark.parametrize("k,m,d", [(1250, 10_000, 3), (1250, 10_000, 55)])
+def test_phi_pallas_bf16x3_within_budget_on_mosaic(rng, k, m, d):
+    """The reduced-precision tier on real Mosaic: small-d = bf16 exp only
+    (~3e-4 budget), big-d = 3-pass bf16x3 MXU splits (1.4e-3 measured;
+    docs/notes.md).  2e-2 is the same acceptance multiple the interpreter
+    tests use over those budgets."""
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu.ops.kernels import RBF
+    from dist_svgd_tpu.ops.pallas_svgd import phi_pallas
+    from dist_svgd_tpu.ops.svgd import phi
+
+    h = 1.0 if d <= 8 else float(2 * d)
+    y = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    s = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    want = phi(y, x, s, RBF(h))
+    got = phi_pallas(y, x, s, bandwidth=h, gram_dtype=jnp.bfloat16)
+    _close(got, want, 2e-2, f"phi bf16 ({k},{m},{d})")
+
+
+def test_phi_auto_dispatch_selects_pallas_on_mosaic(rng):
+    """'auto' above the pair threshold returns the Pallas kernel's exact
+    result (and hence also tracks the XLA path within the f32 budget)."""
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu.ops.kernels import RBF
+    from dist_svgd_tpu.ops.pallas_svgd import phi_pallas, resolve_phi_fn
+    from dist_svgd_tpu.ops.svgd import phi
+
+    k, m, d = 1250, 10_000, 3  # k·m = 1.25e7 ≥ PALLAS_MIN_PAIRS (2^22)
+    y = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    s = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    auto = resolve_phi_fn(RBF(1.0), "auto")(y, x, s)
+    np.testing.assert_array_equal(
+        np.asarray(auto), np.asarray(phi_pallas(y, x, s, bandwidth=1.0))
+    )
+    _close(auto, phi(y, x, s, RBF(1.0)), 1e-3, "phi auto")
+
+
+def test_phi_adaptive_bandwidth_pallas_on_mosaic(rng):
+    """AdaptiveRBF's rescaling identity composes with the real kernel: the
+    adaptive Pallas φ equals a fixed-RBF XLA φ at the resolved bandwidth."""
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF, median_bandwidth_approx
+    from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
+    from dist_svgd_tpu.ops.svgd import phi
+
+    k, m, d = 1250, 10_000, 3
+    y = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    s = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    h = float(median_bandwidth_approx(x))
+    want = phi(y, x, s, RBF(h))
+    got = resolve_phi_fn(AdaptiveRBF(), "pallas")(y, x, s)
+    _close(got, want, 1e-3, "phi adaptive pallas")
+
+
+# --------------------------------------------------------------------- #
+# Sinkhorn W2 kernels (ops/pallas_ot.py) vs the XLA solve (ops/ot.py)
+
+
+@pytest.mark.parametrize("tol", [None, 1e-2])
+def test_sinkhorn_fused_matches_xla_on_mosaic(rng, tol):
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
+    from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_fused
+
+    m, n, d = 1250, 10_000, 3  # the north-star W2 shard shape
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    y = jnp.asarray(rng.normal(size=(n, d)), dtype=jnp.float32)
+    want, want_g = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=100, tol=tol, return_g=True, impl="xla"
+    )
+    got, got_g = sinkhorn_grad_fused(
+        x, y, eps=0.05, iters=100, tol=tol, return_g=True
+    )
+    _close(got, want, 5e-3, "fused grad")
+    _close(got_g, want_g, 5e-3, "fused dual")
+
+
+def test_sinkhorn_fused_warm_start_on_mosaic(rng):
+    """Warm-start path (soft c-transform reductions) on real Mosaic: feeding
+    the previous solve's dual must track the XLA warm solve."""
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
+    from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_fused
+
+    m, n, d = 1250, 10_000, 3
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    y = jnp.asarray(rng.normal(size=(n, d)), dtype=jnp.float32)
+    _, g0 = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=50, tol=1e-2, return_g=True, impl="xla"
+    )
+    x2 = x + jnp.asarray(0.01 * rng.normal(size=(m, d)), dtype=jnp.float32)
+    want, want_g = wasserstein_grad_sinkhorn(
+        x2, y, eps=0.05, iters=100, tol=1e-2, g_init=g0, return_g=True,
+        impl="xla",
+    )
+    got, got_g = sinkhorn_grad_fused(
+        x2, y, eps=0.05, iters=100, tol=1e-2, g_init=g0, return_g=True
+    )
+    _close(got, want, 5e-3, "fused warm grad")
+    _close(got_g, want_g, 5e-3, "fused warm dual")
+
+
+@pytest.mark.parametrize("warm", [False, True])
+def test_sinkhorn_streaming_matches_xla_on_mosaic(rng, warm):
+    """The O(n·d) streaming solve on real Mosaic (kmat_vec + plan_grad),
+    cold and warm-started."""
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
+    from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_streaming
+
+    m, n, d = 1250, 10_000, 3
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    y = jnp.asarray(rng.normal(size=(n, d)), dtype=jnp.float32)
+    g0 = None
+    if warm:
+        _, g0 = wasserstein_grad_sinkhorn(
+            x, y, eps=0.05, iters=50, tol=1e-2, return_g=True, impl="xla"
+        )
+    want, want_g = wasserstein_grad_sinkhorn(
+        x, y, eps=0.05, iters=100, tol=1e-2, g_init=g0, return_g=True,
+        impl="xla", absorb_every=1,  # the streaming tol-exit granularity
+    )
+    got, got_g = sinkhorn_grad_streaming(
+        x, y, eps=0.05, iters=100, tol=1e-2, g_init=g0, return_g=True
+    )
+    _close(got, want, 5e-3, "streaming grad")
+    _close(got_g, want_g, 5e-3, "streaming dual")
+
+
+def test_sinkhorn_auto_dispatch_selects_fused_on_mosaic(rng):
+    """impl='auto' at ≥FUSED_SINKHORN_MIN_PAIRS f32 small-d sizes routes to
+    the fused Pallas solve on TPU — its result must be exactly the forced
+    fused path's."""
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
+    from dist_svgd_tpu.ops.pallas_ot import sinkhorn_grad_fused
+
+    m, n, d = 1250, 10_000, 3  # 1.25e7 pairs ≥ 2^20
+    x = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
+    y = jnp.asarray(rng.normal(size=(n, d)), dtype=jnp.float32)
+    auto = wasserstein_grad_sinkhorn(x, y, eps=0.05, iters=60, tol=1e-2)
+    forced = sinkhorn_grad_fused(x, y, eps=0.05, iters=60, tol=1e-2)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(forced))
+
+
+# --------------------------------------------------------------------- #
+# End-to-end: the sharded step on the real chip (vmap emulation), pallas
+# vs xla φ — the program bench.py times
+
+
+def test_sharded_step_pallas_vs_xla_on_mosaic(rng):
+    import jax.numpy as jnp
+
+    from dist_svgd_tpu import DistSampler
+    from dist_svgd_tpu.models.gmm import gmm_logp
+
+    n, d = 4096, 2
+    init = jnp.asarray(rng.normal(size=(n, d)), dtype=jnp.float32)
+    logp = lambda th, _: gmm_logp(th)
+
+    def run(impl):
+        ds = DistSampler(
+            8, logp, None, init,
+            exchange_particles=True, exchange_scores=False,
+            include_wasserstein=False, phi_impl=impl,
+        )
+        return np.asarray(ds.run_steps(3, 0.05))
+
+    _close(run("pallas"), run("xla"), 1e-3, "sharded step")

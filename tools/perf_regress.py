@@ -1,0 +1,222 @@
+"""Perf regression gate: re-measure the headline rows against recorded
+incumbents (round-4 VERDICT item 8).
+
+The measured wins in ``docs/notes.md`` (north-star φ, warm-started W2,
+covertype bf16x3, the config-1 dispatch floor) previously lived only in
+prose and ad-hoc tools; this gate re-measures them in ONE command and
+red-flags a regression at a noise-aware threshold, institutionalising the
+A/B timing protocol those notes derived:
+
+- **chained fenced samples** — every timing is the mean wall of a chain of
+  state-chained scan dispatches under one trailing scalar fetch
+  (``bench._timed_chain``'s protocol: the ~0.1 s tunnel round trip is fixed
+  per sample, so chains amortise it away and per-call eager timing is
+  meaningless);
+- **interleaved rounds** — one sample of *every* bench per round, rounds
+  repeated; per-bench the min across rounds is kept.  A pool slowdown in
+  one round hits all benches together instead of biasing whichever config
+  was measured last (the incumbent-first / idle-credit artifacts measured
+  in round 2, docs/notes.md timing-protocol notes);
+- **noise-aware threshold** — the shared pool swings ±40% *between*
+  sessions; min-of-interleaved-chains removes most of the within-session
+  spread, so the default gate fails a row only when it lands >35% below its
+  incumbent (``--tol``), and warns from half that.
+
+Usage (on the TPU host)::
+
+    python tools/perf_regress.py            # compare vs tools/perf_incumbents.json
+    python tools/perf_regress.py --record   # overwrite incumbents with this run
+    python tools/perf_regress.py --rounds 5 --tol 0.25
+
+Prints one JSON line per row plus a summary line; exit code 1 if any row
+FAILs.  Run it before adopting any perf-relevant change; after a *verified*
+improvement, ``--record`` promotes the new numbers to incumbents.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import N_PARTICLES, NUM_SHARDS, _fence, _make_sharded, _TUNNEL_RT_S
+
+INCUMBENTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "perf_incumbents.json")
+
+
+def _build_benches():
+    """Construct the headline-row runners.  Each entry:
+    ``key -> (run, to_value, unit, higher_better)`` where ``run()`` advances
+    real sampler state (chains cannot be elided) and ``to_value(wall_per_run)``
+    converts one run's wall seconds to the metric."""
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import (
+        logreg_likelihood,
+        logreg_prior,
+        make_logreg_logp,
+    )
+    from dist_svgd_tpu.utils.datasets import load_benchmark, load_covertype
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    fold = load_benchmark("banana", 42)
+    benches = {}
+
+    # 1. north-star sharded φ (the bench.py headline)
+    ns = _make_sharded(fold)
+    benches["north_star_ups"] = (
+        lambda: ns.run_steps(500, 3e-3),
+        lambda w: N_PARTICLES * 500 / w,
+        "updates/sec", True,
+    )
+
+    # 2. warm-started Sinkhorn W2 (carried duals in the scan state)
+    w2 = _make_sharded(fold, wasserstein=True)
+    benches["w2_warm_ms_per_step"] = (
+        lambda: w2.run_steps(100, 3e-3, h=10.0),
+        lambda w: w / 100 * 1e3,
+        "ms/step", False,
+    )
+
+    # 3. covertype bf16x3 (big-d minibatched, the fast tier's home ground)
+    cx, ct = load_covertype(50_000)
+    ct_d = 1 + cx.shape[1]
+    cov = dt.DistSampler(
+        NUM_SHARDS, logreg_likelihood, None,
+        init_particles_per_shard(0, N_PARTICLES, ct_d, NUM_SHARDS),
+        data=(jnp.asarray(cx), jnp.asarray(ct)),
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False, shard_data=True, batch_size=256,
+        log_prior=logreg_prior, phi_impl="pallas_bf16",
+    )
+    benches["covertype_bf16x3_ups"] = (
+        lambda: cov.run_steps(100, 1e-4),
+        lambda w: N_PARTICLES * 100 / w,
+        "updates/sec", True,
+    )
+
+    # 4. config-1 floor (100-particle single sampler — dispatch-bound row)
+    logp = make_logreg_logp(fold.x_train, fold.t_train.reshape(-1))
+    c1 = dt.Sampler(1 + fold.x_train.shape[1], logp)
+    c1_state = {"out": None}
+
+    def c1_run():
+        c1_state["out"] = c1.run(
+            100, 100, 3e-3, seed=0, record=False,
+            initial_particles=c1_state["out"],
+        )[0]
+        return c1_state["out"]
+
+    benches["config1_ups"] = (
+        c1_run, lambda w: 100 * 100 / w, "updates/sec", True,
+    )
+    return benches
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="interleaved measurement rounds (min kept)")
+    ap.add_argument("--tol", type=float, default=0.35,
+                    help="FAIL when a row lands this fraction below its "
+                         "incumbent (warn from tol/2)")
+    ap.add_argument("--target-s", type=float, default=1.0,
+                    help="device work per fenced sample (chain sizing)")
+    ap.add_argument("--record", action="store_true",
+                    help="overwrite the incumbents file with this run "
+                         "(refused when any row FAILs — see --force)")
+    ap.add_argument("--force", action="store_true",
+                    help="allow --record even when rows FAIL (deliberately "
+                         "lowering the bar, e.g. after a hardware change)")
+    args = ap.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        print(json.dumps({"error": "perf_regress needs the TPU (the "
+                          "incumbents are v5e numbers)", "platform": platform}))
+        sys.exit(2)
+
+    with open(INCUMBENTS_PATH) as fh:
+        incumbents = json.load(fh)
+
+    benches = _build_benches()
+
+    # warm up / compile (untimed), then size each bench's chain once so a
+    # fenced sample does ~target_s of device work
+    reps = {}
+    for key, (run, _, _, _) in benches.items():
+        _fence(run())
+        t0 = time.perf_counter()
+        _fence(run())
+        est = time.perf_counter() - t0
+        marginal = max(est - _TUNNEL_RT_S, 2e-3)
+        reps[key] = max(2, min(512, round(args.target_s / marginal)))
+
+    # interleaved rounds: one fenced chained sample of EVERY bench per round
+    best = {key: float("inf") for key in benches}
+    for _ in range(args.rounds):
+        for key, (run, _, _, _) in benches.items():
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(reps[key]):
+                out = run()
+            _fence(out)
+            best[key] = min(best[key], (time.perf_counter() - t0) / reps[key])
+
+    failures = 0
+    results = {}
+    for key, (_, to_value, unit, higher) in benches.items():
+        value = to_value(best[key])
+        inc = incumbents.get(key)
+        row = {"bench": key, "value": round(value, 2), "unit": unit,
+               "incumbent": inc, "reps": reps[key]}
+        if inc:
+            # regression ratio, oriented so >1 means better than incumbent
+            ratio = value / inc if higher else inc / value
+            row["vs_incumbent"] = round(ratio, 3)
+            if ratio < 1 - args.tol:
+                row["status"] = "FAIL"
+                failures += 1
+            elif ratio < 1 - args.tol / 2:
+                row["status"] = "WARN"
+            else:
+                row["status"] = "PASS"
+        else:
+            row["status"] = "NO_INCUMBENT"
+        results[key] = value
+        print(json.dumps(row), flush=True)
+
+    print(json.dumps({
+        "summary": "FAIL" if failures else "PASS",
+        "failures": failures,
+        "rounds": args.rounds,
+        "tol": args.tol,
+    }))
+    if args.record and failures and not args.force:
+        # never silently ratchet the bar down: recording a FAILing run would
+        # launder the regression into the baseline every future gate passes
+        print(json.dumps({
+            "record_refused": f"{failures} row(s) FAILed; pass --force to "
+                              "deliberately lower the incumbents"
+        }))
+        sys.exit(1)
+    if args.record:
+        incumbents.update(results)
+        incumbents["recorded"] = (
+            f"perf_regress --record (rounds={args.rounds}) on {platform}"
+        )
+        with open(INCUMBENTS_PATH, "w") as fh:
+            json.dump(incumbents, fh, indent=2)
+            fh.write("\n")
+        print(json.dumps({"recorded_to": INCUMBENTS_PATH}))
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
