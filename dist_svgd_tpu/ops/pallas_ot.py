@@ -86,17 +86,51 @@ def _blocks(k, m, default_k, default_m):
 _NEG_HUGE = -3.0e38
 
 
-def _d2_tile(y, xT, d_true):
+def _col(rowvec):
+    """(1, bk) → (bk, 1) in-kernel relayout.
+
+    The row-side operands of every kernel here (coordinates, potentials,
+    outputs) are stored **transposed and lane-dense** — ``(d, kp)`` /
+    ``(1, kp)`` instead of ``(kp, 128)`` — because TPU tiles every 2-D f32
+    array to (8, 128): a ``(kp, small)`` array physically occupies
+    ``kp × 128`` floats (42.7× waste at d=3), which at streaming sizes is
+    gigabytes per operand (measured: the 1M-particle W2 step OOMed HBM on
+    three 3.8 GB lane-padded row operands).  The lane↔sublane relayout is
+    NOT free (a naive per-tile transpose measured ~15–25% per pass), so
+    the kernels hoist it: :func:`_row_tile` caches the transposed row
+    block in VMEM scratch once per outer grid index and the inner column
+    sweep reads the cache.
+    """
+    return jnp.transpose(rowvec, (1, 0))
+
+
+def _row_tile(j, yT_ref, yc_ref, d_true: int):
+    """Cache the transposed row-coordinate block in scratch at the start of
+    each row tile's column sweep (``j == 0``; the grid iterates columns
+    innermost, so the row block is invariant until the next outer step).
+    Returns the ``(bk, ·)`` column view the distance broadcasts use."""
+    @pl.when(j == 0)
+    def _():
+        yc_ref[:, :d_true] = jnp.transpose(yT_ref[:d_true, :], (1, 0))
+
+    return yc_ref
+
+
+def _d2_tile(j, yT_ref, xT, yc_ref, d_true):
     """(bk, bm) squared distances via per-dim VPU broadcasts, clamped so
-    sentinel-padded columns stay finite (ops/pallas_svgd.py conventions)."""
+    sentinel-padded columns stay finite (ops/pallas_svgd.py conventions).
+    Coordinate operands arrive transposed (``(SMALL_D, bk)`` /
+    ``(SMALL_D, bm)`` — see :func:`_col`); the row block's relayout is
+    served from the ``yc_ref`` scratch cache (:func:`_row_tile`)."""
+    yc = _row_tile(j, yT_ref, yc_ref, d_true)
     d2 = None
     for c in range(d_true):  # static unroll
-        diff = y[:, c:c + 1] - xT[c:c + 1, :]
+        diff = yc[:, c:c + 1] - xT[c:c + 1, :]
         d2 = diff * diff if d2 is None else d2 + diff * diff
     return jnp.minimum(d2, _D2_CAP)
 
 
-def _ct_kernel(y_ref, xT_ref, p_ref, o_ref, m_ref, s_ref, *,
+def _ct_kernel(yT_ref, xT_ref, p_ref, o_ref, m_ref, s_ref, yc_ref, *,
                inv_reg: float, d_true: int, nm: int, soft: bool):
     """One (i, j) grid step of :func:`ctransform_reduce`.
 
@@ -107,7 +141,7 @@ def _ct_kernel(y_ref, xT_ref, p_ref, o_ref, m_ref, s_ref, *,
     exp-zero / never-min without any mask.
     """
     j = pl.program_id(1)
-    d2 = _d2_tile(y_ref[:], xT_ref[:], d_true)
+    d2 = _d2_tile(j, yT_ref, xT_ref[:], yc_ref, d_true)
     p = p_ref[:]  # (1, bm) column potentials
 
     if soft:
@@ -129,7 +163,7 @@ def _ct_kernel(y_ref, xT_ref, p_ref, o_ref, m_ref, s_ref, *,
 
         @pl.when(j == nm - 1)
         def _():
-            o_ref[:] = m_ref[:, :1] + jnp.log(s_ref[:, :1])
+            o_ref[:] = _col(m_ref[:, :1] + jnp.log(s_ref[:, :1]))
     else:
         e = d2 - p  # (bk, bm)
 
@@ -143,7 +177,7 @@ def _ct_kernel(y_ref, xT_ref, p_ref, o_ref, m_ref, s_ref, *,
 
         @pl.when(j == nm - 1)
         def _():
-            o_ref[:] = m_ref[:, :1]
+            o_ref[:] = _col(m_ref[:, :1])
 
 
 @functools.partial(
@@ -161,7 +195,8 @@ def ctransform_reduce(rows, cols, col_pot, inv_reg: float, soft: bool,
         soft: logsumexp (True) or hard min (False) — docstring above.
 
     Returns ``(k,)``: ``LSE_j((p_j − C_ij)·inv_reg)`` or ``min_j (C_ij −
-    p_j)``.
+    p_j)``.  All row-side operands and the output travel transposed and
+    lane-dense (:func:`_col`): HBM cost is O(k·d), not O(k·128).
     """
     k, d = rows.shape
     m = cols.shape[0]
@@ -171,38 +206,46 @@ def ctransform_reduce(rows, cols, col_pot, inv_reg: float, soft: bool,
     kp, mp = _round_up(k, bk), _round_up(m, bm)
     nk, nm = kp // bk, mp // bm
 
-    y = _pad_to(rows.astype(f32), kp, 128)
+    yT = _pad_to(rows.T.astype(f32), SMALL_D, kp)
     xT = _pad_to(cols.T.astype(f32), SMALL_D, mp, value=_FAR)
     p = _pad_to(col_pot.astype(f32)[None, :], 1, mp)
 
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
     scratch = (
-        [pltpu.VMEM((bk, 128), f32), pltpu.VMEM((bk, 128), f32)]
+        [pltpu.VMEM((bk, 128), f32), pltpu.VMEM((bk, 128), f32),
+         pltpu.VMEM((bk, SMALL_D), f32)]
         if pltpu is not None
         else [jax.ShapeDtypeStruct((bk, 128), f32),
-              jax.ShapeDtypeStruct((bk, 128), f32)]
+              jax.ShapeDtypeStruct((bk, 128), f32),
+              jax.ShapeDtypeStruct((bk, SMALL_D), f32)]
     )
     out = pl.pallas_call(
         functools.partial(_ct_kernel, inv_reg=float(inv_reg), d_true=d,
                           nm=nm, soft=soft),
-        out_shape=jax.ShapeDtypeStruct((kp, 1), f32),
+        out_shape=jax.ShapeDtypeStruct((1, kp), f32),
         grid=(nk, nm),
         in_specs=[
-            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((SMALL_D, bk), lambda i, j: (0, i), **vmem),
             pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
             pl.BlockSpec((1, bm), lambda i, j: (0, j), **vmem),
         ],
-        out_specs=pl.BlockSpec((bk, 1), lambda i, j: (i, 0), **vmem),
+        out_specs=pl.BlockSpec((1, bk), lambda i, j: (0, i), **vmem),
         scratch_shapes=scratch,
         interpret=interpret,
-    )(y, xT, p)
-    return out[:k, 0]
+    )(yT, xT, p)
+    return out[0, :k]
 
 
-def _kexp_kernel(y_ref, xT_ref, f_ref, g_ref, o_ref, *,
+def _kexp_kernel(yT_ref, xT_ref, f_ref, g_ref, o_ref, yc_ref, fc_ref, *,
                  inv_reg: float, d_true: int):
-    d2 = _d2_tile(y_ref[:], xT_ref[:], d_true)
-    e = (f_ref[:, :1] + g_ref[:] - d2) * inv_reg
+    j = pl.program_id(1)
+    d2 = _d2_tile(j, yT_ref, xT_ref[:], yc_ref, d_true)
+
+    @pl.when(j == 0)
+    def _():
+        fc_ref[:, :1] = _col(f_ref[:])
+
+    e = (fc_ref[:, :1] + g_ref[:] - d2) * inv_reg
     o_ref[:] = jnp.exp(e)
 
 
@@ -219,43 +262,53 @@ def kexp(rows, cols, f, g, inv_reg: float, interpret: bool = False):
     bk, bm = _blocks(k, m, _KEXP_BLOCK_K, _BLOCK_M)
     kp, mp = _round_up(k, bk), _round_up(m, bm)
 
-    y = _pad_to(rows.astype(f32), kp, 128)
+    yT = _pad_to(rows.T.astype(f32), SMALL_D, kp)
     xT = _pad_to(cols.T.astype(f32), SMALL_D, mp, value=_FAR)
-    fp = _pad_to(f.astype(f32)[:, None], kp, 128)
+    fp = _pad_to(f.astype(f32)[None, :], 1, kp)
     gp = _pad_to(g.astype(f32)[None, :], 1, mp)
 
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    scratch = (
+        [pltpu.VMEM((bk, SMALL_D), f32), pltpu.VMEM((bk, 1), f32)]
+        if pltpu is not None
+        else [jax.ShapeDtypeStruct((bk, SMALL_D), f32),
+              jax.ShapeDtypeStruct((bk, 1), f32)]
+    )
     out = pl.pallas_call(
         functools.partial(_kexp_kernel, inv_reg=float(inv_reg), d_true=d),
         out_shape=jax.ShapeDtypeStruct((kp, mp), f32),
         grid=(kp // bk, mp // bm),
         in_specs=[
-            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((SMALL_D, bk), lambda i, j: (0, i), **vmem),
             pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
-            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((1, bk), lambda i, j: (0, i), **vmem),
             pl.BlockSpec((1, bm), lambda i, j: (0, j), **vmem),
         ],
         out_specs=pl.BlockSpec((bk, bm), lambda i, j: (i, j), **vmem),
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(y, xT, fp, gp)
+    )(yT, xT, fp, gp)
     return out[:k, :m]
 
 
-def _plan_grad_kernel(y_ref, xT_ref, f_ref, g_ref, o_ref, acc_ref, ksum_ref,
-                      *, inv_reg: float, d_true: int, nm: int):
+def _plan_grad_kernel(yT_ref, xT_ref, f_ref, g_ref, o_ref, acc_ref, ksum_ref,
+                      yc_ref, fc_ref, *, inv_reg: float, d_true: int,
+                      nm: int):
     """φ-kernel-style accumulation: per tile, plan entries ``P = exp((f + g
     − C)·inv_reg)`` feed a row-sum accumulator and d per-dim contractions
-    ``Σ_j P_ij·prevᵀ_cj``; the epilogue emits ``y·rowsum − acc``."""
+    ``Σ_j P_ij·prevᵀ_cj``; the epilogue emits ``y·rowsum − acc``
+    (transposed, matching the lane-dense output layout)."""
     j = pl.program_id(1)
-    y = y_ref[:]
     xT = xT_ref[:]
-    d2 = _d2_tile(y, xT, d_true)
-    p = jnp.exp((f_ref[:, :1] + g_ref[:] - d2) * inv_reg)  # (bk, bm)
+    d2 = _d2_tile(j, yT_ref, xT, yc_ref, d_true)
 
     @pl.when(j == 0)
     def _():
+        fc_ref[:, :1] = _col(f_ref[:])
         acc_ref[:] = jnp.zeros_like(acc_ref)
         ksum_ref[:] = jnp.zeros_like(ksum_ref)
+
+    p = jnp.exp((fc_ref[:, :1] + g_ref[:] - d2) * inv_reg)  # (bk, bm)
 
     cols = [
         jnp.sum(p * xT[c:c + 1, :], axis=1, keepdims=True)
@@ -263,20 +316,24 @@ def _plan_grad_kernel(y_ref, xT_ref, f_ref, g_ref, o_ref, acc_ref, ksum_ref,
     ]
     pad = acc_ref.shape[1] - d_true
     acc_ref[:] = acc_ref[:] + jnp.concatenate(
-        cols + [jnp.zeros((y.shape[0], pad), jnp.float32)], axis=1
+        cols + [jnp.zeros((p.shape[0], pad), jnp.float32)], axis=1
     )
     ksum_ref[:] = ksum_ref[:] + jnp.sum(p, axis=1, keepdims=True)
 
     @pl.when(j == nm - 1)
     def _():
-        o_ref[:] = y * ksum_ref[:, :1] - acc_ref[:]
+        # (SMALL_D, bk) output tile: yT·rowsumᵀ − accᵀ (once per row tile)
+        ksum_row = _col(ksum_ref[:, :1])                    # (1, bk)
+        accT = jnp.transpose(acc_ref[:, :o_ref.shape[0]], (1, 0))
+        o_ref[:] = yT_ref[:] * ksum_row - accT
 
 
 @functools.partial(jax.jit, static_argnames=("inv_reg", "interpret"))
 def plan_grad(rows, cols, f, g, inv_reg: float, interpret: bool = False):
     """Fused W2 gradient ``grad_i = rows_i·Σ_j P_ij − Σ_j P_ij·cols_j`` with
     the plan ``P = exp((f_i + g_j − C_ij)·inv_reg)`` recomputed per tile —
-    the plan never exists in HBM."""
+    the plan never exists in HBM.  Row-side operands travel transposed and
+    lane-dense (:func:`_col`)."""
     k, d = rows.shape
     m = cols.shape[0]
     assert d <= SMALL_D, d
@@ -285,38 +342,41 @@ def plan_grad(rows, cols, f, g, inv_reg: float, interpret: bool = False):
     kp, mp = _round_up(k, bk), _round_up(m, bm)
     nm = mp // bm
 
-    y = _pad_to(rows.astype(f32), kp, 128)
+    yT = _pad_to(rows.T.astype(f32), SMALL_D, kp)
     # padded columns contribute nothing because P underflows to an EXACT
     # zero there (the clamped sentinel distance gives exp(−1e30·inv_reg)
     # == 0.0 for any inv_reg ≳ 1e-28), and 0.0 · _FAR == 0.0 — the
     # sentinel coordinate never reaches the accumulators
     xT = _pad_to(cols.T.astype(f32), SMALL_D, mp, value=_FAR)
-    fp = _pad_to(f.astype(f32)[:, None], kp, 128)
+    fp = _pad_to(f.astype(f32)[None, :], 1, kp)
     gp = _pad_to(g.astype(f32)[None, :], 1, mp)
 
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
     scratch = (
-        [pltpu.VMEM((bk, 128), f32), pltpu.VMEM((bk, 128), f32)]
+        [pltpu.VMEM((bk, 128), f32), pltpu.VMEM((bk, 128), f32),
+         pltpu.VMEM((bk, SMALL_D), f32), pltpu.VMEM((bk, 1), f32)]
         if pltpu is not None
         else [jax.ShapeDtypeStruct((bk, 128), f32),
-              jax.ShapeDtypeStruct((bk, 128), f32)]
+              jax.ShapeDtypeStruct((bk, 128), f32),
+              jax.ShapeDtypeStruct((bk, SMALL_D), f32),
+              jax.ShapeDtypeStruct((bk, 1), f32)]
     )
     out = pl.pallas_call(
         functools.partial(_plan_grad_kernel, inv_reg=float(inv_reg),
                           d_true=d, nm=nm),
-        out_shape=jax.ShapeDtypeStruct((kp, 128), f32),
+        out_shape=jax.ShapeDtypeStruct((SMALL_D, kp), f32),
         grid=(kp // bk, nm),
         in_specs=[
-            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((SMALL_D, bk), lambda i, j: (0, i), **vmem),
             pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
-            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((1, bk), lambda i, j: (0, i), **vmem),
             pl.BlockSpec((1, bm), lambda i, j: (0, j), **vmem),
         ],
-        out_specs=pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+        out_specs=pl.BlockSpec((SMALL_D, bk), lambda i, j: (0, i), **vmem),
         scratch_shapes=scratch,
         interpret=interpret,
-    )(y, xT, fp, gp)
-    return out[:k, :d]
+    )(yT, xT, fp, gp)
+    return out[:d, :k].T
 
 
 def _solve_setup(particles, previous, eps, g_init, interpret):
@@ -443,20 +503,23 @@ def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
     return grad.astype(particles.dtype)
 
 
-def _kmat_vec_kernel(y_ref, xT_ref, f_ref, g_ref, rT_ref, o_ref, acc_ref, *,
-                     inv_reg: float, d_true: int, r_true: int, nm: int):
+def _kmat_vec_kernel(yT_ref, xT_ref, f_ref, g_ref, rT_ref, o_ref, acc_ref,
+                     yc_ref, fc_ref, *, inv_reg: float, d_true: int,
+                     r_true: int, nm: int):
     """Accumulate ``Σ_j P_ij · R_jc`` per output tile without materialising
     P: the absorbed-kernel tile is rebuilt from coordinates (the
     :func:`_d2_tile` broadcast) and contracted against the RHS columns as
     per-column VPU reductions — :func:`_plan_grad_kernel`'s pattern with an
     arbitrary (small, static) RHS instead of the coordinates."""
     j = pl.program_id(1)
-    d2 = _d2_tile(y_ref[:], xT_ref[:], d_true)
-    p = jnp.exp((f_ref[:, :1] + g_ref[:] - d2) * inv_reg)  # (bk, bm)
+    d2 = _d2_tile(j, yT_ref, xT_ref[:], yc_ref, d_true)
 
     @pl.when(j == 0)
     def _():
+        fc_ref[:, :1] = _col(f_ref[:])
         acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    p = jnp.exp((fc_ref[:, :1] + g_ref[:] - d2) * inv_reg)  # (bk, bm)
 
     cols = [
         jnp.sum(p * rT_ref[c:c + 1, :], axis=1, keepdims=True)
@@ -469,7 +532,7 @@ def _kmat_vec_kernel(y_ref, xT_ref, f_ref, g_ref, rT_ref, o_ref, acc_ref, *,
 
     @pl.when(j == nm - 1)
     def _():
-        o_ref[:] = acc_ref[:]
+        o_ref[:] = jnp.transpose(acc_ref[:, :o_ref.shape[0]], (1, 0))
 
 
 @functools.partial(jax.jit, static_argnames=("inv_reg", "interpret"))
@@ -479,7 +542,10 @@ def kmat_vec(rows, cols, f, g, rhs, inv_reg: float, interpret: bool = False):
     memory, no ``(k, m)`` matrix ever exists.  ``rhs`` is ``(m,)`` or
     ``(m, r)`` with small static ``r`` (≤ :data:`SMALL_D`).  The transpose
     product ``Pᵀ u`` is the same kernel with the roles (and potentials)
-    swapped: ``kmat_vec(cols, rows, g, f, u, inv_reg)``."""
+    swapped: ``kmat_vec(cols, rows, g, f, u, inv_reg)``.  Row-side
+    operands and the output travel transposed and lane-dense
+    (:func:`_col`): at k = 1M rows, O(k) HBM instead of the 512 MB-per-
+    operand lane padding that OOMed the 1M-particle W2 step."""
     squeeze = rhs.ndim == 1
     if squeeze:
         rhs = rhs[:, None]
@@ -491,38 +557,42 @@ def kmat_vec(rows, cols, f, g, rhs, inv_reg: float, interpret: bool = False):
     kp, mp = _round_up(k, bk), _round_up(m, bm)
     nm = mp // bm
 
-    y = _pad_to(rows.astype(f32), kp, 128)
+    yT = _pad_to(rows.T.astype(f32), SMALL_D, kp)
     # padded columns: P underflows to an exact 0.0 there (clamped sentinel
     # distance), so the rhs pad value never reaches the accumulators
     xT = _pad_to(cols.T.astype(f32), SMALL_D, mp, value=_FAR)
-    fp = _pad_to(f.astype(f32)[:, None], kp, 128)
+    fp = _pad_to(f.astype(f32)[None, :], 1, kp)
     gp = _pad_to(g.astype(f32)[None, :], 1, mp)
     rT = _pad_to(rhs.T.astype(f32), SMALL_D, mp)
 
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
     scratch = (
-        [pltpu.VMEM((bk, 128), f32)]
+        [pltpu.VMEM((bk, 128), f32), pltpu.VMEM((bk, SMALL_D), f32),
+         pltpu.VMEM((bk, 1), f32)]
         if pltpu is not None
-        else [jax.ShapeDtypeStruct((bk, 128), f32)]
+        else [jax.ShapeDtypeStruct((bk, 128), f32),
+              jax.ShapeDtypeStruct((bk, SMALL_D), f32),
+              jax.ShapeDtypeStruct((bk, 1), f32)]
     )
     out = pl.pallas_call(
         functools.partial(_kmat_vec_kernel, inv_reg=float(inv_reg),
                           d_true=d, r_true=r, nm=nm),
-        out_shape=jax.ShapeDtypeStruct((kp, 128), f32),
+        out_shape=jax.ShapeDtypeStruct((SMALL_D, kp), f32),
         grid=(kp // bk, nm),
         in_specs=[
-            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((SMALL_D, bk), lambda i, j: (0, i), **vmem),
             pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
-            pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+            pl.BlockSpec((1, bk), lambda i, j: (0, i), **vmem),
             pl.BlockSpec((1, bm), lambda i, j: (0, j), **vmem),
             pl.BlockSpec((SMALL_D, bm), lambda i, j: (0, j), **vmem),
         ],
-        out_specs=pl.BlockSpec((bk, 128), lambda i, j: (i, 0), **vmem),
+        out_specs=pl.BlockSpec((SMALL_D, bk), lambda i, j: (0, i), **vmem),
         scratch_shapes=scratch,
         interpret=interpret,
-    )(y, xT, fp, gp, rT)
-    out = out[:k, :r]
-    return out[:, 0] if squeeze else out
+    )(yT, xT, fp, gp, rT)
+    if squeeze:
+        return out[0, :k]
+    return out[:r, :k].T
 
 
 def sinkhorn_grad_streaming(particles, previous, eps: float = 0.05,
