@@ -163,7 +163,11 @@ def _timed_chain(fn, reps=None, samples=3, target_s=1.0):
     return best
 
 
-def _make_sharded(fold, phi_impl="auto", wasserstein=False, mode="all_particles"):
+def _make_sharded(fold, phi_impl="auto", wasserstein=False,
+                  mode="all_particles", n=None):
+    """The flagship sharded-sampler config, in ONE place — bench rows, the
+    perf gate (tools/perf_regress.py), and the large-n tools all build from
+    here so a config change cannot silently diverge between them."""
     import jax.numpy as jnp
 
     import dist_svgd_tpu as dt
@@ -172,7 +176,7 @@ def _make_sharded(fold, phi_impl="auto", wasserstein=False, mode="all_particles"
 
     data = (jnp.asarray(fold.x_train), jnp.asarray(fold.t_train.reshape(-1)))
     d = 1 + fold.x_train.shape[1]
-    particles = init_particles_per_shard(0, N_PARTICLES, d, NUM_SHARDS)
+    particles = init_particles_per_shard(0, n or N_PARTICLES, d, NUM_SHARDS)
     return dt.DistSampler(
         NUM_SHARDS, logreg_logp, None, particles, data=data,
         exchange_particles=(mode != "partitions"), exchange_scores=False,
@@ -423,19 +427,8 @@ def main():
     # --no-fixed measures the cold/warm pair)
     w2s_ms = None
     if platform == "tpu":
-        from dist_svgd_tpu.models.logreg import logreg_logp
-        from dist_svgd_tpu.utils.rng import init_particles_per_shard
-        import jax.numpy as jnp
-
-        n100, k100 = 100_000, 5
-        w2s = dt.DistSampler(
-            NUM_SHARDS, logreg_logp, None,
-            init_particles_per_shard(0, n100, d, NUM_SHARDS),
-            data=(jnp.asarray(fold.x_train),
-                  jnp.asarray(fold.t_train.reshape(-1))),
-            exchange_particles=True, exchange_scores=False,
-            include_wasserstein=True, wasserstein_solver="sinkhorn",
-        )
+        k100 = 5
+        w2s = _make_sharded(fold, wasserstein=True, n=100_000)
         _fence(w2s.run_steps(k100, 3e-3, h=10.0))  # compile, untimed
         w2s_wall = _timed_chain(lambda: w2s.run_steps(k100, 3e-3, h=10.0))
         w2s_ms = w2s_wall / k100 * 1e3

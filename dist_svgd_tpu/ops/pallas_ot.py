@@ -66,9 +66,12 @@ from dist_svgd_tpu.ops.pallas_svgd import (
 
 #: Default tile sizes — the φ kernel's small-d autotune result (1024² —
 #: docs/notes.md) applies to the accumulator kernels (ctransform_reduce,
-#: plan_grad), whose outputs are (bk, 128) slivers.  ``kexp`` writes full
-#: (bk, bm) tiles (4 MB at 1024², double-buffered) and needs a smaller k
-#: tile to fit scoped VMEM alongside its distance temporaries.
+#: plan_grad, kmat_vec), whose outputs are lane-dense (1, bk)/(SMALL_D, bk)
+#: row slivers and whose VMEM residents beyond the (bk, bm) distance
+#: temporaries are the (bk, 128) accumulators plus the small transposed
+#: row caches (``_row_tile``).  ``kexp`` writes full (bk, bm) tiles (4 MB
+#: at 1024², double-buffered) and needs a smaller k tile to fit scoped
+#: VMEM alongside its distance temporaries.
 _BLOCK_K = 1024
 _BLOCK_M = 1024
 _KEXP_BLOCK_K = 512

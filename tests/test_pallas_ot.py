@@ -230,6 +230,54 @@ def test_streaming_grad_matches_xla_path(rng, tol, warm):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_kernels_multi_tile_grids(rng, monkeypatch):
+    """Force tiny tiles so every kernel runs a REAL multi-tile grid (several
+    row tiles × several column sweeps) under the interpreter — pinning the
+    per-row-tile scratch-cache protocol (``_row_tile``/``fc_ref`` refresh at
+    ``j == 0``) that single-tile shapes never exercise.  A stale cache (row
+    block i−1's transposed coordinates or potential leaking into row block
+    i) shows up as wrong rows here."""
+    from dist_svgd_tpu.ops import pallas_ot as po
+
+    import jax
+
+    monkeypatch.setattr(po, "_BLOCK_K", 16)
+    monkeypatch.setattr(po, "_BLOCK_M", 16)
+    monkeypatch.setattr(po, "_KEXP_BLOCK_K", 16)
+    # the kernels are module-level jax.jit functions that read the tile
+    # globals at TRACE time: stale traces for these shapes would silently
+    # ignore the patch (and tiny-tile traces must not outlive it either)
+    jax.clear_caches()
+    k, m, d = 50, 70, 3  # 4 × 5 grids with ragged edges
+    x = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(m, d)), jnp.float32)
+    f = jnp.asarray(rng.normal(size=(k,)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=(m,)), jnp.float32)
+    sq = np.asarray(
+        ((np.asarray(x)[:, None, :] - np.asarray(y)[None, :, :]) ** 2).sum(-1)
+    )
+    p_dense = np.exp(np.asarray(f)[:, None] + np.asarray(g)[None, :] - sq)
+
+    got_k = np.asarray(po.kexp(x, y, f, g, 1.0, interpret=True))
+    np.testing.assert_allclose(got_k, p_dense, rtol=1e-5, atol=1e-7)
+
+    v = jnp.asarray(rng.normal(size=(m,)), jnp.float32)
+    got_mv = np.asarray(po.kmat_vec(x, y, f, g, v, 1.0, interpret=True))
+    np.testing.assert_allclose(got_mv, p_dense @ np.asarray(v),
+                               rtol=1e-5, atol=1e-5)
+
+    got_ct = np.asarray(po.ctransform_reduce(x, y, g, 1.0, True,
+                                             interpret=True))
+    want_ct = np.log(np.exp(np.asarray(g)[None, :] - sq).sum(1))
+    np.testing.assert_allclose(got_ct, want_ct, rtol=1e-5, atol=1e-5)
+
+    got_pg = np.asarray(po.plan_grad(x, y, f, g, 1.0, interpret=True))
+    want_pg = (np.asarray(x) * p_dense.sum(1)[:, None]
+               - p_dense @ np.asarray(y))
+    np.testing.assert_allclose(got_pg, want_pg, rtol=1e-5, atol=1e-5)
+    jax.clear_caches()  # drop the tiny-tile traces before other tests
+
+
 def test_streaming_warm_early_exit_at_converged_dual(rng):
     """A carried dual whose soft-transform change is already within tol
     skips the scaling loop entirely (the start pair is one exact log-domain
