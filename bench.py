@@ -347,6 +347,43 @@ def _steps_to_target(_fold_unused=None) -> dict:
     }
 
 
+def _run_tpu_test_tier() -> str:
+    """Run the real-Mosaic pytest tier (``DSVGD_TPU_TESTS=1 pytest -m tpu``,
+    tests/test_tpu_kernels.py) in a subprocess and return its one-line
+    result — so every BENCH_r* carries the hardware-pinning evidence, not
+    just throughput numbers (the round-3 verdict's ask: a Mosaic-only
+    kernel regression should be a red test in the driver's record)."""
+    import os
+    import re
+    import subprocess
+
+    env = dict(os.environ, DSVGD_TPU_TESTS="1")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests", "-m", "tpu", "-q",
+             "--no-header", "-p", "no:cacheprovider"],
+            capture_output=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        tail = (proc.stdout or b"").decode(errors="replace").strip().splitlines()
+        summary = next(
+            (ln for ln in reversed(tail) if re.search(r"\d+ (passed|failed)", ln)),
+            tail[-1] if tail else "no output",
+        ).strip("= ")
+        if proc.returncode != 0 or "passed" not in summary:
+            # a tier that failed, errored out, or never ran (e.g. a TPU
+            # runtime that refuses a second process's backend init → the
+            # tests all auto-skip) must not read as benign evidence
+            err_tail = (proc.stderr or b"").decode(errors="replace").strip()
+            return (f"NOT GREEN (exit {proc.returncode}): {summary}"
+                    + (f" | stderr: {err_tail[-200:]}" if err_tail else ""))
+        return summary
+    except subprocess.TimeoutExpired:
+        return "TIMEOUT after 900 s"
+    except Exception as e:  # pragma: no cover — never break the bench
+        return f"tier run failed: {type(e).__name__}: {e}"
+
+
 def main():
     platform, devs = _init_platform()
 
@@ -495,6 +532,12 @@ def main():
         "ref_headline_config_ref_wall_s": 2007.11,
     }
     out.update(conv)
+    # hardware-pinning evidence rides along with the numbers (TPU only;
+    # the subprocess runs after every measurement so it cannot contaminate
+    # the timed sections — two concurrent TPU workloads measured 6× noise,
+    # docs/notes.md timing protocol)
+    if platform == "tpu":
+        out["tpu_test_tier"] = _run_tpu_test_tier()
     print(json.dumps(out))
 
 

@@ -230,7 +230,7 @@ def test_streaming_grad_matches_xla_path(rng, tol, warm):
                                rtol=1e-4, atol=1e-4)
 
 
-def test_kernels_multi_tile_grids(rng, monkeypatch):
+def test_kernels_multi_tile_grids(rng, monkeypatch, request):
     """Force tiny tiles so every kernel runs a REAL multi-tile grid (several
     row tiles × several column sweeps) under the interpreter — pinning the
     per-row-tile scratch-cache protocol (``_row_tile``/``fc_ref`` refresh at
@@ -246,8 +246,10 @@ def test_kernels_multi_tile_grids(rng, monkeypatch):
     monkeypatch.setattr(po, "_KEXP_BLOCK_K", 16)
     # the kernels are module-level jax.jit functions that read the tile
     # globals at TRACE time: stale traces for these shapes would silently
-    # ignore the patch (and tiny-tile traces must not outlive it either)
+    # ignore the patch — and tiny-tile traces must not outlive it either,
+    # so the trailing clear runs even when an assertion fails
     jax.clear_caches()
+    request.addfinalizer(jax.clear_caches)
     k, m, d = 50, 70, 3  # 4 × 5 grids with ragged edges
     x = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
     y = jnp.asarray(rng.normal(size=(m, d)), jnp.float32)
@@ -275,7 +277,6 @@ def test_kernels_multi_tile_grids(rng, monkeypatch):
     want_pg = (np.asarray(x) * p_dense.sum(1)[:, None]
                - p_dense @ np.asarray(y))
     np.testing.assert_allclose(got_pg, want_pg, rtol=1e-5, atol=1e-5)
-    jax.clear_caches()  # drop the tiny-tile traces before other tests
 
 
 def test_streaming_warm_early_exit_at_converged_dual(rng):
