@@ -289,6 +289,27 @@ def test_resharded_restore_partitions(rng):
     )
 
 
+def test_resharded_restore_through_checkpoint_files(tmp_path, rng):
+    """The reshard path composes with the on-disk checkpoint layer: save an
+    S=8 W2 run with save_state, restore the files into an S=4 sampler, and
+    continue — the layout conversion happens at load_state_dict, so the
+    file format needs no awareness of it."""
+    n, d = 16, 3
+    parts = jnp.asarray(rng.normal(size=(n, d)))
+    kw = dict(exchange_particles=True, exchange_scores=False)
+    a = _make_w2(8, parts, kw)
+    for _ in range(3):
+        a.make_step(0.05, h=0.5)
+    post = np.asarray(a.particles)
+    path = save_state(str(tmp_path / "s8"), a.state_dict())
+
+    b = _make_w2(4, parts, kw)
+    b.load_state_dict(load_state(path))
+    np.testing.assert_array_equal(np.asarray(b.particles), post)
+    assert np.asarray(b._previous).shape == (4, n, d)
+    assert np.isfinite(np.asarray(b.run_steps(2, 0.05, h=0.5))).all()
+
+
 def test_resharded_restore_impossible_cases(rng):
     """partitions/S=1 saves never recorded pre-update rows, so restoring
     them into an exchanged S>1 layout must raise, as must garbage shapes."""
