@@ -96,10 +96,11 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
             b = sampler.owned_block_index(r, t)
             shard_blocks[r].append(global_now[b * per : (b + 1) * per])
 
-    if wasserstein and (wasserstein_solver == "lp" or update_rule != "jacobi"):
+    if wasserstein and wasserstein_solver == "lp":
         # eager reference loop, one dispatch per step: the host-LP W2 (exact
-        # reference parity) needs per-step host snapshots, and the scanned W2
-        # dispatch is Jacobi-only (DistSampler.run_steps raises for GS+W2)
+        # reference parity) needs per-step host snapshots and cannot live in
+        # a jitted scan.  Every other combination — including GS + sinkhorn
+        # W2 (round 4) — runs scanned below
         for _ in range(niter):
             slice_snapshot(np.asarray(sampler.particles))
             sampler.make_step(stepsize, h=10.0)  # h=10 matches logreg.py:83

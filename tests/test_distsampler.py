@@ -190,8 +190,10 @@ def test_wasserstein_modes_match_oracle(name, exch_p, exch_s):
 
 @pytest.mark.parametrize("name,exch_p,exch_s", MODES)
 def test_wasserstein_gauss_seidel_matches_oracle(name, exch_p, exch_s):
-    """GS sweep + LP W2 term (make_step path — the scanned path is
-    Jacobi-only by construction) matches the oracle in every mode."""
+    """GS sweep + LP W2 term (make_step path — the host LP cannot live in a
+    scan) matches the oracle in every mode.  The scanned GS+W2 composition
+    (sinkhorn) is pinned against this eager path below
+    (test_run_steps_wasserstein_gauss_seidel_matches_eager)."""
     rng = np.random.default_rng(23)
     S = 2
     particles, data, score_of = make_gaussian_problem(rng, n=6, d=2, n_rows=8, num_shards=S)

@@ -123,6 +123,7 @@ def _driver_run_final(logreg, get_results_dir, solver, **over):
     results_dir = get_results_dir(
         cfg["dataset_name"], cfg["fold"], cfg["num_shards"], cfg["nparticles"],
         cfg["stepsize"], cfg["exchange"], cfg["wasserstein"],
+        cfg.get("update_rule", "jacobi"),
     )
     os.makedirs(results_dir, exist_ok=True)
     logreg.run(**cfg)
@@ -142,6 +143,22 @@ def test_logreg_driver_sinkhorn_solver_tracks_lp():
     logreg, get_results_dir = _import_logreg_driver()
     lp = _driver_run_final(logreg, get_results_dir, "lp")
     sk = _driver_run_final(logreg, get_results_dir, "sinkhorn")
+    assert lp.shape == sk.shape
+    np.testing.assert_allclose(sk, lp, atol=2e-2)
+    assert not np.allclose(sk, 0.0)
+
+
+def test_logreg_driver_gs_sinkhorn_scanned_tracks_lp():
+    """--update-rule gauss_seidel --wasserstein now drives the SCANNED
+    sinkhorn path (round-4 GS+W2 composition) and must stay close to the
+    eager host-LP GS parity path — the driver-level pin of the composition
+    cell (the sampler-level pin is
+    test_distsampler.py::test_run_steps_wasserstein_gauss_seidel_matches_eager)."""
+    logreg, get_results_dir = _import_logreg_driver()
+    lp = _driver_run_final(logreg, get_results_dir, "lp",
+                           update_rule="gauss_seidel")
+    sk = _driver_run_final(logreg, get_results_dir, "sinkhorn",
+                           update_rule="gauss_seidel")
     assert lp.shape == sk.shape
     np.testing.assert_allclose(sk, lp, atol=2e-2)
     assert not np.allclose(sk, 0.0)
