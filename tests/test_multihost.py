@@ -66,6 +66,20 @@ def test_make_global_particles_row_sharded():
     assert len(arr.sharding.device_set) == 8
 
 
+def test_make_global_from_local_single_process():
+    """The any-rank sibling of make_global_particles (used by the multi-host
+    checkpoint restore for the (S, ., d) snapshot stack): single-process it
+    is a sharded device_put of the full array, and a block that is not the
+    whole array must be rejected (one process owns all rows here)."""
+    mesh = multihost.make_particle_mesh(8)
+    arr = np.arange(8 * 4 * 2, dtype=np.float64).reshape(8, 4, 2)
+    out = multihost.make_global_from_local(arr, mesh, (8, 4, 2))
+    np.testing.assert_array_equal(np.asarray(out), arr)
+    assert len(out.sharding.device_set) == 8
+    with pytest.raises(ValueError, match="single-process local block"):
+        multihost.make_global_from_local(arr[:4], mesh, (8, 4, 2))
+
+
 def test_replicate_places_full_value_everywhere():
     mesh = multihost.make_particle_mesh(8)
     val = np.arange(10.0)
