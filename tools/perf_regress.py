@@ -41,7 +41,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import N_PARTICLES, NUM_SHARDS, _fence, _make_sharded, _TUNNEL_RT_S
+from bench import (
+    N_PARTICLES,
+    NUM_SHARDS,
+    _fence,
+    _make_phi_kernel_bench,
+    _make_sharded,
+    _TUNNEL_RT_S,
+)
 
 INCUMBENTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "perf_incumbents.json")
@@ -133,6 +140,14 @@ def _build_benches():
     benches["config1_ups"] = (
         c1_run, lambda w: 100 * 100 / w, "updates/sec", True,
     )
+
+    # 6. bare φ kernel on the north-star shapes — the same-session roofline
+    # that normalises the utilisation-fraction gate below (a ratio of two
+    # interleaved same-session measurements: pool noise cancels)
+    phi_run, phi_pairs = _make_phi_kernel_bench(1 + fold.x_train.shape[1])
+    benches["phi_kernel_pairs_per_sec"] = (
+        phi_run, lambda w: phi_pairs / w, "pairs/sec", True,
+    )
     return benches
 
 
@@ -210,6 +225,34 @@ def main():
         else:
             row["status"] = "NO_INCUMBENT"
         results[key] = value
+        print(json.dumps(row), flush=True)
+
+    # derived φ-utilisation gate (round-4 VERDICT item 6): the north-star
+    # step's pair rate (ups × n — each update is one row of n kernel-pair
+    # interactions) over the SAME-SESSION bare-φ-kernel rate, both from the
+    # interleaved rounds above, so pool noise cancels in the ratio and a
+    # move means a genuine utilisation change.  Gated at a FIXED 15%
+    # relative regression vs the incumbent fraction — tighter than the
+    # throughput rows' ±40% noise band, defensibly
+    frac_key = "north_star_roofline_fraction"
+    if "north_star_ups" in results and "phi_kernel_pairs_per_sec" in results:
+        fraction = (results["north_star_ups"] * N_PARTICLES
+                    / results["phi_kernel_pairs_per_sec"])
+        inc_frac = incumbents.get(frac_key)
+        row = {"bench": frac_key, "value": round(fraction, 4),
+               "unit": "step pairs/s over same-session bare-φ pairs/s",
+               "incumbent": inc_frac}
+        if inc_frac:
+            ratio = fraction / inc_frac
+            row["vs_incumbent"] = round(ratio, 3)
+            if ratio < 0.85:
+                row["status"] = "FAIL"
+                failures += 1
+            else:
+                row["status"] = "PASS"
+        else:
+            row["status"] = "NO_INCUMBENT"
+        results[frac_key] = round(fraction, 4)
         print(json.dumps(row), flush=True)
 
     print(json.dumps({
