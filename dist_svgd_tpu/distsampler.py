@@ -33,6 +33,7 @@ Reference parity notes (SURVEY.md §7.4):
 
 from __future__ import annotations
 
+import warnings
 from typing import Callable, Optional
 
 import jax
@@ -50,6 +51,17 @@ from dist_svgd_tpu.parallel.exchange import (
 )
 from dist_svgd_tpu.parallel.mesh import AXIS, bind_shard_fn, make_mesh
 from dist_svgd_tpu.utils.rng import minibatch_key
+
+
+#: Above this global particle count, ``w2_pairing='auto'`` routes the
+#: exchanged-mode Wasserstein term to the ``partitions``-style block pairing
+#: instead of the reference's global mixed-snapshot pairing.  Measured cliff
+#: (docs/notes.md round-4 large-n table, one v5e chip): the global pairing's
+#: ~4 resident lane-padded ``(n, d)`` buffers (gathered set, snapshot stack,
+#: their scan-carry doubles) run 3.78 s/step at n=400k but fall off an HBM
+#: cliff to 67.8 s/step at 600k; the block pairing's carried state is
+#: ``(n/S, d)`` per shard and scales to n = 1M+ on one chip.
+W2_GLOBAL_PAIRING_MAX_N = 400_000
 
 
 def _data_rows(data) -> int:
@@ -166,6 +178,21 @@ class DistSampler:
             RBF kernel at Gram-bound sizes, XLA otherwise), ``'xla'``,
             ``'pallas'`` (force), or ``'pallas_bf16'`` (bf16-Gram variant);
             see :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
+        w2_pairing: which sets the Wasserstein term pairs, in the exchanged
+            (``all_*``) modes.  ``'global'`` is the reference's literal
+            (warty) semantics: each shard pairs its block against the full
+            mixed-snapshot global set (module docstring) — per-shard
+            ``(n, d)`` carried state and ``(n/S, n)`` solves, which fall off
+            a measured HBM cliff past :data:`W2_GLOBAL_PAIRING_MAX_N`
+            particles (3.78 s/step at 400k → 67.8 at 600k on one v5e;
+            docs/notes.md).  ``'block'`` is the ``partitions``-style pairing
+            (block ``b`` against the last-step snapshot of block ``(b+1) mod
+            S``) with φ still interacting globally — ``(n/S, d)`` state,
+            ``(n/S, n/S)`` solves, scales to n = 1M+.  ``'auto'`` (default)
+            picks ``'global'`` up to the threshold and routes to ``'block'``
+            above it with a logged warning.  Ignored when the W2 term is off;
+            in ``partitions`` mode the pairing is inherently block-level
+            (``'global'`` raises there).
         seed: root PRNG seed for the per-step minibatch streams.
     """
 
@@ -194,6 +221,7 @@ class DistSampler:
         batch_size: Optional[int] = None,
         log_prior: Optional[Callable] = None,
         phi_impl: str = "auto",
+        w2_pairing: str = "auto",
         seed=0,
     ):
         assert not (exchange_scores and not exchange_particles), (
@@ -301,6 +329,54 @@ class DistSampler:
             self._mode = ALL_SCORES if exchange_scores else ALL_PARTICLES
         else:
             self._mode = PARTITIONS
+
+        # Wasserstein pairing resolution (docstring; round-5: the measured
+        # exchanged-mode W2 memory cliff gets an auto-route, not a silent
+        # 20× regression)
+        if w2_pairing not in ("auto", "global", "block"):
+            raise ValueError(f"unknown w2_pairing {w2_pairing!r}")
+        if self._mode == PARTITIONS:
+            if w2_pairing == "global":
+                raise ValueError(
+                    "w2_pairing='global' is undefined in partitions mode — "
+                    "its W2 pairing is inherently block-level (the (b+1) "
+                    "ring roll, module docstring)"
+                )
+            self._w2_pairing = "block"
+        elif not include_wasserstein:
+            self._w2_pairing = "global"  # inert without the W2 term
+        elif w2_pairing == "auto":
+            if (self._num_particles > W2_GLOBAL_PAIRING_MAX_N
+                    and self._num_shards > 1):
+                warnings.warn(
+                    f"n={self._num_particles} exceeds the exchanged-mode "
+                    f"global-W2-pairing ceiling ({W2_GLOBAL_PAIRING_MAX_N}): "
+                    "routing the Wasserstein term to w2_pairing='block' "
+                    "(partitions-style block snapshots; (n/S, n/S) solves). "
+                    "Pass w2_pairing='global' to force the reference pairing "
+                    "and accept the measured HBM cliff (67.8 s/step at 600k "
+                    "vs 3.78 at 400k — docs/notes.md).",
+                    stacklevel=2,
+                )
+                self._w2_pairing = "block"
+            else:
+                self._w2_pairing = "global"
+        else:
+            self._w2_pairing = w2_pairing
+            if (w2_pairing == "global"
+                    and self._num_particles > W2_GLOBAL_PAIRING_MAX_N):
+                warnings.warn(
+                    f"w2_pairing='global' forced at n={self._num_particles} "
+                    f"> {W2_GLOBAL_PAIRING_MAX_N}: expect the measured HBM "
+                    "cliff (docs/notes.md round-4 large-n table)",
+                    stacklevel=2,
+                )
+        # block-sized snapshots + (b+1) roll — partitions natively, or the
+        # exchanged modes under block pairing; S=1 degenerates to global
+        self._block_w2 = (
+            (self._mode == PARTITIONS or self._w2_pairing == "block")
+            and self._num_shards > 1
+        )
 
         self._mesh = make_mesh(self._num_shards) if mesh == "auto" else mesh
         # Under vmap emulation all S lanes run as ONE batched kernel, so the
@@ -433,8 +509,10 @@ class DistSampler:
 
     def _prev_shape(self) -> tuple:
         """Shape of the Wasserstein ``previous`` snapshot stack (see the
-        state comment in ``__init__``)."""
-        if self._mode == PARTITIONS and self._num_shards > 1:
+        state comment in ``__init__``): block-sized under block pairing
+        (``partitions``, or exchanged modes with ``w2_pairing='block'``),
+        global-sized under the reference's mixed-snapshot pairing."""
+        if self._block_w2:
             return (self._num_shards, self._particles_per_shard, self._d)
         return (self._num_shards, self._num_particles, self._d)
 
@@ -448,9 +526,10 @@ class DistSampler:
         """Per-shard W2 gradient, stacked to global ``(n, d)``."""
         cur = self._blocks(self._particles)
         grads = np.zeros_like(cur)
-        if self._mode == PARTITIONS and self._num_shards > 1:
+        if self._block_w2:
             # Device b's block pairs with the snapshot taken (last step) of
-            # block (b+1) mod S — the ring-ownership pairing, see module doc.
+            # block (b+1) mod S — the ring-ownership pairing (partitions
+            # natively, exchanged modes under w2_pairing='block').
             prev_for = np.roll(self._previous, -1, axis=0)
         else:
             prev_for = self._previous  # (S, n, d) mixed snapshots
@@ -482,7 +561,7 @@ class DistSampler:
 
     def _snapshot_previous(self, pre_update: np.ndarray) -> None:
         post = self._blocks(self._particles)
-        if self._mode == PARTITIONS and self._num_shards > 1:
+        if self._block_w2:
             self._previous = post.copy()  # owned-block snapshots
         else:
             pre_blocks = self._blocks(pre_update)
@@ -514,8 +593,15 @@ class DistSampler:
         Multi-host: on a mesh spanning several processes the global arrays
         are not fully addressable, so each process's dict holds only **its
         own** contiguous row block (plus its ``*_start`` offset) — every
-        process must save to its own path and restore its own checkpoint
-        (``parallel/multihost.py:host_addressable_block``)."""
+        process saves to its own path and, under the *same* layout, restores
+        its own checkpoint (``parallel/multihost.py:host_addressable_block``).
+        A federation with a **different process count** restores the same
+        save by assembling every per-process block back into the global
+        state first (:func:`dist_svgd_tpu.utils.checkpoint.
+        assemble_full_state` — the mesh size, hence every global shape, is
+        process-layout-independent) and loading that; a single
+        foreign-layout block alone is rejected with a clear error
+        (``tests/test_multihost.py::test_cross_process_count_restore``)."""
         from dist_svgd_tpu.parallel.multihost import host_addressable_block
 
         particles, p_start = host_addressable_block(self._particles)
@@ -615,7 +701,8 @@ class DistSampler:
             post = prev_arr.reshape(n, d)
         S_new = self._num_shards
         if len(want) == 3 and want[1] != n:
-            # partitions target: owned-block (post-update) stacks
+            # block-sized target (partitions, or exchanged w2_pairing=
+            # 'block'): owned-block (post-update) stacks
             return post.reshape(want)
         if S_new == 1:
             # the (1, n, d) stack is just the post-update global, whichever
@@ -625,10 +712,10 @@ class DistSampler:
         if not exch_save or S_old < 2:
             raise ValueError(
                 f"cannot reshard 'previous' {prev_arr.shape} to {want}: the "
-                "save holds only post-update blocks (partitions-mode or "
-                "single-shard save), but an exchanged-mode stack at "
-                f"num_shards={S_new} needs the pre-update rows it never "
-                "recorded"
+                "save holds only post-update blocks (partitions-mode, "
+                "w2_pairing='block', or single-shard save), but a global-"
+                f"pairing exchanged stack at num_shards={S_new} needs the "
+                "pre-update rows it never recorded"
             )
         s_old = n // S_old
         pre = np.empty_like(post)
@@ -651,7 +738,10 @@ class DistSampler:
         — whose per-block pairing does not survive a layout change — is
         dropped, so the first resumed W2 solve starts from zeroed duals (the
         safe soft-transform start; trajectory within the solver's tol band).
-        Multi-host restores still require the saving layout."""
+        Multi-host restores under a different *process* layout go through
+        :func:`~dist_svgd_tpu.utils.checkpoint.assemble_full_state` (see
+        :meth:`state_dict`); a different *shard count* on a multi-process
+        mesh still requires the saving mesh size."""
         self._particles = self._restore_global(
             "particles",
             np.asarray(state["particles"]),
@@ -732,7 +822,7 @@ class DistSampler:
         adaptive loops — should decompose their schedule into a bounded set
         of lengths (e.g. power-of-two chunks, at most log2(K) programs; see
         ``experiments/covertype.py`` and ``experiments/logreg.py:
-        RECORD_CHUNK``) or they will pay a fresh multi-second compile for
+        record_chunk_steps``) or they will pay a fresh multi-second compile for
         every new length.
 
         With the Wasserstein/JKO term enabled the ``previous`` snapshots ride
@@ -833,6 +923,7 @@ class DistSampler:
                 sinkhorn_warm_start=self._sinkhorn_warm_start,
                 phi_batch_hint=self._phi_batch_hint,
                 update_rule=self._update_rule,
+                w2_pairing=self._w2_pairing,
             )
             self._bound_w2_step = bind_shard_fn(
                 step,

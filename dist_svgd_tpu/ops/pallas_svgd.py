@@ -39,6 +39,7 @@ the XLA path.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -298,6 +299,14 @@ def phi_pallas(
         # round-2 sweep at (8×1250, 10k, 55) measured 256×1024 at 2.52 ms
         # vs 2.78 at 256² (f32) and 1.93 vs 2.80 (bf16x3) — docs/notes.md
         default_k, default_m = 256, 1024
+    if block_k is None and block_m is None:
+        # shape-keyed measured defaults (round 5): when the caller asked
+        # for no specific tiling, consult the harvested per-regime table
+        # before the generic heuristic — still padding-clamped and (big-d)
+        # VMEM-fitted below, so a measured tile can only shrink, not OOM
+        measured = _measured_block(k, m, d <= SMALL_D)
+        if measured is not None:
+            default_k, default_m = measured
     bk = min(block_k or _auto_block(k, default_k), _round_up(k, 8))
     bm = min(block_m or _auto_block(m, default_m), _round_up(m, 8))
     fit_m, fit_k = block_m is None, block_k is None
@@ -388,6 +397,51 @@ def phi_pallas(
         interpret=interpret,
     )(y, x_in, xs)
     return out[:k, :d].astype(in_dtype)
+
+
+#: Measured-best (block_k, block_m) per φ shape regime, harvested on a v5e
+#: (``tools/pallas_autotune.py --harvest`` + the vmapped-lane A/B —
+#: docs/notes.md round-5).  Keyed ``(small_d, k, m)`` at the measured
+#: ladder points; :func:`_measured_block` picks the nearest regime in
+#: log-shape space and the chosen tiles still pass the padding clamp and
+#: the big-d VMEM fit downstream.  Evidence notes:
+#:
+#: - the 8-shard lane row was measured UNDER ``vmap(8)`` — the framework's
+#:   actual regime.  The single-lane sweep crowns 512×1024 there (all
+#:   combos within 8%, dispatch-bound), but batched, 256×1024 wins by 31%
+#:   (0.842 ms/sweep, 118.8 G pairs/s vs 1.101 for 512×1024): per-lane
+#:   dead work from tile padding multiplies by the lane count;
+#: - the big-d lane's f32 sweep puts 256×{256,512,1024} within 2%; the
+#:   wide default is kept because round-2's bf16x3 sweep (the tier that
+#:   regime actually runs) measured wide-m decisively better (1.93 ms at
+#:   256×1024 vs 2.80 at 256²);
+#: - the large squares have the only strong k-axis signal: at (100k, 100k)
+#:   1024×1024 reaches 129.4 G pairs/s vs 76.6 for 256² — tall AND wide
+#:   tiles pay off once k amortises the m-axis accumulator traffic.
+_MEASURED_BLOCKS = (
+    ((True, 1_250, 10_000), (256, 1024)),     # vmap8 0.842 ms, 118.8 G pairs/s
+    ((True, 10_000, 10_000), (1024, 1024)),   # 2.032 ms, 49.2 G pairs/s
+    ((True, 12_500, 100_000), (512, 1024)),   # 25.43 ms (≈ tie w/ 1024×1024)
+    ((True, 100_000, 100_000), (1024, 1024)), # 77.30 ms, 129.4 G pairs/s
+    ((False, 1_250, 10_000), (256, 1024)),    # f32 tie; bf16x3 wide-m win
+)
+
+
+def _measured_block(k: int, m: int, small_d: bool):
+    """Tiles of the nearest measured regime (sum of |log| distances on both
+    axes), or ``None`` when the shape sits >4× away from every measured
+    point on average — there the padding heuristic stands alone rather
+    than extrapolating a measurement that never covered the regime."""
+    best = None
+    for (sd, mk, mm), tiles in _MEASURED_BLOCKS:
+        if sd != small_d:
+            continue
+        dist = abs(math.log(k / mk)) + abs(math.log(m / mm))
+        if best is None or dist < best[0]:
+            best = (dist, tiles)
+    if best is None or best[0] > 2 * math.log(4.0):
+        return None
+    return best[1]
 
 
 def _round_up(v: int, mult: int) -> int:

@@ -588,10 +588,23 @@ def make_shard_step_sinkhorn_w2(
     sinkhorn_warm_start: bool = True,
     phi_batch_hint: int = 1,
     update_rule: str = "jacobi",
+    w2_pairing: str = "global",
 ) -> Callable:
     """Per-shard SVGD step with the Wasserstein/JKO term computed **inside
     the step** from carried previous-snapshot state, so whole W2 trajectories
     can run under one ``lax.scan`` (``DistSampler.run_steps``).
+
+    ``w2_pairing='block'`` (exchanged modes, S > 1) swaps the W2 term's
+    reference-warty global pairing for the ``partitions``-style one while φ
+    still interacts with the gathered global set: each shard snapshots only
+    the block it just updated and pairs its block against the snapshot of
+    block ``(b+1) mod S`` (the same ``ppermute`` roll ``partitions`` uses).
+    The carried state drops from a per-shard ``(n, d)`` snapshot — four
+    lane-padded ``(n, 128)``-float buffers deep in the scan, the measured
+    memory cliff past n = 400k (docs/notes.md round-4 table) — to ``(n/S,
+    d)``, and each solve from ``(n/S, n)`` to ``(n/S, n/S)``.
+    ``DistSampler`` auto-routes to this above
+    :data:`~dist_svgd_tpu.distsampler.W2_GLOBAL_PAIRING_MAX_N` particles.
 
     Replicates the reference's exact (warty) snapshot semantics
     (dsvgd/distsampler.py:103-129,186-205; distsampler.py module docstring):
@@ -656,12 +669,17 @@ def make_shard_step_sinkhorn_w2(
         )
     else:
         raise ValueError(f"unknown update_rule {update_rule!r}")
+    if w2_pairing not in ("global", "block"):
+        raise ValueError(f"unknown w2_pairing {w2_pairing!r}")
     # prev_for[b] = previous[(b+1) % S]  (np.roll(prev, -1) device-side)
     roll_perm = [(j, (j - 1) % num_shards) for j in range(num_shards)]
+    # block-sized snapshots + (b+1)-roll: partitions natively, or the
+    # exchanged modes under w2_pairing='block' (docstring)
+    block_pair = (mode == PARTITIONS or w2_pairing == "block") and num_shards > 1
 
     def step(block, prev, g_dual, data, t, key, step_size, h, w_on):
         prev = prev[0]
-        if mode == PARTITIONS and num_shards > 1:
+        if block_pair:
             prev_for = lax.ppermute(prev, AXIS, roll_perm)
         else:
             prev_for = prev
@@ -675,16 +693,17 @@ def make_shard_step_sinkhorn_w2(
         if gs_step is not None:
             # the sweep applies h·w_grad per row itself; the snapshot needs
             # the pre-sweep gather (the sweep's internal gather of the same
-            # pre-update block — XLA CSEs the duplicate collective)
+            # pre-update block — XLA CSEs the duplicate collective).  Block
+            # pairing snapshots only the own block, so no extra gather
             interacting = (
-                None if mode == PARTITIONS
+                None if (mode == PARTITIONS or block_pair)
                 else lax.all_gather(block, AXIS, tiled=True)
             )
             new = gs_step(block, data, w_grad, t, key, step_size, h)
         else:
             delta, interacting = core(block, data, t, key)
             new = block + step_size * (delta + h * w_grad)
-        if mode == PARTITIONS:
+        if mode == PARTITIONS or block_pair:
             new_prev = new
         else:
             r = lax.axis_index(AXIS)

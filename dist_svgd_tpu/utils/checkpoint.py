@@ -109,6 +109,67 @@ def load_state(path: str) -> Dict[str, Any]:
     return dict(restored)
 
 
+def assemble_full_state(paths) -> Dict[str, Any]:
+    """Assemble the per-process block checkpoints of ONE multi-host save
+    into a full-global state dict, enabling **cross-process-count restore**
+    (round-5, VERDICT r04 item 7).
+
+    A multi-host ``DistSampler.state_dict`` holds only each process's
+    contiguous axis-0 block plus its ``<key>_start`` offset
+    (``parallel/multihost.py:host_addressable_block``).  A federation with a
+    *different* process partitioning cannot restore any single file — its
+    row ranges don't match — but the mesh *size* (and therefore every
+    global array's shape) is process-layout-independent, so concatenating
+    every saved block along axis 0 reconstructs the exact global state,
+    which ``load_state_dict`` then re-slices for the new layout (its
+    full-save branch).  Every process of the new federation calls this on
+    the complete list of old per-process paths.
+
+    Raises ``ValueError`` when the blocks are not contiguous from row 0
+    (paths from different saves, or an incomplete list)."""
+    states = [load_state(p) for p in paths]
+    if not states:
+        raise ValueError("assemble_full_state needs at least one checkpoint")
+    out: Dict[str, Any] = {}
+    keys = {k for s in states for k in s if not k.endswith("_start")}
+    for key in keys:
+        parts = [
+            (int(np.asarray(s.get(key + "_start", 0))), s[key])
+            for s in states if s.get(key) is not None
+        ]
+        if not parts:
+            out[key] = None
+            continue
+        if key + "_start" not in states[0]:
+            # a scalar/replicated entry (t): must be identical in every
+            # file — a mismatch means the paths mix two different saves
+            # (the contiguity check below cannot catch that when the row
+            # layouts happen to line up)
+            for s in states[1:]:
+                if not np.array_equal(np.asarray(s[key]),
+                                      np.asarray(states[0][key])):
+                    raise ValueError(
+                        f"checkpoint files disagree on {key!r} "
+                        f"({np.asarray(states[0][key])} vs "
+                        f"{np.asarray(s[key])}) — are these paths from one "
+                        "complete multi-host save?"
+                    )
+            out[key] = states[0][key]
+            continue
+        parts.sort(key=lambda p: p[0])
+        cursor = 0
+        for start, rows in parts:
+            if start != cursor:
+                raise ValueError(
+                    f"checkpoint blocks for {key!r} are not contiguous: "
+                    f"expected a block starting at row {cursor}, got {start} "
+                    "— are these paths from one complete multi-host save?"
+                )
+            cursor += rows.shape[0]
+        out[key] = np.concatenate([rows for _, rows in parts])
+    return out
+
+
 class CheckpointManager:
     """Every-K-steps checkpointing with retention.
 

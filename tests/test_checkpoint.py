@@ -336,3 +336,33 @@ def test_load_state_dict_shape_mismatch(rng):
     s = _make_sampler(parts, (x, t), dict(exchange_particles=True, exchange_scores=False))
     with pytest.raises(ValueError, match="checkpoint particles"):
         s.load_state_dict({"particles": np.zeros((4, d)), "t": 1})
+
+
+def test_assemble_full_state_guards(tmp_path):
+    """assemble_full_state (cross-process-count restore): reconstructs the
+    global state from one complete multi-host save; rejects mixed saves
+    (disagreeing replicated scalars) and non-contiguous block lists."""
+    from dist_svgd_tpu.utils.checkpoint import assemble_full_state, save_state
+
+    def save(name, start, t, fill):
+        save_state(str(tmp_path / name), {
+            "particles": np.full((4, 2), fill, dtype=np.float32),
+            "particles_start": np.int64(start),
+            "t": np.int64(t),
+        })
+        return str(tmp_path / name)
+
+    a, b = save("a", 0, 3, 1.0), save("b", 4, 3, 2.0)
+    st = assemble_full_state([b, a])  # order-independent (sorted by start)
+    assert st["particles"].shape == (8, 2)
+    assert int(st["t"]) == 3
+    np.testing.assert_array_equal(st["particles"][:4], 1.0)
+    np.testing.assert_array_equal(st["particles"][4:], 2.0)
+
+    mixed = save("c", 4, 5, 2.0)  # same layout, later save (t=5)
+    with pytest.raises(ValueError, match="disagree"):
+        assemble_full_state([a, mixed])
+
+    gap = save("e", 8, 3, 2.0)  # rows 4..7 missing
+    with pytest.raises(ValueError, match="contiguous"):
+        assemble_full_state([a, gap])

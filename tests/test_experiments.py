@@ -165,15 +165,32 @@ def test_logreg_driver_gs_sinkhorn_scanned_tracks_lp():
 
 
 def test_logreg_driver_record_chunking_is_semantics_neutral(monkeypatch):
-    """Chunked trajectory recording (RECORD_CHUNK) must reproduce the
+    """Chunked trajectory recording (record_chunk_steps) must reproduce the
     single-dispatch history exactly (ADVICE r1: bound the (niter, n, d)
-    device history buffer)."""
+    device history buffer; round 5: the chunk is HBM-budget-sized and the
+    D2H copy of chunk k overlaps chunk k+1's scan)."""
     logreg, get_results_dir = _import_logreg_driver()
     kw = dict(wasserstein=False, niter=6)
     whole = _driver_run_final(logreg, get_results_dir, "lp", **kw)
-    monkeypatch.setattr(logreg, "RECORD_CHUNK", 4)  # 6 = 4 + 2 → two chunks
+    monkeypatch.setattr(logreg, "record_chunk_steps",
+                        lambda n, d: 4)  # 6 = 4 + 2 → two chunks
     chunked = _driver_run_final(logreg, get_results_dir, "lp", **kw)
     np.testing.assert_array_equal(whole, chunked)
+
+
+def test_record_chunk_steps_sizing():
+    """The HBM-budget sizing accounts for TPU lane padding (a (n, d≤128)
+    snapshot is physically n×128 floats) and clamps to [1, max]."""
+    logreg, _ = _import_logreg_driver()
+    # tiny n: budget allows far more than the cap → clamped to the cap
+    assert logreg.record_chunk_steps(100, 3) == logreg.RECORD_CHUNK_MAX
+    # n=100k, d=3: 100_000 × 128 × 4 B = 51.2 MB/step → 2 GiB holds 41
+    assert logreg.record_chunk_steps(100_000, 3) == 41
+    # d > 128 pads to d, not 128
+    assert (logreg.record_chunk_steps(100_000, 256)
+            == (logreg.RECORD_HBM_BUDGET_BYTES // (100_000 * 256 * 4)))
+    # pathological n never sizes to zero
+    assert logreg.record_chunk_steps(10**9, 3) == 1
 
 
 def test_logreg_convergence_reaches_sklearn_baseline():

@@ -236,6 +236,29 @@ def test_four_process_federation_matches_oracle(tmp_path):
     np.testing.assert_allclose(got_s, want_s, rtol=2e-6, atol=2e-7)
 
 
+def test_cross_process_count_restore(tmp_path):
+    """Cross-process-count restore (round-5, VERDICT r04 item 7): a
+    4-process federation saves mid-trajectory (W2 on — the carried snapshot
+    stack and dual ride along); a 2-process federation then resumes it.
+    The mesh size (8 shards) — and therefore every global array — is
+    process-layout-independent, so ``assemble_full_state`` over all four
+    per-process blocks reconstructs the exact global state and the new
+    layout re-slices it.  Any *single* foreign-layout block must raise the
+    clear mismatch error instead (asserted inside the worker).  The resumed
+    tail must equal the uninterrupted 4-process trajectory bit-for-bit
+    (same program, different partitioning — mesh layout is an execution
+    detail, not semantics)."""
+    _run_federation(tmp_path, 4, 2, "ckpt")           # save at t=3, want at t=5
+    _run_federation(tmp_path, 2, 4, "ckpt_restore")   # resume t=3 → t=5
+
+    n, d = 32, 2
+    want = _assemble(tmp_path, 4, n, d, "ckpt_want_rows_{}.npy",
+                     "ckpt_want_range_{}.npy")
+    got = _assemble(tmp_path, 2, n, d, "cross_rows_{}.npy",
+                    "cross_range_{}.npy")
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
 def test_distsampler_runs_on_multihost_mesh():
     """The full driver recipe: build the granule-major mesh, assemble the global
     particle array from (this process's) local rows, run sharded steps."""

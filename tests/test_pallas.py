@@ -209,3 +209,25 @@ def test_phi_pallas_under_shard_map(rng):
         return np.asarray(ds.run_steps(3, 0.05))
 
     np.testing.assert_allclose(run("pallas"), run("xla"), rtol=2e-5, atol=2e-6)
+
+
+def test_measured_block_table_lookup():
+    """The shape-keyed measured tile defaults (round 5): nearest measured
+    regime in log-shape space; far-from-evidence shapes fall back to the
+    padding heuristic (None)."""
+    from dist_svgd_tpu.ops.pallas_svgd import _measured_block
+
+    # exact ladder points
+    assert _measured_block(1_250, 10_000, True) == (256, 1024)
+    assert _measured_block(100_000, 100_000, True) == (1024, 1024)
+    assert _measured_block(1_250, 10_000, False) == (256, 1024)
+    # nearby shapes snap to the nearest regime (an 11k-lane ~ the 12.5k one)
+    assert _measured_block(11_000, 90_000, True) == (512, 1024)
+    assert _measured_block(9_000, 11_000, True) == (1024, 1024)
+    # far from every measured point: no table hit
+    assert _measured_block(64, 64, True) is None
+    assert _measured_block(64, 64, False) is None
+    # big-d table has one regime; a big-d square at 10k² is within reach of
+    # the (1250, 10k) lane on the m axis but >4x off on k+m combined? k:
+    # log(10000/1250)=2.08, m: 0 -> total 2.08 <= 2*log(4)=2.77 -> snaps
+    assert _measured_block(10_000, 10_000, False) == (256, 1024)

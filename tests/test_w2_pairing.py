@@ -1,0 +1,175 @@
+"""The exchanged-mode W2 pairing option (round-5: the measured memory cliff
+past n=400k gets an auto-route to the partitions-style block pairing, not a
+silent 20× regression — VERDICT r04 item 2).
+
+``w2_pairing='block'`` keeps φ interacting with the gathered global set but
+pairs each shard's W2 solve block-(b+1)-ring style with ``(n/S, d)`` carried
+state.  Pinned here: the exact semantics (oracle), eager ≡ scanned parity,
+the auto-route threshold + warnings, the composition rejections, and
+checkpoint reshard behaviour across pairings."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import dist_svgd_tpu.distsampler as distsampler_mod
+from dist_svgd_tpu import DistSampler
+from dist_svgd_tpu.models.logreg import logreg_logp
+from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
+
+from test_distsampler import make_gaussian_problem
+
+SINK = dict(sinkhorn_eps=0.05, sinkhorn_iters=50)
+
+
+def build(particles, data, S, pairing="auto", exch_p=True, w2=True, **kw):
+    return DistSampler(
+        S, logreg_logp, None, jnp.asarray(particles), data=data,
+        exchange_particles=exch_p, exchange_scores=False,
+        include_wasserstein=w2, wasserstein_solver="sinkhorn",
+        w2_pairing=pairing, **SINK, **kw,
+    )
+
+
+def test_block_pairing_oracle_semantics():
+    """Step 2 under block pairing = the no-W2 twin's step plus
+    ``eps·h·sinkhorn_grad(block_b, snapshot_{(b+1) mod S})`` — the
+    partitions-style pairing computed directly from the ops layer."""
+    rng = np.random.default_rng(7)
+    S = 4
+    particles, data, _ = make_gaussian_problem(rng, n=16, d=2, num_shards=S)
+    eps, h = 0.05, 0.7
+
+    w2s = build(particles, data, S, pairing="block")
+    twin = build(particles, data, S, w2=False)
+
+    # step 1: no previous snapshot yet → W2 inert, trajectories coincide
+    s1 = np.asarray(w2s.make_step(eps, h=h))
+    np.testing.assert_allclose(s1, np.asarray(twin.make_step(eps, h=h)),
+                               rtol=1e-10)
+    # the snapshot is the post-update own-block stack, (S, n/S, d)
+    assert w2s._previous.shape == (S, 16 // S, 2)
+    np.testing.assert_allclose(w2s._previous.reshape(16, 2), s1, rtol=1e-12)
+
+    # step 2: oracle = twin step + eps·h·blockwise ring-rolled solve
+    n_loc = 16 // S
+    cur = s1.reshape(S, n_loc, 2)
+    w_grad = np.stack([
+        np.asarray(wasserstein_grad_sinkhorn(
+            jnp.asarray(cur[b]), jnp.asarray(cur[(b + 1) % S]),
+            eps=SINK["sinkhorn_eps"], iters=SINK["sinkhorn_iters"],
+            tol=1e-2, g_init=jnp.zeros(n_loc),
+        ))
+        for b in range(S)
+    ])
+    want = np.asarray(twin.make_step(eps, h=h)) + eps * h * w_grad.reshape(16, 2)
+    got = np.asarray(w2s.make_step(eps, h=h))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-10)
+
+
+def test_block_pairing_scanned_matches_eager():
+    """run_steps (carried snapshots + duals on device) ≡ make_step under
+    block pairing, including the step-1 W2 gate and cross-driver mixing."""
+    rng = np.random.default_rng(31)
+    S = 2
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, n_rows=8,
+                                               num_shards=S)
+    eager = build(particles, data, S, pairing="block")
+    for _ in range(4):
+        want = eager.make_step(0.05, h=0.5)
+    scanned = build(particles, data, S, pairing="block")
+    got = scanned.run_steps(4, 0.05, h=0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-6)
+    np.testing.assert_allclose(np.asarray(scanned._previous),
+                               np.asarray(eager._previous), rtol=2e-6)
+    np.testing.assert_allclose(
+        np.asarray(scanned.run_steps(2, 0.05, h=0.5)),
+        np.asarray([eager.make_step(0.05, h=0.5) for _ in range(2)][-1]),
+        rtol=2e-6,
+    )
+
+
+def test_auto_routes_above_threshold(monkeypatch):
+    rng = np.random.default_rng(3)
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, num_shards=2)
+    monkeypatch.setattr(distsampler_mod, "W2_GLOBAL_PAIRING_MAX_N", 4)
+    with pytest.warns(UserWarning, match="routing the Wasserstein term"):
+        ds = DistSampler(
+            2, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=True, exchange_scores=False,
+            include_wasserstein=True, wasserstein_solver="sinkhorn", **SINK,
+        )
+    assert ds._w2_pairing == "block"
+    assert ds._prev_shape() == (2, 4, 2)
+    # forcing the reference pairing still works, with the cliff warning
+    with pytest.warns(UserWarning, match="HBM cliff"):
+        forced = DistSampler(
+            2, logreg_logp, None, jnp.asarray(particles), data=data,
+            exchange_particles=True, exchange_scores=False,
+            include_wasserstein=True, wasserstein_solver="sinkhorn",
+            w2_pairing="global", **SINK,
+        )
+    assert forced._w2_pairing == "global"
+    assert forced._prev_shape() == (2, 8, 2)
+
+
+def test_auto_stays_global_below_threshold():
+    rng = np.random.default_rng(3)
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, num_shards=2)
+    ds = build(particles, data, 2, pairing="auto")
+    assert ds._w2_pairing == "global"
+    assert ds._prev_shape() == (2, 8, 2)
+    # without the W2 term the option is inert — no warning at any n
+    off = build(particles, data, 2, pairing="auto", w2=False)
+    assert off._prev_shape() == (2, 8, 2)
+
+
+def test_partitions_rejects_global_pairing():
+    rng = np.random.default_rng(3)
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, num_shards=2)
+    with pytest.raises(ValueError, match="partitions"):
+        build(particles, data, 2, pairing="global", exch_p=False)
+    # block/auto are its native pairing — accepted
+    ds = build(particles, data, 2, pairing="block", exch_p=False)
+    assert ds._block_w2
+
+
+def test_unknown_pairing_rejected():
+    rng = np.random.default_rng(3)
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, num_shards=2)
+    with pytest.raises(ValueError, match="w2_pairing"):
+        build(particles, data, 2, pairing="rowwise")
+
+
+def test_checkpoint_reshard_across_pairings():
+    """Global-pairing saves restore into block-pairing samplers (post blocks
+    are recoverable); the reverse needs pre-update rows the block save never
+    recorded and must raise."""
+    rng = np.random.default_rng(5)
+    S = 2
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, num_shards=S)
+
+    glob = build(particles, data, S, pairing="global")
+    for _ in range(2):
+        glob.make_step(0.05, h=0.5)
+    state = glob.state_dict()
+
+    blk = build(particles, data, S, pairing="block")
+    blk.load_state_dict(state)
+    assert np.asarray(blk._previous).shape == (S, 4, 2)
+    # the rebuilt stack is the post-update global, re-blocked
+    np.testing.assert_allclose(
+        np.asarray(blk._previous).reshape(8, 2),
+        np.asarray(glob._previous)[np.arange(S).repeat(4),
+                                   np.arange(8)],  # own rows = post rows
+        rtol=1e-12,
+    )
+    # dual dropped on reshard → first resumed solve cold-starts
+    assert blk._w2_g is None
+
+    blk2 = build(particles, data, S, pairing="block")
+    for _ in range(2):
+        blk2.make_step(0.05, h=0.5)
+    glob2 = build(particles, data, S, pairing="global")
+    with pytest.raises(ValueError, match="pre-update rows"):
+        glob2.load_state_dict(blk2.state_dict())

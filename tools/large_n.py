@@ -52,6 +52,14 @@ def main():
                          "pairs blocks against block snapshots ((n/8, n/8) "
                          "solves, block-sized state — the reference's own "
                          "per-rank W2 pairing), viable at n = 1M+")
+    ap.add_argument("--w2-pairing", default="auto",
+                    choices=["auto", "global", "block"],
+                    help="exchanged-mode W2 pairing (DistSampler.w2_pairing)."
+                         "  'auto' routes to the block pairing above the "
+                         "measured 400k global-pairing ceiling with a "
+                         "warning; 'global' forces the reference pairing "
+                         "onto the cliff (the A/B for the scaling table); "
+                         "'block' forces the scalable pairing at any n")
     ap.add_argument("--stepsize", type=float, default=3e-3)
     ap.add_argument("--sinkhorn-iters", type=int, default=200,
                     help="per-step solve iteration cap.  At n = 1M a COLD "
@@ -81,6 +89,7 @@ def main():
             exchange_scores=False,
             include_wasserstein=True, wasserstein_solver="sinkhorn",
             sinkhorn_iters=args.sinkhorn_iters,
+            w2_pairing=args.w2_pairing,
         )
         # warm up with SINGLE-step dispatches: the very first steps solve
         # cold (w_on=0 placeholder, then a full cold solve) and at n = 1M a

@@ -256,6 +256,59 @@ def big_d(iters):
               f"{np.abs(got - want).max()/scale:.2e}", flush=True)
 
 
+def harvest():
+    """Per-regime block-size sweep over the shape ladder the framework
+    actually runs (round-5, VERDICT r04 item 8): 8-shard lane shapes
+    (n/8, n) at n = 10k and 100k, the unsharded 10k and 100k squares, and
+    the big-d covertype lane.  Prints the per-shape winner table to encode
+    into ``ops/pallas_svgd.py:_MEASURED_BLOCKS`` (which ``phi_pallas``
+    consults before the padding heuristic), interleaved-timed per shape so
+    pool drift cannot crown the wrong tile."""
+    rng = np.random.default_rng(0)
+    shapes = [
+        (1_250, 10_000, 3),     # 8-shard lane, north star
+        (10_000, 10_000, 3),    # unsharded 10k square
+        (12_500, 100_000, 3),   # 8-shard lane at n=100k
+        (100_000, 100_000, 3),  # unsharded 100k square
+        (1_250, 10_000, 55),    # big-d covertype lane
+    ]
+    eps = jnp.float32(1e-6)
+    winners = {}
+    for k, m, d in shapes:
+        y = jnp.asarray(rng.normal(size=(k, d)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(m, d)), jnp.float32)
+        s = jnp.asarray(rng.normal(size=(m, d)), jnp.float32)
+        h = 1.0 if d <= 8 else float(2 * d)  # big-d: median-scale bandwidth
+        # size the chain so one timed scan is ~0.5-2 s of φ work
+        iters = int(max(3, min(50, 6e9 / (k * m))))
+        named = []
+        for bk in (256, 512, 1024):
+            for bm in (256, 512, 1024):
+                def fn(c, bk=bk, bm=bm):
+                    return c + eps * phi_pallas(c, x, s, bandwidth=h,
+                                                block_k=bk, block_m=bm)
+                try:  # probe-compile: VMEM-overflow combos drop out here
+                    np.asarray(jax.jit(fn)(y)).ravel()[0]
+                except Exception as e:
+                    print(f"  ({k},{m},{d}) {bk}x{bm}: FAILED "
+                          f"{type(e).__name__}", flush=True)
+                    continue
+                named.append((f"{bk}x{bm}", fn))
+        best = timed_group(named, y, iters)
+        for name in sorted(best, key=best.get):
+            t = best[name]
+            print(f"  ({k},{m},{d}) {name:9s} {t*1e3:8.3f} ms "
+                  f"({k*m/t/1e9:6.1f} G pairs/s)", flush=True)
+        win = min(best, key=best.get)
+        winners[(k, m, d)] = (win, best[win])
+        print(f"shape ({k},{m},{d}): best {win}", flush=True)
+    print("\n== table for ops/pallas_svgd.py:_MEASURED_BLOCKS ==")
+    for (k, m, d), (win, t) in winners.items():
+        bk, bm = (int(v) for v in win.split("x"))
+        print(f"    ({d <= 8}, {k}, {m}): ({bk}, {bm}),"
+              f"  # {t*1e3:.3f} ms measured")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=50)
@@ -263,8 +316,14 @@ def main():
     ap.add_argument("--big-d", action="store_true",
                     help="measure the big-d (covertype-shape) kernel instead "
                          "of the small-d north star")
+    ap.add_argument("--harvest", action="store_true",
+                    help="sweep the per-regime shape ladder and print the "
+                         "_MEASURED_BLOCKS table (module docstring)")
     args = ap.parse_args()
 
+    if args.harvest:
+        harvest()
+        return
     if args.big_d:
         big_d(args.iters)
         return
