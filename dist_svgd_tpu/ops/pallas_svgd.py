@@ -411,10 +411,16 @@ def phi_pallas(
 #:   combos within 8%, dispatch-bound), but batched, 256×1024 wins by 31%
 #:   (0.842 ms/sweep, 118.8 G pairs/s vs 1.101 for 512×1024): per-lane
 #:   dead work from tile padding multiplies by the lane count;
-#: - the big-d lane's f32 sweep puts 256×{256,512,1024} within 2%; the
-#:   wide default is kept because round-2's bf16x3 sweep (the tier that
-#:   regime actually runs) measured wide-m decisively better (1.93 ms at
-#:   256×1024 vs 2.80 at 256²);
+#: - the big-d lane keeps 256×1024 on STEP-LEVEL evidence, and is the
+#:   cautionary tale for this table: a bare-φ vmap(8) sweep measured
+#:   128×1024 16.5% faster (bf16x3; 13.9% f32), but an interleaved A/B of
+#:   the full covertype *step* (minibatched scores + gather + update
+#:   around the same φ shape) measured the 128 tile 22% SLOWER — kernel
+#:   microbenchmarks don't transfer when the kernel shares the program
+#:   with other VMEM/HBM tenants.  Tiles here are promoted only on
+#:   step-level interleaved wins (round-2's bf16x3 256×1024-vs-256² win
+#:   was step-level; the round-5 small-d entries were re-checked by the
+#:   north-star gate at 0.999× incumbent);
 #: - the large squares have the only strong k-axis signal: at (100k, 100k)
 #:   1024×1024 reaches 129.4 G pairs/s vs 76.6 for 256² — tall AND wide
 #:   tiles pay off once k amortises the m-axis accumulator traffic.
@@ -423,7 +429,9 @@ _MEASURED_BLOCKS = (
     ((True, 10_000, 10_000), (1024, 1024)),   # 2.032 ms, 49.2 G pairs/s
     ((True, 12_500, 100_000), (512, 1024)),   # 25.43 ms (≈ tie w/ 1024×1024)
     ((True, 100_000, 100_000), (1024, 1024)), # 77.30 ms, 129.4 G pairs/s
-    ((False, 1_250, 10_000), (256, 1024)),    # f32 tie; bf16x3 wide-m win
+    ((False, 1_250, 10_000), (256, 1024)),    # step-level winner (comment
+                                              # above; bare-φ sweeps mislead
+                                              # in this regime)
 )
 
 

@@ -193,13 +193,24 @@ def test_record_chunk_steps_sizing():
     assert logreg.record_chunk_steps(10**9, 3) == 1
 
 
-def test_logreg_convergence_reaches_sklearn_baseline():
+@pytest.mark.parametrize("sampler_kwargs,h", [
+    pytest.param({"include_wasserstein": False}, 1.0, id="north_star"),
+    # the large-n auto-route target (exchanged φ + block W2 pairing, round
+    # 5): the pairing swap is a memory-layout decision, not an accuracy
+    # trade (throughput/fidelity evidence in docs/notes.md; this is the
+    # convergence side).  h=10 is the reference driver's W2 weight
+    pytest.param({"include_wasserstein": True,
+                  "wasserstein_solver": "sinkhorn", "sinkhorn_iters": 50,
+                  "w2_pairing": "block"}, 10.0,
+                 id="block_w2", marks=pytest.mark.slow),
+])
+def test_logreg_convergence_reaches_sklearn_baseline(sampler_kwargs, h):
     """SURVEY.md §4's quantitative acceptance test (the convergence half of
     the primary metric, reference experiments/logreg_plots.py:37-57): the
     sharded sampler's ensemble posterior-predictive accuracy reaches the
     sklearn LogisticRegression baseline − 0.01 within a fixed step budget —
-    the same target ``bench.py`` measures steps-to at the 10k-particle scale."""
-    import jax
+    the same target ``bench.py`` measures steps-to at the 10k-particle
+    scale."""
     import jax.numpy as jnp
 
     import dist_svgd_tpu as dt
@@ -218,10 +229,9 @@ def test_logreg_convergence_reaches_sklearn_baseline():
     sampler = dt.DistSampler(
         4, logreg_logp, None, init_particles_per_shard(0, 256, d, 4),
         data=(jnp.asarray(fold.x_train), jnp.asarray(fold.t_train.reshape(-1))),
-        exchange_particles=True, exchange_scores=False,
-        include_wasserstein=False,
+        exchange_particles=True, exchange_scores=False, **sampler_kwargs,
     )
-    sampler.run_steps(200, 0.1)
+    sampler.run_steps(200, 0.1, h=h)
     acc = float(ensemble_test_accuracy(
         sampler.particles, jnp.asarray(fold.x_test),
         jnp.asarray(fold.t_test.reshape(-1)),
