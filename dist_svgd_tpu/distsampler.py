@@ -828,20 +828,36 @@ class DistSampler:
         With the Wasserstein/JKO term enabled the ``previous`` snapshots ride
         the scan carry on device (``parallel/exchange.py:
         make_shard_step_sinkhorn_w2`` — same warty snapshot semantics as the
-        eager path); this requires ``wasserstein_solver='sinkhorn'`` and the
-        gather exchange implementation.  The host-LP solver stays
-        :meth:`make_step`-only.  ``h`` is the W2 weight (reference
+        eager path); this requires ``wasserstein_solver='sinkhorn'``, and
+        the *global* W2 pairing additionally requires the gather exchange
+        implementation (its snapshot is the gathered set).  Under
+        ``w2_pairing='block'`` the ring implementation composes — the fully
+        O(n/S)-memory exchanged W2 step (round 5).  The host-LP solver
+        stays :meth:`make_step`-only.  ``h`` is the W2 weight (reference
         ``delta += h·w_grad``); it is inert when the term is disabled.
         """
         if self._include_wasserstein:
-            # ring is a no-op in partitions mode (constructor docstring), so
-            # only the all_* modes genuinely need the gather implementation
-            needs_gather = self._mode != PARTITIONS and self._exchange_impl != "gather"
+            # ring is a no-op in partitions mode (constructor docstring);
+            # in the all_* modes it composes with the BLOCK W2 pairing
+            # (round 5: block-sized snapshots need no gathered set — the
+            # fully O(n/S)-memory exchanged W2 step) but not with the
+            # global pairing, whose snapshot IS the gathered set
+            needs_gather = (
+                self._mode != PARTITIONS
+                and self._exchange_impl != "gather"
+                and self._w2_pairing != "block"
+                # S=1: every pairing degenerates to the same whole-array
+                # snapshot, which the ring step builds without a gather
+                and self._num_shards > 1
+            )
             if self._wasserstein_solver != "sinkhorn" or needs_gather:
                 raise ValueError(
                     "run_steps with the Wasserstein term requires "
-                    "wasserstein_solver='sinkhorn' and exchange_impl='gather' "
-                    "(the host-LP snapshot path is make_step-only)"
+                    "wasserstein_solver='sinkhorn', and the global W2 "
+                    "pairing requires exchange_impl='gather' (its snapshot "
+                    "is the gathered set; pass w2_pairing='block' to "
+                    "compose with the ring implementation).  The host-LP "
+                    "snapshot path is make_step-only"
                 )
             return self._run_steps_w2(num_steps, step_size, h, record)
         lagged = self._exchange_every > 1
@@ -924,6 +940,8 @@ class DistSampler:
                 phi_batch_hint=self._phi_batch_hint,
                 update_rule=self._update_rule,
                 w2_pairing=self._w2_pairing,
+                ring=(self._exchange_impl == "ring"
+                      and self._mode != PARTITIONS),
             )
             self._bound_w2_step = bind_shard_fn(
                 step,

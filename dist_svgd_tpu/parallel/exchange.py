@@ -589,6 +589,7 @@ def make_shard_step_sinkhorn_w2(
     phi_batch_hint: int = 1,
     update_rule: str = "jacobi",
     w2_pairing: str = "global",
+    ring: bool = False,
 ) -> Callable:
     """Per-shard SVGD step with the Wasserstein/JKO term computed **inside
     the step** from carried previous-snapshot state, so whole W2 trajectories
@@ -617,8 +618,12 @@ def make_shard_step_sinkhorn_w2(
       ``(b+1) mod S`` (a ``lax.ppermute`` of the carried snapshots — the
       device-side form of the host path's ``np.roll(previous, -1)``).
 
-    Gather implementation only: the exchanged-mode snapshot *is* the gathered
-    set, which the ring implementation exists to avoid materialising.
+    Exchange implementation: the *global* pairing is gather-only — its
+    snapshot IS the gathered set, which the ring implementation exists to
+    avoid materialising.  Under ``w2_pairing='block'`` the snapshot is the
+    own block, so ``ring=True`` composes (round 5): blockwise ppermute φ
+    accumulation + block-sized W2 state — the fully O(n/S)-memory exchanged
+    W2 step (Jacobi only, like every ring path).
 
     Returns ``step(block, prev, g_dual, data, t, key, step_size, h, w_on) ->
     (new_block, new_prev, new_g)`` where ``prev``/``new_prev`` and
@@ -665,7 +670,7 @@ def make_shard_step_sinkhorn_w2(
         gs_step = None
         core = _build_core(
             logp, kernel, mode, num_shards, n_local_data, score_scale,
-            False, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint,
+            ring, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint,
         )
     else:
         raise ValueError(f"unknown update_rule {update_rule!r}")
@@ -676,6 +681,15 @@ def make_shard_step_sinkhorn_w2(
     # block-sized snapshots + (b+1)-roll: partitions natively, or the
     # exchanged modes under w2_pairing='block' (docstring)
     block_pair = (mode == PARTITIONS or w2_pairing == "block") and num_shards > 1
+    if ring and mode != PARTITIONS and not block_pair and num_shards > 1:
+        # S == 1 is exempt: every pairing degenerates to the same thing
+        # there (the snapshot is the whole post-update array), handled by
+        # the interacting-is-None branch in the step
+        raise ValueError(
+            "the scanned W2 step under exchange_impl='ring' requires the "
+            "block pairing (w2_pairing='block'): the global pairing's "
+            "snapshot is the gathered set the ring exists to avoid"
+        )
 
     def step(block, prev, g_dual, data, t, key, step_size, h, w_on):
         prev = prev[0]
@@ -703,7 +717,9 @@ def make_shard_step_sinkhorn_w2(
         else:
             delta, interacting = core(block, data, t, key)
             new = block + step_size * (delta + h * w_grad)
-        if mode == PARTITIONS or block_pair:
+        if mode == PARTITIONS or block_pair or interacting is None:
+            # block-sized snapshot, or the S=1 ring degenerate case where
+            # the "global" snapshot is exactly the whole post-update array
             new_prev = new
         else:
             r = lax.axis_index(AXIS)

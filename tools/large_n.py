@@ -52,6 +52,12 @@ def main():
                          "pairs blocks against block snapshots ((n/8, n/8) "
                          "solves, block-sized state — the reference's own "
                          "per-rank W2 pairing), viable at n = 1M+")
+    ap.add_argument("--exchange-impl", default="gather",
+                    choices=["gather", "ring"],
+                    help="all_* exchange implementation for --w2.  'ring' "
+                         "composes with the block W2 pairing only: blockwise "
+                         "ppermute φ + block-sized W2 state — no gathered "
+                         "(n, d) set at all, the fully O(n/S)-memory step")
     ap.add_argument("--w2-pairing", default="auto",
                     choices=["auto", "global", "block"],
                     help="exchanged-mode W2 pairing (DistSampler.w2_pairing)."
@@ -90,6 +96,7 @@ def main():
             include_wasserstein=True, wasserstein_solver="sinkhorn",
             sinkhorn_iters=args.sinkhorn_iters,
             w2_pairing=args.w2_pairing,
+            exchange_impl=args.exchange_impl,
         )
         # warm up with SINGLE-step dispatches: the very first steps solve
         # cold (w_on=0 placeholder, then a full cold solve) and at n = 1M a
@@ -108,7 +115,8 @@ def main():
             np.asarray(ds.run_steps(args.steps, args.stepsize, h=10.0))[0, 0]
             best = min(best, (time.perf_counter() - t0) / args.steps)
         print(
-            f"n={n} W2 streaming warm ({args.exchange}, S={S}, stepsize "
+            f"n={n} W2 streaming warm ({args.exchange}/{args.exchange_impl}, "
+            f"pairing {ds._w2_pairing}, S={S}, stepsize "
             f"{args.stepsize}): {best*1e3:.0f} ms/step "
             f"({n/best/1e3:.0f}k updates/s)",
             flush=True,
