@@ -87,6 +87,16 @@ def main():
         from dist_svgd_tpu.utils.rng import init_particles_per_shard
 
         S = 8
+        if (args.exchange_impl == "ring" and args.exchange != "partitions"
+                and args.w2_pairing == "auto" and args.n <= 400_000):
+            # 'auto' resolves to the global pairing below the route
+            # threshold, which the ring implementation rejects (its
+            # snapshot is the gathered set) — the only pairing ring can
+            # measure is 'block', so select it rather than erroring after
+            # construction
+            print("exchange-impl=ring: resolving --w2-pairing auto -> "
+                  "block (the only ring-compatible pairing)", flush=True)
+            args.w2_pairing = "block"
         ds = dt.DistSampler(
             S, logreg_logp, None, init_particles_per_shard(0, n, d, S),
             data=(jnp.asarray(fold.x_train),
