@@ -220,6 +220,14 @@ class Sampler:
         ``(num_iter + 1, n, d)`` device array (pre-update snapshots plus the
         final state) or ``None`` when ``record=False``.  ``dtype`` defaults to
         the dtype of ``initial_particles`` when given, else float32.
+
+        Memory note: with ``record=True`` the whole ``(num_iter, n, d)``
+        history stack lives in HBM for the duration of the call, and TPU
+        lane padding makes each snapshot physically ``n × max(d, 128)``
+        floats.  At large ``n`` drive recorded trajectories in budget-sized
+        chunks via repeated calls with ``initial_particles`` (the pattern
+        ``experiments/logreg.py:record_chunk_steps`` implements for the
+        distributed driver) instead of one long recorded call.
         """
         if initial_particles is not None:
             particles = jnp.asarray(initial_particles, dtype=dtype)
