@@ -227,7 +227,13 @@ class Sampler:
         floats.  At large ``n`` drive recorded trajectories in budget-sized
         chunks via repeated calls with ``initial_particles`` (the pattern
         ``experiments/logreg.py:record_chunk_steps`` implements for the
-        distributed driver) instead of one long recorded call.
+        distributed driver) instead of one long recorded call.  Two chunking
+        caveats: with ``batch_size`` set, vary ``seed`` per chunk (e.g.
+        ``seed=steps_done``) — a fixed seed replays the same minibatch-key
+        stream every chunk instead of a stochastic trajectory — and drop
+        each chunk's trailing history row before concatenating (it is the
+        chunk's final state, which reappears as the next chunk's first
+        pre-update snapshot).
         """
         if initial_particles is not None:
             particles = jnp.asarray(initial_particles, dtype=dtype)

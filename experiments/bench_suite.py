@@ -69,12 +69,13 @@ def _time_sampler_run(sampler, n, iters, step_size, initial_particles=None):
     return _timed_chain(run_one)
 
 
-def _time_dist_steps(sampler, iters, step_size):
+def _time_dist_steps(sampler, iters, step_size, **run_kwargs):
     """Time the scanned K-step path (one dispatch — how the framework is
     meant to be driven for throughput; ``DistSampler.run_steps``), bench.py
-    timing protocol (``run_steps`` is stateful, so reps chain naturally)."""
-    _fence(sampler.run_steps(iters, step_size))  # compile, untimed
-    return _timed_chain(lambda: sampler.run_steps(iters, step_size))
+    timing protocol (``run_steps`` is stateful, so reps chain naturally).
+    ``run_kwargs`` pass through to ``run_steps`` (e.g. the W2 weight ``h``)."""
+    _fence(sampler.run_steps(iters, step_size, **run_kwargs))  # compile, untimed
+    return _timed_chain(lambda: sampler.run_steps(iters, step_size, **run_kwargs))
 
 
 def _result(config, n, iters, wall, **extra):
@@ -314,9 +315,11 @@ def bench_bnn(iters, n_particles=500, dataset="boston", batch_size=100,
 # World-size scaling table (the reference table's shape, notes.md:128-132)
 
 
-def scaling_table_10k(iters, world_sizes=(1, 2, 4, 8), n_particles=10_000):
+def scaling_table_10k(iters, world_sizes=(1, 2, 4, 8), n_particles=10_000,
+                      wasserstein=False):
     """Compute-bound scaling curve: banana logreg at 10k particles in
-    ``partitions`` mode, world sizes 1/2/4/8.
+    ``partitions`` mode, world sizes 1/2/4/8 (``wasserstein=True`` adds the
+    scanned Sinkhorn W2 term at h=10 — :func:`scaling_table_w2`).
 
     This is the config where shards genuinely help even on one chip: the
     ``partitions`` interaction set is the owned block (n/S particles), so the
@@ -342,14 +345,33 @@ def scaling_table_10k(iters, world_sizes=(1, 2, 4, 8), n_particles=10_000):
         sampler = dt.DistSampler(
             ws, logreg_logp, None, particles, data=data,
             exchange_particles=False, exchange_scores=False,
-            include_wasserstein=False,
+            include_wasserstein=wasserstein, wasserstein_solver="sinkhorn",
         )
-        wall = _time_dist_steps(sampler, iters, 3e-3)
+        wall = _time_dist_steps(sampler, iters, 3e-3,
+                                h=10.0 if wasserstein else 1.0)
+        label = "scaling10k-w2" if wasserstein else "scaling10k"
         rows.append(_result(
-            f"scaling10k:ws{ws}", sampler.num_particles, iters, wall,
+            f"{label}:ws{ws}", sampler.num_particles, iters, wall,
             num_shards=ws, emulated=_emulated(ws), exchange="partitions",
+            **({"wasserstein": True} if wasserstein else {}),
         ))
     return rows
+
+
+def scaling_table_w2(iters, world_sizes=(1, 2, 4, 8), n_particles=10_000):
+    """World-size scaling of the **Wasserstein step itself** (round 5):
+    the 10k-particle ``partitions`` table with the scanned Sinkhorn W2
+    term on (h=10, the reference driver's weight).
+
+    Under the block-(b+1) pairing both the φ interaction set AND each W2
+    solve are block-sized, so per-step work is n²/S for *both* terms —
+    the whole step scales with S even on one chip under vmap emulation,
+    unlike the work-conserving ``all_*`` φ.  This is the single-chip
+    demonstration of the mechanism that lets the 1M-particle W2 rows ride
+    S chips: per-device work (and memory) set by n/S, not n.  Measured
+    21.19/4.57/2.75/1.98 ms/step at ws 1/2/4/8 (docs/notes.md round-5)."""
+    return scaling_table_10k(iters, world_sizes, n_particles,
+                             wasserstein=True)
 
 
 def scaling_table(iters, world_sizes=(1, 2, 4, 8), n_particles=50):
@@ -450,6 +472,9 @@ _CONFIGS = {
 @click.option("--scaling-10k/--no-scaling-10k", default=False,
               help="also run the compute-bound 10k-particle partitions-mode "
                    "scaling table (docs/notes.md)")
+@click.option("--scaling-w2/--no-scaling-w2", default=False,
+              help="also run the 10k-particle partitions+W2 scaling table "
+                   "(the W2 step's own n²/S mechanism; docs/notes.md)")
 @click.option("--table", is_flag=True, help="print markdown tables at the end")
 @click.option("--backend", default="auto",
               type=click.Choice(["auto", "tpu", "cpu"]))
@@ -458,8 +483,8 @@ _CONFIGS = {
               help="sklearn-baseline acceptance (target + steps-to-target) "
                    "for configs 4/5; 'auto' runs it on TPU only (the CPU "
                    "fallback is a smoke run, not an acceptance run)")
-def cli(configs, iters, scaling, scaling_iters, scaling_10k, table, backend,
-        acceptance):
+def cli(configs, iters, scaling, scaling_iters, scaling_10k, scaling_w2,
+        table, backend, acceptance):
     select_backend(backend)
     acc_on = acceptance == "on" or (
         acceptance == "auto" and _platform() == "tpu"
@@ -481,6 +506,9 @@ def cli(configs, iters, scaling, scaling_iters, scaling_10k, table, backend,
             print(json.dumps(r), flush=True)
     if scaling_10k:
         for r in scaling_table_10k(iters):
+            print(json.dumps(r), flush=True)
+    if scaling_w2:
+        for r in scaling_table_w2(iters):
             print(json.dumps(r), flush=True)
     if table:
         print()
