@@ -63,6 +63,30 @@ from dist_svgd_tpu.utils.rng import minibatch_key
 #: ``(n/S, d)`` per shard and scales to n = 1M+ on one chip.
 W2_GLOBAL_PAIRING_MAX_N = 400_000
 
+#: Default pairwise-interaction throughput estimate feeding the
+#: ``dispatch_budget`` auto-chunking heuristic (:meth:`DistSampler.
+#: run_steps`): the measured single-chip φ rate at the 1M-particle row
+#: (1e12 pairs / 4.21 s — docs/notes.md large-n table, one v5e).  Pass
+#: ``pairs_per_sec`` explicitly for other hardware; the budget maths is a
+#: planning estimate, not a guarantee.
+DISPATCH_PAIRS_PER_SEC = 2.4e11
+
+#: ``state_dict`` encoding of the resolved ``w2_pairing`` (orbax/
+#: tensorstore cannot serialise unicode arrays, so the checkpoint stores an
+#: index into this tuple).
+W2_PAIRING_CODES = ("global", "block")
+
+
+def _chunk_sizes(total: int, per: int):
+    """Split ``total`` units into full chunks of ``per`` plus a remainder —
+    the dispatch-chain schedule for hop and scan chunking (at most two
+    distinct sizes, so at most two compiled programs per chunk kind)."""
+    per = max(1, min(int(per), total))
+    sizes = [per] * (total // per)
+    if total % per:
+        sizes.append(total % per)
+    return sizes
+
 
 def _data_rows(data) -> int:
     leaves = jax.tree_util.tree_leaves(data)
@@ -190,9 +214,14 @@ class DistSampler:
             S``) with φ still interacting globally — ``(n/S, d)`` state,
             ``(n/S, n/S)`` solves, scales to n = 1M+.  ``'auto'`` (default)
             picks ``'global'`` up to the threshold and routes to ``'block'``
-            above it with a logged warning.  Ignored when the W2 term is off;
-            in ``partitions`` mode the pairing is inherently block-level
-            (``'global'`` raises there).
+            above it with a logged warning.  Ignored when the W2 term is off
+            (any value is accepted unused); with the term on, ``partitions``
+            mode's pairing is inherently block-level (``'global'`` raises
+            there).  The *resolved* pairing is recorded in
+            :meth:`state_dict` and exposed as :attr:`w2_pairing`, so runs
+            straddling the auto-route boundary stay distinguishable after
+            the fact; pin the value explicitly for reproducible
+            experiments.
         seed: root PRNG seed for the per-step minibatch streams.
     """
 
@@ -335,7 +364,15 @@ class DistSampler:
         # 20× regression)
         if w2_pairing not in ("auto", "global", "block"):
             raise ValueError(f"unknown w2_pairing {w2_pairing!r}")
-        if self._mode == PARTITIONS:
+        if not include_wasserstein:
+            # fully inert without the W2 term (docstring): any valid value —
+            # including 'global' in partitions mode — is accepted and
+            # unused, so generic config code can pass the same kwargs with
+            # W2 off (ADVICE round 5)
+            self._w2_pairing = (
+                "block" if self._mode == PARTITIONS else "global"
+            )
+        elif self._mode == PARTITIONS:
             if w2_pairing == "global":
                 raise ValueError(
                     "w2_pairing='global' is undefined in partitions mode — "
@@ -343,8 +380,6 @@ class DistSampler:
                     "ring roll, module docstring)"
                 )
             self._w2_pairing = "block"
-        elif not include_wasserstein:
-            self._w2_pairing = "global"  # inert without the W2 term
         elif w2_pairing == "auto":
             if (self._num_particles > W2_GLOBAL_PAIRING_MAX_N
                     and self._num_shards > 1):
@@ -379,6 +414,21 @@ class DistSampler:
         )
 
         self._mesh = make_mesh(self._num_shards) if mesh == "auto" else mesh
+        if (isinstance(self._kernel, AdaptiveRBF)
+                and exchange_impl == "ring"
+                and self._mode != PARTITIONS
+                and self._mesh is not None):
+            from dist_svgd_tpu.parallel.mesh import SHARD_MAP_LEGACY
+
+            if SHARD_MAP_LEGACY:
+                raise ValueError(
+                    "kernel='median_step' with exchange_impl='ring' on a "
+                    "shard_map mesh crashes this jax version's XLA sharding "
+                    "propagation (SIGABRT in TileAssignment::Reshape — the "
+                    "ring median bandwidth is a collective-derived scalar "
+                    "feeding a ppermute loop); use mesh=None (the exact vmap "
+                    "emulation), exchange_impl='gather', or kernel='median'"
+                )
         # Under vmap emulation all S lanes run as ONE batched kernel, so the
         # phi 'auto' thresholds should see S x the per-lane pair count; on a
         # real mesh each device runs a single lane (resolve_phi_fn docstring)
@@ -420,6 +470,17 @@ class DistSampler:
             self._bound_lagged = self._bind_lagged(record=False)
         self._scan_cache = {}
         self._bound_w2_step = None  # lazily built by _run_steps_w2
+        # Chunked-executor caches (run_steps(dispatch_budget=...)): the
+        # per-shard hop-chunk builders and their bound/jitted programs,
+        # keyed by (kind, num_hops, rotate_last) — at most a handful of
+        # distinct programs per sampler (_chunk_sizes yields ≤ 2 sizes).
+        self._chunk_builders = None
+        self._chunk_cache = {}
+        #: Execution report of the most recent :meth:`run_steps` call —
+        #: ``execution`` mode, ``num_dispatches``, ``dispatches_per_step``,
+        #: the resolved chunking knobs, ``max_dispatch_wall_s`` (when timed),
+        #: and the resolved ``w2_pairing``.  Bench harnesses record it.
+        self.last_run_stats = None
         self._batch_key = minibatch_key(seed)
 
         # Wasserstein "previous particles" state.  In exchanged modes this is
@@ -480,6 +541,15 @@ class DistSampler:
     @property
     def mode(self) -> str:
         return self._mode
+
+    @property
+    def w2_pairing(self) -> str:
+        """The **resolved** Wasserstein pairing (``'global'`` or ``'block'``)
+        after ``'auto'`` routing — record this alongside experiment configs:
+        two runs straddling the :data:`W2_GLOBAL_PAIRING_MAX_N` auto-switch
+        boundary optimise different W2 functionals.  Also written into
+        :meth:`state_dict` and the bench/large-n JSON records."""
+        return self._w2_pairing
 
     def owned_block_index(self, rank: int, t: Optional[int] = None) -> int:
         """Logical block index owned by (= updated against the data slice of)
@@ -609,6 +679,12 @@ class DistSampler:
             "particles": particles,
             "particles_start": np.asarray(p_start, dtype=np.int64),
             "t": np.asarray(self._t, dtype=np.int64),
+            # the RESOLVED pairing (after 'auto' routing), as an index into
+            # W2_PAIRING_CODES — runs straddling the auto-switch boundary
+            # stay distinguishable after the fact (ADVICE round 5)
+            "w2_pairing": np.asarray(
+                W2_PAIRING_CODES.index(self._w2_pairing), dtype=np.int8
+            ),
         }
         if self._previous is None:
             state["previous"] = None
@@ -785,11 +861,512 @@ class DistSampler:
             else:
                 g = g_arr
         self._w2_g = g
+        code = state.get("w2_pairing")  # absent in older checkpoints
+        # with the W2 term off the pairing is an inert placeholder on both
+        # sides — a mismatch means nothing, so stay silent
+        if code is not None and self._include_wasserstein:
+            saved = W2_PAIRING_CODES[int(np.asarray(code))]
+            if saved != self._w2_pairing:
+                warnings.warn(
+                    f"checkpoint was written under w2_pairing='{saved}' but "
+                    f"this sampler resolved '{self._w2_pairing}': the "
+                    "trajectory before and after the restore optimises "
+                    "different W2 functionals (reshard-on-restore converts "
+                    "the state exactly, but the objective changes)",
+                    stacklevel=2,
+                )
         self._t = int(state["t"])
 
     # ------------------------------------------------------------------ #
 
     def run_steps(
+        self,
+        num_steps: int,
+        step_size: float,
+        record: bool = False,
+        h: float = 1.0,
+        dispatch_budget: Optional[float] = None,
+        pairs_per_sec: Optional[float] = None,
+        hops_per_dispatch: Optional[int] = None,
+        max_passes_per_dispatch: Optional[int] = None,
+        time_dispatches: bool = False,
+    ):
+        """``num_steps`` distributed SVGD steps, monolithic or **chunked**.
+
+        With the chunking knobs at their defaults this is the classic
+        single-dispatch scanned path (:meth:`_run_steps_scan` — one jitted
+        ``lax.scan`` over the per-shard step, the fast default).  The knobs
+        exist because past ~2M particles ONE step is a single ≳60 s
+        dispatch (φ alone is 4e12 pairs) and the TPU tunnel's execution
+        watchdog kills it (docs/notes.md large-n table): the chunked
+        executor re-expresses the same trajectory as a host-driven chain of
+        bounded dispatches with the partial state carried between them, so
+        no single dispatch exceeds the budget — the SVGD analogue of
+        gradient-accumulation microbatching, at the measured ~0.2 ms
+        marginal cost per chained dispatch.
+
+        ``dispatch_budget`` (seconds) auto-selects the execution from n, S,
+        and a pairs/sec throughput estimate (``pairs_per_sec``, default
+        :data:`DISPATCH_PAIRS_PER_SEC` — the measured v5e rate):
+
+        - whole run fits the budget → **monolithic** (unchanged fast path);
+        - a single step fits → **scan chunks**: the scan is split into
+          ``steps_per_dispatch``-step dispatches;
+        - a single step exceeds the budget → **intra-step** chunking: the
+          ring exchange's S ppermute hops run ``hops_per_dispatch`` at a
+          time (partial φ accumulator + visiting block carried across
+          dispatches — ``parallel/exchange.py:make_chunked_ring_step_fns``),
+          and each Sinkhorn W2 solve is split into
+          ``max_passes_per_dispatch``-iteration resumable dual-advance
+          dispatches (``ops/ot.py:sinkhorn_dual_advance``; the carried
+          duals make this exact at convergence), replacing the ad-hoc
+          ``sinkhorn_iters`` budget protocol.  Requires
+          ``exchange_impl='ring'`` when the φ pass itself must split.
+
+        Pass ``hops_per_dispatch`` / ``max_passes_per_dispatch`` explicitly
+        to force intra-step chunking without the heuristic (mutually
+        exclusive with ``dispatch_budget``).  ``time_dispatches=True``
+        fences every dispatch (``block_until_ready``) and records the max
+        per-dispatch wall — measurement mode; leave it off to let chained
+        dispatches pipeline.  Every call writes :attr:`last_run_stats`
+        (execution mode, dispatch counts, resolved knobs, max dispatch
+        wall, resolved ``w2_pairing``) for bench harnesses.
+
+        Chunked trajectories match the monolithic path to float tolerance
+        — the hop chunks replay the identical accumulation order, and split
+        Sinkhorn solves agree at convergence (tests/test_chunked.py).
+        Intra-step constraints: no lagged exchange (``exchange_every > 1``
+        plans at whole-cadence granularity instead), fixed-bandwidth
+        kernels for the hop split, ``wasserstein_solver='sinkhorn'`` for
+        the pass split.
+        """
+        explicit = (hops_per_dispatch is not None
+                    or max_passes_per_dispatch is not None)
+        for name, val in (("hops_per_dispatch", hops_per_dispatch),
+                          ("max_passes_per_dispatch",
+                           max_passes_per_dispatch)):
+            if val is not None and val < 1:
+                raise ValueError(f"{name} must be >= 1, got {val}")
+        if dispatch_budget is not None and explicit:
+            raise ValueError(
+                "pass either dispatch_budget (auto-chunking) or explicit "
+                "hops_per_dispatch / max_passes_per_dispatch, not both"
+            )
+        if dispatch_budget is None and not explicit:
+            out = self._run_steps_scan(num_steps, step_size, record, h)
+            self.last_run_stats = self._stats(
+                "monolithic", num_steps, 1, None)
+            return out
+        if explicit:
+            plan = {"execution": "intra_step",
+                    "hops_per_dispatch": hops_per_dispatch,
+                    "max_passes_per_dispatch": max_passes_per_dispatch}
+        else:
+            if dispatch_budget <= 0:
+                raise ValueError(
+                    f"dispatch_budget must be positive, got {dispatch_budget}"
+                )
+            plan = self._plan_dispatches(num_steps, dispatch_budget,
+                                         pairs_per_sec)
+        if plan["execution"] == "monolithic":
+            out = self._run_steps_scan(num_steps, step_size, record, h)
+            self.last_run_stats = self._stats(
+                "monolithic", num_steps, 1, None,
+                dispatch_budget_s=dispatch_budget)
+            return out
+        if plan["execution"] == "scan_chunks":
+            return self._run_steps_scan_chunks(
+                num_steps, step_size, record, h,
+                plan["steps_per_dispatch"], time_dispatches, dispatch_budget,
+            )
+        return self._run_steps_intra(
+            num_steps, step_size, record, h,
+            plan.get("hops_per_dispatch"),
+            plan.get("max_passes_per_dispatch"),
+            time_dispatches, dispatch_budget,
+        )
+
+    def _stats(self, execution, num_steps, num_dispatches, max_wall, **extra):
+        stats = {
+            "execution": execution,
+            "num_steps": num_steps,
+            "num_dispatches": num_dispatches,
+            "dispatches_per_step": round(
+                num_dispatches / max(num_steps, 1), 4),
+            "max_dispatch_wall_s": max_wall,
+            "w2_pairing": self._w2_pairing,
+        }
+        stats.update(extra)
+        return stats
+
+    def _plan_dispatches(self, num_steps, budget, pairs_per_sec) -> dict:
+        """The ``dispatch_budget`` heuristic (see :meth:`run_steps`): model
+        per-step work in pairwise interactions, convert through the
+        pairs/sec estimate, and pick the coarsest execution whose largest
+        dispatch fits the budget."""
+        pps = float(pairs_per_sec if pairs_per_sec is not None
+                    else DISPATCH_PAIRS_PER_SEC)
+        if pps <= 0:
+            raise ValueError(f"pairs_per_sec must be positive, got {pps}")
+        n = float(self._num_particles)
+        S = self._num_shards
+        exchanged = self._mode != PARTITIONS
+        phi_pairs = n * n if exchanged else n * n / S
+        w2_pass_pairs = 0.0
+        w2_passes = 0
+        if self._include_wasserstein and self._wasserstein_solver == "sinkhorn":
+            # per scaling pass: S solves of (n/S, n/S) under the block
+            # pairing, (n/S, n) under the global one; plus the 2 soft-
+            # c-transform start passes and ~1 finish pass per solve
+            w2_pass_pairs = n * n / S if self._block_w2 else n * n
+            w2_passes = self._sinkhorn_iters + 3
+        step_pairs = phi_pairs + w2_pass_pairs * w2_passes
+        t_step = step_pairs / pps
+        if num_steps * t_step <= budget:
+            return {"execution": "monolithic"}
+        if t_step <= budget:
+            k = max(1, int(budget // t_step))
+            if self._exchange_every > 1:
+                # lagged exchange: chunk at whole-cadence granularity
+                k = max(self._exchange_every,
+                        k - k % self._exchange_every)
+            return {"execution": "scan_chunks",
+                    "steps_per_dispatch": min(k, num_steps)}
+        # one step exceeds the budget: split inside the step
+        if self._exchange_every > 1:
+            raise ValueError(
+                f"one lagged macro-step (~{t_step:.1f} s estimated at "
+                f"{pps:.2e} pairs/s) exceeds dispatch_budget={budget} s, "
+                "and the lagged exchange has no intra-step seam (one "
+                "macro-step IS the gather-amortisation unit) — raise the "
+                "budget or drop exchange_every"
+            )
+        hpd = None
+        if self._exchange_impl == "ring" and exchanged:
+            hop_pairs = phi_pairs / S
+            hpd = max(1, min(S, int(budget * pps // max(hop_pairs, 1.0))))
+        elif phi_pairs / pps > budget:
+            raise ValueError(
+                f"one step's φ pass alone ({phi_pairs:.2e} pairs ≈ "
+                f"{phi_pairs / pps:.1f} s at {pps:.2e} pairs/s) exceeds "
+                f"dispatch_budget={budget} s, and only the ring exchange "
+                "has an intra-step seam to split at — construct with "
+                "exchange_impl='ring' (all_* modes), raise num_shards, or "
+                "raise the budget"
+            )
+        max_passes = None
+        if w2_pass_pairs:
+            # every resumed chunk pays the 2 soft-c-transform start passes
+            # (and the last one the finish) on top of its scaling passes —
+            # budget the chunk for start + scaling, not scaling alone
+            max_passes = max(1, min(self._sinkhorn_iters,
+                                    int(budget * pps // w2_pass_pairs) - 3))
+        return {"execution": "intra_step", "hops_per_dispatch": hpd,
+                "max_passes_per_dispatch": max_passes}
+
+    def _dispatch_runner(self, time_dispatches: bool):
+        """Dispatch-counting (and optionally fencing/timing) wrapper used by
+        every chunked execution path."""
+        import time as _time
+
+        rec = {"count": 0, "max_wall": None}
+
+        def run(fn, *args):
+            t0 = _time.perf_counter() if time_dispatches else None
+            out = fn(*args)
+            rec["count"] += 1
+            if time_dispatches:
+                jax.block_until_ready(out)
+                wall = _time.perf_counter() - t0
+                rec["max_wall"] = (wall if rec["max_wall"] is None
+                                   else max(rec["max_wall"], wall))
+            return out
+
+        return run, rec
+
+    def _run_steps_scan_chunks(self, num_steps, step_size, record, h,
+                               steps_per_dispatch, time_dispatches, budget):
+        """Budgeted middle tier: the monolithic scan split into
+        ``steps_per_dispatch``-step dispatches (at most two distinct scan
+        lengths — the chunk and the remainder — so at most two compiled
+        programs).  Semantics identical to one long scan: the step counter
+        and minibatch key stream continue across chunks, and recorded
+        histories concatenate without duplicates (each scan emits pre-update
+        snapshots only)."""
+        run, rec = self._dispatch_runner(time_dispatches)
+        hists = []
+        done = 0
+        for k in _chunk_sizes(num_steps, steps_per_dispatch):
+            out = run(self._run_steps_scan, k, step_size, record, h)
+            done += k
+            if record:
+                hists.append(out[1])
+        self.last_run_stats = self._stats(
+            "scan_chunks", num_steps, rec["count"], rec["max_wall"],
+            steps_per_dispatch=steps_per_dispatch, dispatch_budget_s=budget,
+        )
+        if record:
+            return self._particles, jnp.concatenate(hists, axis=0)
+        return self._particles
+
+    # ------------------------------------------------------------------ #
+    # Intra-step chunked execution (bounded multi-dispatch stepping)
+
+    def _chunk_fn(self, kind, *args):
+        """Bound + jitted chunk program for the intra-step executor, cached
+        per (kind, static args) — the host loop reuses a handful of
+        programs regardless of step count."""
+        key = (kind,) + args
+        fn = self._chunk_cache.get(key)
+        if fn is not None:
+            return fn
+        if self._chunk_builders is None:
+            from dist_svgd_tpu.parallel.exchange import (
+                make_chunked_ring_step_fns,
+            )
+
+            self._chunk_builders = make_chunked_ring_step_fns(
+                logp=self._logp,
+                kernel=self._kernel,
+                mode=self._mode,
+                num_shards=self._num_shards,
+                n_local_data=self._rows_per_shard,
+                score_scale=self._score_scale,
+                shard_data=self._shard_data,
+                batch_size=self._batch_size,
+                log_prior=self._log_prior,
+                phi_impl=self._phi_impl,
+                phi_batch_hint=self._phi_batch_hint,
+            )
+        b = self._chunk_builders
+        data_spec = 0 if self._shard_data else None
+        if kind == "local":
+            num_hops, rotate_last = args
+            fn = jax.jit(bind_shard_fn(
+                b["local_hops"](num_hops, rotate_last),
+                self._num_shards, self._mesh,
+                in_specs=(0, 0, 0, data_spec, None, None),
+                out_specs=(0, 0),
+            ))
+        elif kind == "score":
+            (num_hops,) = args
+            fn = jax.jit(bind_shard_fn(
+                b["score_hops"](num_hops),
+                self._num_shards, self._mesh,
+                in_specs=(0, 0, data_spec, None, None),
+                out_specs=(0, 0),
+            ))
+        elif kind == "exact_phi":
+            num_hops, rotate_last = args
+            fn = jax.jit(bind_shard_fn(
+                b["exact_phi_hops"](num_hops, rotate_last),
+                self._num_shards, self._mesh,
+                in_specs=(0, 0, 0, 0),
+                out_specs=(0, 0, 0),
+            ))
+        elif kind == "add_prior":
+            # row-wise elementwise: applies to the merged global arrays
+            # directly, no binding needed (same for 'finish')
+            fn = jax.jit(b["add_prior"])
+        elif kind == "finish":
+            fn = jax.jit(b["finish"])
+        else:  # pragma: no cover - internal
+            raise ValueError(f"unknown chunk kind {kind!r}")
+        self._chunk_cache[key] = fn
+        return fn
+
+    def _w2_chunk_fn(self, kind, iters, cold):
+        """Jitted vmapped Sinkhorn chunk over the per-shard block stack:
+        ``'advance'`` resumes the duals only (``sinkhorn_dual_advance``),
+        ``'final'`` pays the gradient finish.  ``cold=True`` starts from
+        the hard c-transform (``g_init=None``) — the first chunk of a step
+        under ``sinkhorn_warm_start=False``."""
+        key = ("w2", kind, iters, cold)
+        fn = self._chunk_cache.get(key)
+        if fn is not None:
+            return fn
+        from dist_svgd_tpu.ops.ot import sinkhorn_dual_advance
+
+        eps, tol = self._sinkhorn_eps, self._sinkhorn_tol
+        if kind == "advance":
+            def per(c, p, g):
+                return sinkhorn_dual_advance(
+                    c, p, eps=eps, iters=iters, tol=tol,
+                    g_init=None if cold else g,
+                )
+        else:
+            def per(c, p, g):
+                return wasserstein_grad_sinkhorn(
+                    c, p, eps=eps, iters=iters, tol=tol,
+                    g_init=None if cold else g, return_g=True,
+                )
+
+        fn = jax.jit(jax.vmap(per))
+        self._chunk_cache[key] = fn
+        return fn
+
+    def _chunked_wasserstein_grad(self, max_passes, run):
+        """Per-step W2 gradient as a chain of bounded solve dispatches (the
+        device-side analogue of :meth:`_wasserstein_grad`): ``ceil(iters /
+        max_passes) − 1`` dual-advance dispatches threading ``g``, then one
+        gradient-finish dispatch.  The carried dual stays on device; so does
+        the snapshot roll."""
+        dtype = self._particles.dtype
+        S = self._num_shards
+        cur = self._particles.reshape(S, self._particles_per_shard, self._d)
+        prev = jnp.asarray(self._previous, dtype=dtype)
+        prev_for = jnp.roll(prev, -1, axis=0) if self._block_w2 else prev
+        if self._w2_g is not None:
+            g = jnp.asarray(self._w2_g, dtype=dtype)
+        else:
+            g = jnp.zeros(self._g_shape(), dtype=dtype)
+        total = self._sinkhorn_iters
+        splits = (_chunk_sizes(total, max_passes)
+                  if max_passes is not None else [total])
+        # warm start: g_init is the carried/zeros dual (the safe soft-
+        # transform start _wasserstein_grad uses); cold: the first chunk
+        # starts from the hard c-transform, later chunks must thread g
+        cold0 = not self._sinkhorn_warm_start
+        for i, k in enumerate(splits[:-1]):
+            g = run(self._w2_chunk_fn("advance", k, cold0 and i == 0),
+                    cur, prev_for, g)
+        grad, g = run(
+            self._w2_chunk_fn("final", splits[-1],
+                              cold0 and len(splits) == 1),
+            cur, prev_for, g,
+        )
+        self._w2_g = g
+        return grad.reshape(self._num_particles, self._d)
+
+    def _snapshot_previous_device(self, pre_update) -> None:
+        """Device-side form of :meth:`_snapshot_previous` (the chunked
+        executor keeps W2 state on device between dispatches; forcing a
+        host round-trip per step would serialise the dispatch chain)."""
+        if self._block_w2:
+            self._previous = self._particles.reshape(self._prev_shape())
+            return
+        n, s = self._num_particles, self._particles_per_shard
+        # shard r's snapshot: pre-update rows everywhere except its own
+        # block, which is post-update (reference dsvgd/distsampler.py:202-3)
+        owner = (jnp.arange(n) // s)[None, :] == jnp.arange(
+            self._num_shards)[:, None]
+        self._previous = jnp.where(
+            owner[:, :, None], self._particles[None], pre_update[None]
+        )
+
+    def _chunked_phi_step(self, run, w_grad, t_arr, key, eps_arr, h_arr,
+                          hops_per_dispatch):
+        """One ring-φ step as a chain of hop-chunk dispatches (see
+        ``parallel/exchange.py:make_chunked_ring_step_fns`` for the carry
+        contracts)."""
+        S = self._num_shards
+        sizes = _chunk_sizes(S, hops_per_dispatch)
+        parts = self._particles
+        if self._mode == ALL_SCORES:
+            visiting, vscores = parts, jnp.zeros_like(parts)
+            for k in sizes:  # score pass: every hop rotates
+                visiting, vscores = run(
+                    self._chunk_fn("score", k),
+                    visiting, vscores, self._data, t_arr, key,
+                )
+            vscores = run(self._chunk_fn("add_prior"), visiting, vscores)
+            acc = jnp.zeros_like(parts)
+            for i, k in enumerate(sizes):
+                visiting, vscores, acc = run(
+                    self._chunk_fn("exact_phi", k, i < len(sizes) - 1),
+                    parts, visiting, vscores, acc,
+                )
+        else:
+            visiting, acc = parts, jnp.zeros_like(parts)
+            for i, k in enumerate(sizes):
+                visiting, acc = run(
+                    self._chunk_fn("local", k, i < len(sizes) - 1),
+                    parts, visiting, acc, self._data, t_arr, key,
+                )
+        return run(self._chunk_fn("finish"), parts, acc, w_grad,
+                   eps_arr, h_arr)
+
+    def _run_steps_intra(self, num_steps, step_size, record, h,
+                         hops_per_dispatch, max_passes, time_dispatches,
+                         budget):
+        """Bounded multi-dispatch stepping: every logical step is a host-
+        driven chain of dispatches — budgeted W2 solve chunks, ring hop
+        chunks, and the elementwise finish — with the carried state
+        (visiting block, φ accumulator, Sinkhorn duals, W2 snapshots)
+        threaded between them.  Trajectory-equivalent to the eager/scanned
+        paths (tests/test_chunked.py)."""
+        if self._exchange_every > 1:
+            raise ValueError(
+                "intra-step chunking is undefined for the lagged exchange "
+                "(exchange_every > 1): one macro-step IS the amortisation "
+                "unit — use dispatch_budget, which chunks at whole-cadence "
+                "granularity"
+            )
+        ring_hops = (self._exchange_impl == "ring"
+                     and self._mode != PARTITIONS)
+        if hops_per_dispatch is not None and not ring_hops:
+            raise ValueError(
+                "hops_per_dispatch requires exchange_impl='ring' in an "
+                "all_* mode: the gather step has no hop seam to split at, "
+                "and the partitions step is already block-local"
+            )
+        if max_passes is not None and (
+                not self._include_wasserstein
+                or self._wasserstein_solver != "sinkhorn"):
+            raise ValueError(
+                "max_passes_per_dispatch splits the per-step Sinkhorn "
+                "solve and requires include_wasserstein=True with "
+                "wasserstein_solver='sinkhorn' (the host-LP solve has no "
+                "pass seam)"
+            )
+        run, rec = self._dispatch_runner(time_dispatches)
+        dtype = self._particles.dtype
+        eps_arr = jnp.asarray(step_size, dtype)
+        h_arr = jnp.asarray(h, dtype)
+        history = [] if record else None
+        for _ in range(num_steps):
+            self._t += 1
+            t_arr = jnp.asarray(self._t, dtype=jnp.int32)
+            key = jax.random.fold_in(self._batch_key, self._t)
+            if record:
+                # keep the snapshot as a device array: an np.asarray here
+                # would fence the chain once per step (the same round-trip
+                # _snapshot_previous_device exists to avoid)
+                history.append(self._particles)
+            if self._include_wasserstein and self._previous is not None:
+                if self._wasserstein_solver == "sinkhorn":
+                    w_grad = self._chunked_wasserstein_grad(
+                        max_passes, run).astype(dtype)
+                else:  # host LP: no pass seam, one host solve per step
+                    w_grad = self._wasserstein_grad().astype(dtype)
+                    rec["count"] += 1
+            else:
+                w_grad = jnp.zeros_like(self._particles)
+            pre_update = self._particles if self._include_wasserstein else None
+            if ring_hops:
+                self._particles = self._chunked_phi_step(
+                    run, w_grad, t_arr, key, eps_arr, h_arr,
+                    hops_per_dispatch
+                    if hops_per_dispatch is not None else self._num_shards,
+                )
+            else:
+                self._particles = run(
+                    self._step, self._particles, self._data, w_grad,
+                    t_arr, key, eps_arr, h_arr,
+                )
+            if self._include_wasserstein:
+                self._snapshot_previous_device(pre_update)
+        self.last_run_stats = self._stats(
+            "intra_step", num_steps, rec["count"], rec["max_wall"],
+            hops_per_dispatch=hops_per_dispatch,
+            max_passes_per_dispatch=max_passes,
+            dispatch_budget_s=budget,
+        )
+        if record:
+            return self._particles, jnp.stack(history)
+        return self._particles
+
+    def _run_steps_scan(
         self,
         num_steps: int,
         step_size: float,

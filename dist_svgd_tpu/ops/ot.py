@@ -327,6 +327,70 @@ FUSED_SINKHORN_MIN_PAIRS = 1 << 20
 FUSED_SINKHORN_STREAM_MIN_PAIRS = 1 << 28
 
 
+def _resolve_sinkhorn_route(x, y, impl: str):
+    """Shared implementation gate of :func:`wasserstein_grad_sinkhorn` and
+    :func:`sinkhorn_dual_advance`: picks ``'xla'`` / ``'fused'`` /
+    ``'streaming'`` (with the streaming-rescue and forced-pallas precision
+    warnings) so the two entries cannot drift on routing.  Returns
+    ``(route, on_tpu)``."""
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown sinkhorn impl {impl!r}")
+    if impl == "xla":
+        return "xla", False
+    from dist_svgd_tpu.ops.pallas_svgd import SMALL_D, pallas_available
+
+    on_tpu = pallas_available()
+    small_d = x.shape[1] <= SMALL_D
+    pairs = x.shape[0] * y.shape[0]
+    big = pairs >= FUSED_SINKHORN_MIN_PAIRS
+    # the fused path is f32-internal; honor other dtypes via XLA
+    f32 = (x.dtype == jnp.float32 and y.dtype == jnp.float32)
+    if (impl != "pallas" and on_tpu
+            and pairs >= FUSED_SINKHORN_STREAM_MIN_PAIRS
+            and not (small_d and f32)):
+        # forced 'pallas' is exempt: it routes small-d inputs to the
+        # streaming path itself (f32-internal), so the materialised-XLA
+        # OOM prediction below would be wrong guidance there
+        import warnings
+
+        warnings.warn(
+            f"sinkhorn solve with {pairs:.2e} cost entries (dtype "
+            f"{x.dtype}, d={x.shape[1]}) is past the streaming-rescue "
+            "threshold but ineligible for the O(n*d) streaming path "
+            "(f32, d <= SMALL_D only); the materialised XLA solve "
+            "will likely exhaust TPU HBM — cast to float32 / reduce d, "
+            "or force impl='xla' deliberately on a large-memory host",
+            stacklevel=3,
+        )
+    if impl == "pallas" or (on_tpu and small_d and big and f32):
+        if not small_d:
+            raise ValueError(
+                f"impl='pallas' requires d <= {SMALL_D}, got {x.shape[1]}"
+            )
+        wider_than_f32 = any(
+            jnp.issubdtype(a.dtype, jnp.floating)
+            and jnp.finfo(a.dtype).bits > 32
+            for a in (x, y)
+        )
+        if impl == "pallas" and wider_than_f32:
+            # sub-f32 inputs (bf16/f16) lose nothing to the f32-internal
+            # solve — only genuinely wider dtypes warrant the warning
+            import warnings
+
+            warnings.warn(
+                f"impl='pallas' computes internally in float32 but got "
+                f"{x.dtype}/{y.dtype} inputs; the result is cast back "
+                "but carries f32 precision — use impl='xla' (or 'auto', "
+                "which routes non-f32 there) for full-precision solves",
+                stacklevel=3,
+            )
+        if pairs >= FUSED_SINKHORN_STREAM_MIN_PAIRS:
+            # past the HBM cliff: never materialise the kernel matrix
+            return "streaming", on_tpu
+        return "fused", on_tpu
+    return "xla", on_tpu
+
+
 def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
                               iters: int = 200, tol: float | None = None,
                               absorb_every: int = 10,
@@ -368,71 +432,19 @@ def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
         )
         grad = x * jnp.sum(plan, axis=1)[:, None] - plan @ y
         return (grad, g) if return_g else grad
-    if impl != "xla":
-        from dist_svgd_tpu.ops.pallas_svgd import SMALL_D, pallas_available
+    route, on_tpu = _resolve_sinkhorn_route(x, y, impl)
+    if route != "xla":
+        from dist_svgd_tpu.ops.pallas_ot import (
+            sinkhorn_grad_fused,
+            sinkhorn_grad_streaming,
+        )
 
-        on_tpu = pallas_available()
-        small_d = x.shape[1] <= SMALL_D
-        pairs = x.shape[0] * y.shape[0]
-        big = pairs >= FUSED_SINKHORN_MIN_PAIRS
-        # the fused path is f32-internal; honor other dtypes via XLA
-        f32 = (x.dtype == jnp.float32 and y.dtype == jnp.float32)
-        if (impl != "pallas" and on_tpu
-                and pairs >= FUSED_SINKHORN_STREAM_MIN_PAIRS
-                and not (small_d and f32)):
-            # forced 'pallas' is exempt: it routes small-d inputs to the
-            # streaming path itself (f32-internal), so the materialised-XLA
-            # OOM prediction below would be wrong guidance there
-            import warnings
-
-            warnings.warn(
-                f"sinkhorn solve with {pairs:.2e} cost entries (dtype "
-                f"{x.dtype}, d={x.shape[1]}) is past the streaming-rescue "
-                "threshold but ineligible for the O(n*d) streaming path "
-                "(f32, d <= SMALL_D only); the materialised XLA solve "
-                "will likely exhaust TPU HBM — cast to float32 / reduce d, "
-                "or force impl='xla' deliberately on a large-memory host",
-                stacklevel=2,
-            )
-        if impl == "pallas" or (on_tpu and small_d and big and f32):
-            if not small_d:
-                raise ValueError(
-                    f"impl='pallas' requires d <= {SMALL_D}, got {x.shape[1]}"
-                )
-            wider_than_f32 = any(
-                jnp.issubdtype(a.dtype, jnp.floating)
-                and jnp.finfo(a.dtype).bits > 32
-                for a in (x, y)
-            )
-            if impl == "pallas" and wider_than_f32:
-                # sub-f32 inputs (bf16/f16) lose nothing to the f32-internal
-                # solve — only genuinely wider dtypes warrant the warning
-                import warnings
-
-                warnings.warn(
-                    f"impl='pallas' computes internally in float32 but got "
-                    f"{x.dtype}/{y.dtype} inputs; the result is cast back "
-                    "but carries f32 precision — use impl='xla' (or 'auto', "
-                    "which routes non-f32 there) for full-precision solves",
-                    stacklevel=2,
-                )
-            from dist_svgd_tpu.ops.pallas_ot import (
-                sinkhorn_grad_fused,
-                sinkhorn_grad_streaming,
-            )
-
-            if x.shape[0] * y.shape[0] >= FUSED_SINKHORN_STREAM_MIN_PAIRS:
-                # past the HBM cliff: never materialise the kernel matrix
-                return sinkhorn_grad_streaming(
-                    x, y, eps=eps, iters=iters, tol=tol,
-                    absorb_every=absorb_every, g_init=g_init,
-                    return_g=return_g, interpret=not on_tpu,
-                )
-            return sinkhorn_grad_fused(
-                x, y, eps=eps, iters=iters, tol=tol,
-                absorb_every=absorb_every, g_init=g_init, return_g=return_g,
-                interpret=not on_tpu,
-            )
+        fn = sinkhorn_grad_streaming if route == "streaming" else sinkhorn_grad_fused
+        return fn(
+            x, y, eps=eps, iters=iters, tol=tol,
+            absorb_every=absorb_every, g_init=g_init, return_g=return_g,
+            interpret=not on_tpu,
+        )
     cost = squared_distances(x, y)
     _, g, kmat, u, v, _ = _sinkhorn_solve(
         cost, x.shape[0], y.shape[0], eps, iters, tol, absorb_every, g_init
@@ -449,3 +461,70 @@ def wasserstein_grad_sinkhorn(particles, previous, eps: float = 0.05,
     if return_g:
         return grad, g
     return grad
+
+
+def sinkhorn_dual_advance(particles, previous, eps: float = 0.05,
+                          iters: int = 200, tol: float | None = None,
+                          absorb_every: int = 10, g_init=None,
+                          impl: str = "auto"):
+    """Advance the Sinkhorn dual potential ``g`` by up to ``iters`` scaling
+    iterations WITHOUT the gradient finish — the resumable half of
+    :func:`wasserstein_grad_sinkhorn`, as a first-class entry.
+
+    The carried ``g`` already makes *consecutive* solves resumable (each
+    call restarts from the soft c-transform pair of ``g_init``); this entry
+    makes that a within-step chunk: a host loop splits one logical solve of
+    ``I`` iterations into bounded dispatches of ``max_passes_per_dispatch``
+    — ``g = sinkhorn_dual_advance(x, y, iters=passes, g_init=g)`` repeated,
+    with only the terminal chunk paying the gradient finish
+    (``wasserstein_grad_sinkhorn(..., g_init=g, return_g=True)``).  This is
+    what ``DistSampler.run_steps(dispatch_budget=...)`` uses to keep every
+    W2 dispatch under the TPU tunnel's execution watchdog at large n,
+    replacing the ad-hoc protocol of shrinking ``sinkhorn_iters`` to bound
+    the *whole step's* dispatch.
+
+    Each resume costs the two soft-c-transform start passes; the start pair
+    is one exact log-domain iteration from ``g_init``, so a split solve
+    sits a few effective iterations *ahead* of the unsplit one, never
+    behind — at convergence split ≡ unsplit (tests/test_chunked.py).  With
+    ``tol`` set, chunks after convergence collapse to the start passes
+    alone (the streaming path's ``delta0`` early exit).
+
+    Returns ``g`` in cost units, ready to feed back as ``g_init``.
+    """
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown sinkhorn impl {impl!r}")
+    x, y = particles, previous
+    route, on_tpu = _resolve_sinkhorn_route(x, y, impl)
+    if iters == 0:
+        if route == "streaming":
+            # the bare start pair without ever materialising C — at
+            # streaming sizes the dense sinkhorn_plan path below would
+            # build exactly the matrix this route exists to avoid
+            from dist_svgd_tpu.ops.pallas_ot import _solve_setup
+
+            _, _, _, g0, _, reg, *_ = _solve_setup(
+                x, y, eps, g_init, not on_tpu)
+            return (g0 * reg).astype(x.dtype)
+        _, (_, g) = sinkhorn_plan(
+            x, y, eps=eps, iters=0, absorb_every=absorb_every,
+            g_init=g_init, return_potentials=True,
+        )
+        return g
+    if route != "xla":
+        from dist_svgd_tpu.ops.pallas_ot import (
+            sinkhorn_grad_fused,
+            sinkhorn_grad_streaming,
+        )
+
+        fn = sinkhorn_grad_streaming if route == "streaming" else sinkhorn_grad_fused
+        return fn(
+            x, y, eps=eps, iters=iters, tol=tol,
+            absorb_every=absorb_every, g_init=g_init, duals_only=True,
+            interpret=not on_tpu,
+        )
+    cost = squared_distances(x, y)
+    _, g, _, _, _, _ = _sinkhorn_solve(
+        cost, x.shape[0], y.shape[0], eps, iters, tol, absorb_every, g_init
+    )
+    return g

@@ -446,6 +446,7 @@ def _solve_setup(particles, previous, eps, g_init, interpret):
 def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
                         iters: int = 200, tol=None, absorb_every: int = 10,
                         g_init=None, return_g: bool = False,
+                        duals_only: bool = False,
                         interpret: bool = False):
     """W2 gradient via the fused kernels — same algorithm and exit
     semantics as ``ops/ot.py:sinkhorn_plan`` + ``wasserstein_grad_sinkhorn``
@@ -463,9 +464,11 @@ def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
     - the final gradient is the matvec finish against the last block's
       ``(kmat, u, v)`` — no exp pass, and the plan is never materialised.
 
-    Returns ``grad`` or ``(grad, g)`` like the XLA path.  Numerically equal
-    to it up to f32 reduction-order roundoff (pinned by
-    tests/test_pallas_ot.py).
+    Returns ``grad`` or ``(grad, g)`` like the XLA path; ``duals_only=True``
+    skips the gradient finish and returns just ``g`` (cost units) — the
+    resumable-solve chunk behind ``ops/ot.py:sinkhorn_dual_advance``.
+    Numerically equal to the XLA path up to f32 reduction-order roundoff
+    (pinned by tests/test_pallas_ot.py).
     """
     if absorb_every <= 0:
         raise ValueError(f"absorb_every must be positive, got {absorb_every}")
@@ -486,6 +489,8 @@ def sinkhorn_grad_fused(particles, previous, eps: float = 0.05,
     f, g, kmat, u, v = _sinkhorn_scaling_loop(
         f0, g0, make_ops, 1.0, m, n, iters, tol, absorb_every, dt,
     )
+    if duals_only:
+        return (g * reg).astype(particles.dtype)
 
     # Gradient from the last block's (kmat, u, v) — the plan is
     # diag(u)·kmat·diag(v) entrywise, so rowsum and P@y' are two cheap
@@ -602,6 +607,7 @@ def sinkhorn_grad_streaming(particles, previous, eps: float = 0.05,
                             iters: int = 200, tol=None,
                             absorb_every: int = 10, g_init=None,
                             return_g: bool = False,
+                            duals_only: bool = False,
                             interpret: bool = False):
     """W2 gradient with O(n·d) memory — for particle counts where even ONE
     ``(n/S, n)`` kernel matrix does not fit HBM (the exchanged-mode W2
@@ -670,6 +676,11 @@ def sinkhorn_grad_streaming(particles, previous, eps: float = 0.05,
     else:
         f, g = run_loop((f0, g0))
 
+    if duals_only:
+        # the resumable-solve chunk (ops/ot.py:sinkhorn_dual_advance): no
+        # plan_grad pass — at streaming sizes the finish is a whole extra
+        # rebuild pass over n²/S pairs, paid once per *solve*, not per chunk
+        return (g * reg).astype(particles.dtype)
     grad = plan_grad(xs_, ys_, f, g, 1.0, interpret=interpret) * sr
     if return_g:
         return grad.astype(particles.dtype), (g * reg).astype(particles.dtype)

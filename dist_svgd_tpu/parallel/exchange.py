@@ -112,27 +112,88 @@ def _shard_data_resolver(mode, num_shards, n_local_data, shard_data):
     return resolve
 
 
+def _ring_local_hops(y_block, carry, score_of, phi_fn, num_shards,
+                     num_hops: int, rotate_last: bool):
+    """Advance ``num_hops`` (accumulate, rotate) hops of the single-pass
+    (``all_particles``) ring φ from an explicit carry ``(visiting, acc)``.
+
+    The carry is the *resumable* state of the hop loop — the visiting block
+    and the partial φ accumulator — so a full S-hop pass can be executed as
+    one call (the monolithic :func:`_ring_phi_local_scores`) or split
+    ``hops_per_dispatch`` at a time across host-driven dispatches (the
+    chunked step executor, :func:`make_chunked_ring_step_fns`), with bitwise-
+    identical accumulation order either way.  ``rotate_last=False`` elides
+    the final hop's ppermute — a wasted inter-device transfer XLA cannot
+    elide — and is only valid on the pass's terminal chunk."""
+    perm = _ring_perm(num_shards)
+
+    def body(i, c):
+        visiting, acc = c
+        acc = acc + phi_fn(y_block, visiting, score_of(visiting))
+        return lax.ppermute(visiting, AXIS, perm), acc
+
+    loop_hops = num_hops if rotate_last else num_hops - 1
+    visiting, acc = lax.fori_loop(0, loop_hops, body, carry)
+    if not rotate_last:
+        acc = acc + phi_fn(y_block, visiting, score_of(visiting))
+    return visiting, acc
+
+
 def _ring_phi_local_scores(y_block, score_of, phi_fn, num_shards):
     """Single-pass ring φ with ``all_particles`` semantics: the visiting block
     is scored by *this* device's ``score_of`` (local data, importance-scaled,
     prior included).  Equal block sizes let each hop contribute
     ``phi(y, visiting, s)`` (already normalised by the block size) so the mean
-    over hops is the global-mean φ."""
+    over hops is the global-mean φ.  One monolithic S-hop pass of
+    :func:`_ring_local_hops` (S−1 rotations + a rotation-free tail)."""
+    _, acc = _ring_local_hops(
+        y_block, (y_block, jnp.zeros_like(y_block)), score_of, phi_fn,
+        num_shards, num_shards, rotate_last=False,
+    )
+    return acc / num_shards
+
+
+def _ring_exact_score_hops(carry, lik_score_of, num_shards, num_hops: int):
+    """Advance ``num_hops`` hops of the ``all_scores`` ring's score pass from
+    the carry ``(visiting, vscores)`` — each hop adds this device's
+    local-data likelihood score of the visiting block to its travelling
+    accumulator, then rotates both.  All S hops rotate (the pass must end
+    with every block home), so chunks compose without a tail variant."""
     perm = _ring_perm(num_shards)
 
-    def body(i, carry):
-        visiting, acc = carry
-        acc = acc + phi_fn(y_block, visiting, score_of(visiting))
-        return lax.ppermute(visiting, AXIS, perm), acc
+    def body(i, c):
+        visiting, vscores = c
+        vscores = vscores + lik_score_of(visiting)
+        return (
+            lax.ppermute(visiting, AXIS, perm),
+            lax.ppermute(vscores, AXIS, perm),
+        )
 
-    # S−1 (accumulate, rotate) hops, then the last visiting block needs no
-    # rotation — the loop body's trailing ppermute would be a wasted
-    # inter-device transfer XLA cannot elide.
-    visiting, acc = lax.fori_loop(
-        0, num_shards - 1, body, (y_block, jnp.zeros_like(y_block))
-    )
-    acc = acc + phi_fn(y_block, visiting, score_of(visiting))
-    return acc / num_shards
+    return lax.fori_loop(0, num_hops, body, carry)
+
+
+def _ring_exact_phi_hops(y_block, carry, phi_fn, num_shards, num_hops: int,
+                         rotate_last: bool):
+    """Advance ``num_hops`` hops of the ``all_scores`` ring's φ pass from the
+    carry ``(visiting, vscores, acc)`` — the (block, score)-pair rotation
+    with the partial φ accumulator.  ``rotate_last=False`` (terminal chunk
+    only) elides the final two transfers, as in :func:`_ring_local_hops`."""
+    perm = _ring_perm(num_shards)
+
+    def body(i, c):
+        visiting, vscores, acc = c
+        acc = acc + phi_fn(y_block, visiting, vscores)
+        return (
+            lax.ppermute(visiting, AXIS, perm),
+            lax.ppermute(vscores, AXIS, perm),
+            acc,
+        )
+
+    loop_hops = num_hops if rotate_last else num_hops - 1
+    visiting, vscores, acc = lax.fori_loop(0, loop_hops, body, carry)
+    if not rotate_last:
+        acc = acc + phi_fn(y_block, visiting, vscores)
+    return visiting, vscores, acc
 
 
 def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, phi_fn, num_shards):
@@ -142,37 +203,18 @@ def _ring_phi_exact_scores(y_block, lik_score_of, prior_score_of, phi_fn, num_sh
     is home with the exact global score (the ``lax.psum`` result, modulo
     summation order); the prior gradient (identity when the prior lives
     inside ``logp``) is then added once.  Pass 2 rotates (block, score) pairs
-    and accumulates φ."""
-    perm = _ring_perm(num_shards)
-
-    def score_body(i, carry):
-        visiting, vscores = carry
-        vscores = vscores + lik_score_of(visiting)
-        return (
-            lax.ppermute(visiting, AXIS, perm),
-            lax.ppermute(vscores, AXIS, perm),
-        )
-
-    visiting, vscores = lax.fori_loop(
-        0, num_shards, score_body, (y_block, jnp.zeros_like(y_block))
+    and accumulates φ.  Both passes are monolithic full-S calls of the
+    resumable hop primitives (:func:`_ring_exact_score_hops` /
+    :func:`_ring_exact_phi_hops`)."""
+    visiting, vscores = _ring_exact_score_hops(
+        (y_block, jnp.zeros_like(y_block)), lik_score_of, num_shards,
+        num_shards,
     )
     vscores = vscores + prior_score_of(visiting)
-
-    def phi_body(i, carry):
-        visiting, vscores, acc = carry
-        acc = acc + phi_fn(y_block, visiting, vscores)
-        return (
-            lax.ppermute(visiting, AXIS, perm),
-            lax.ppermute(vscores, AXIS, perm),
-            acc,
-        )
-
-    # S−1 hops + one rotation-free tail, as in _ring_phi_local_scores (here
-    # the saving is two transfers: the block and its travelling scores).
-    visiting, vscores, acc = lax.fori_loop(
-        0, num_shards - 1, phi_body, (visiting, vscores, jnp.zeros_like(y_block))
+    _, _, acc = _ring_exact_phi_hops(
+        y_block, (visiting, vscores, jnp.zeros_like(y_block)), phi_fn,
+        num_shards, num_shards, rotate_last=False,
     )
-    acc = acc + phi_fn(y_block, visiting, vscores)
     return acc / num_shards
 
 
@@ -474,6 +516,142 @@ def _build_core(
         return delta, interacting
 
     return core
+
+
+def make_chunked_ring_step_fns(
+    logp: Callable,
+    kernel,
+    mode: str,
+    num_shards: int,
+    n_local_data: int,
+    score_scale: float,
+    shard_data: bool = False,
+    batch_size: Optional[int] = None,
+    log_prior: Optional[Callable] = None,
+    phi_impl: str = "xla",
+    phi_batch_hint: int = 1,
+) -> dict:
+    """Per-shard pieces of the ring-φ SVGD step for **host-driven bounded-
+    dispatch execution** — the chunked step executor behind
+    ``DistSampler.run_steps(dispatch_budget=...)``.
+
+    The monolithic ring step runs all S ppermute hops inside one jitted
+    dispatch; at large n that single dispatch exceeds the TPU tunnel's
+    execution watchdog (the measured 2M-particle ceiling, docs/notes.md
+    large-n table).  This builder instead exposes the step's natural seams
+    as separately bindable per-shard functions whose carries are exactly the
+    resumable hop-loop state (:func:`_ring_local_hops` /
+    :func:`_ring_exact_score_hops` / :func:`_ring_exact_phi_hops`), so a
+    host loop can chain ``hops_per_dispatch``-hop dispatches with the
+    partial accumulator, visiting block, and travelling scores threaded
+    through a serializable carry — the same accumulation order as the
+    monolithic pass, hence trajectories allclose (tests/test_chunked.py),
+    at the measured ~0.2 ms marginal cost per chained dispatch
+    (docs/notes.md dispatch-relay table).
+
+    Returns a dict of builders:
+
+    - ``'local_hops'``: ``factory(num_hops, rotate_last) -> fn(block,
+      visiting, acc, data, t, key) -> (visiting, acc)`` — ``all_particles``
+      hop chunks.  Scores are recomputed per hop from the dispatch's own
+      ``(data, t, key)`` arguments; the per-shard minibatch draw folds the
+      same ``(key, r)`` in every chunk, so all chunks of a step see the
+      step's ONE minibatch, exactly like the monolithic pass.
+    - ``'score_hops'`` (``all_scores``): ``factory(num_hops) ->
+      fn(visiting, vscores, data, t, key) -> (visiting, vscores)`` —
+      score-pass chunks (every hop rotates; chunks compose freely).
+    - ``'exact_phi_hops'`` (``all_scores``): ``factory(num_hops,
+      rotate_last) -> fn(block, visiting, vscores, acc) -> (visiting,
+      vscores, acc)`` — φ-pass chunks over the (block, score) pairs.
+    - ``'add_prior'``: ``fn(visiting, vscores) -> vscores`` — the once-per-
+      step prior add between the two ``all_scores`` passes.  Row-wise
+      elementwise, so the executor applies it to the merged global arrays
+      directly (no collective inside).
+    - ``'finish'``: ``fn(block, acc, w_grad_block, step_size, h) ->
+      new_block`` — hop-mean normalisation plus the update (row-wise
+      elementwise, like ``add_prior``).
+
+    ``rotate_last=False`` is the terminal-chunk variant (elides the final
+    hop's wasted ppermute, matching the monolithic tail).  Jacobi only (the
+    ring has no Gauss–Seidel variant); fixed-bandwidth kernels only —
+    ``median_step``'s per-step bandwidth would need its own gathered-
+    subsample dispatch; resolve ``'median'`` once at construction instead.
+    """
+    if mode not in (ALL_PARTICLES, ALL_SCORES):
+        raise ValueError(
+            f"chunked ring stepping is defined for the all_* modes, got {mode!r}"
+        )
+    if isinstance(kernel, AdaptiveRBF):
+        raise ValueError(
+            "chunked ring stepping requires a fixed-bandwidth kernel: "
+            "kernel='median_step' resolves per step from a gathered "
+            "subsample the bounded-dispatch chain does not carry — use "
+            "kernel='median' (resolved once at construction) instead"
+        )
+    phi_fn, batched_score, batched_prior = _builder_prelude(
+        logp, kernel, phi_impl, log_prior, batch_size, n_local_data,
+        phi_batch_hint,
+    )
+    resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
+
+    def lik_score_env(dtype, data, t, key):
+        """The step's per-shard likelihood-score closure, reconstructed
+        identically in every chunk dispatch from the step's ``(data, t,
+        key)`` — one minibatch per shard per step (the same ``(key, r)``
+        fold the monolithic core draws)."""
+        r = lax.axis_index(AXIS)
+        data_local = resolve_data(data, t, r)
+        mb_scale = jnp.asarray(1.0, dtype=dtype)
+        if batch_size is not None:
+            data_local, scale = draw_minibatch(
+                jax.random.fold_in(key, r), data_local, n_local_data, batch_size
+            )
+            mb_scale = jnp.asarray(scale, dtype=dtype)
+        return lambda thetas: mb_scale * batched_score(thetas, data_local)
+
+    def local_hops(num_hops: int, rotate_last: bool):
+        def fn(block, visiting, acc, data, t, key):
+            lik = lik_score_env(block.dtype, data, t, key)
+            score_of = lambda th: score_scale * lik(th) + batched_prior(th)
+            return _ring_local_hops(
+                block, (visiting, acc), score_of, phi_fn, num_shards,
+                num_hops, rotate_last,
+            )
+
+        return fn
+
+    def score_hops(num_hops: int):
+        def fn(visiting, vscores, data, t, key):
+            lik = lik_score_env(visiting.dtype, data, t, key)
+            return _ring_exact_score_hops(
+                (visiting, vscores), lik, num_shards, num_hops
+            )
+
+        return fn
+
+    def exact_phi_hops(num_hops: int, rotate_last: bool):
+        def fn(block, visiting, vscores, acc):
+            return _ring_exact_phi_hops(
+                block, (visiting, vscores, acc), phi_fn, num_shards,
+                num_hops, rotate_last,
+            )
+
+        return fn
+
+    def add_prior(visiting, vscores):
+        return vscores + batched_prior(visiting)
+
+    def finish(block, acc, w_grad_block, step_size, h):
+        delta = acc / num_shards + h * w_grad_block
+        return block + step_size * delta
+
+    return {
+        "local_hops": local_hops,
+        "score_hops": score_hops,
+        "exact_phi_hops": exact_phi_hops,
+        "add_prior": add_prior,
+        "finish": finish,
+    }
 
 
 def make_shard_step_lagged(

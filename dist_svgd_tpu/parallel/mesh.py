@@ -26,7 +26,25 @@ from typing import Callable, Optional, Sequence
 import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # newer jax: public export, replication check named check_vma
+    from jax import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except ImportError:  # older jax (< 0.5): experimental home, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
+#: True on jax versions whose shard_map still lives in jax.experimental —
+#: a proxy for the old XLA pipeline whose sharding propagation crashes
+#: (SIGABRT, ``TileAssignment::Reshape`` check failure) when a collective-
+#: derived scalar feeds a ``ppermute`` loop body, the exact dataflow of the
+#: ring exchange's per-step median bandwidth.  ``DistSampler`` refuses that
+#: configuration on these versions with a clear error instead of letting
+#: the compiler kill the process (tests/test_adaptive_bandwidth.py runs it
+#: under the vmap emulation there, which is unaffected).
+SHARD_MAP_LEGACY = _SHARD_MAP_CHECK_KW == "check_rep"
 
 #: Name of the particle-sharding mesh axis used throughout the framework.
 AXIS = "shards"
@@ -70,12 +88,12 @@ def bind_shard_fn(
             return P() if s is None else P(*([None] * s + [AXIS]))
 
         sm_out = to_p(out_specs[0]) if single_out else tuple(to_p(s) for s in out_specs)
-        return shard_map(
+        return _shard_map(
             fn,
             mesh=mesh,
             in_specs=tuple(to_p(s) for s in in_specs),
             out_specs=sm_out,
-            check_vma=False,
+            **{_SHARD_MAP_CHECK_KW: False},
         )
 
     vf = jax.vmap(

@@ -40,6 +40,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dist_svgd_tpu.parallel.mesh import AXIS
 
 
+def _distributed_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` with a fallback for jax versions
+    that predate the public probe (< 0.5): the distributed client lives on
+    ``jax._src.distributed.global_state`` there."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    from jax._src import distributed as _dist
+
+    state = getattr(_dist, "global_state", None)
+    return state is not None and getattr(state, "client", None) is not None
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -60,7 +73,7 @@ def initialize(
     True when initialization happened.  An explicit ``coordinator_address``
     that cannot be honored always raises.
     """
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return False
     try:
         jax.distributed.initialize(
@@ -95,7 +108,9 @@ def initialize(
         # *fails* (connection refused, timeout — XlaRuntimeError subclasses)
         # must abort, or every worker would silently run an independent
         # exchange-free job with wrong results.
-        if coordinator_address is not None or "before any JAX calls" not in str(e):
+        too_late = ("before any JAX calls" in str(e)        # newer jax
+                    or "before any JAX computations" in str(e))  # < 0.5
+        if coordinator_address is not None or not too_late:
             raise
         warnings.warn(
             "jax.distributed could not auto-initialize (the XLA backend is "

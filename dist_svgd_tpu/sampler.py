@@ -141,6 +141,10 @@ class Sampler:
                 full = lambda theta: logp(theta, self._data)
         self._score_fn = jax.grad(full)
         self._compiled = {}
+        #: Execution report of the most recent :meth:`run` call (mode,
+        #: dispatch counts, steps per dispatch) — see ``DistSampler.
+        #: last_run_stats`` for the sharded counterpart.
+        self.last_run_stats = None
 
     # ------------------------------------------------------------------ #
 
@@ -189,9 +193,13 @@ class Sampler:
             return svgd_step_sequential(parts, self._score_fn, step_size, kernel)
 
         @partial(jax.jit, static_argnums=())
-        def run(particles, step_size, batch_key):
+        def run(particles, step_size, batch_key, i0):
+            # i0 offsets the per-step key fold so a budget-chunked run
+            # (dispatch_budget) draws the SAME minibatch stream as one
+            # monolithic scan — chunk boundaries are invisible to the RNG
             def body(parts, i):
-                new = one_step(parts, step_size, jax.random.fold_in(batch_key, i))
+                new = one_step(parts, step_size,
+                               jax.random.fold_in(batch_key, i0 + i))
                 if record:
                     return new, parts  # pre-update snapshot (reference convention)
                 return new, None
@@ -213,6 +221,8 @@ class Sampler:
         record: bool = True,
         initial_particles: Optional[jax.Array] = None,
         dtype=None,
+        dispatch_budget: Optional[float] = None,
+        pairs_per_sec: Optional[float] = None,
     ):
         """Raw-array variant of :meth:`sample`.
 
@@ -221,19 +231,31 @@ class Sampler:
         final state) or ``None`` when ``record=False``.  ``dtype`` defaults to
         the dtype of ``initial_particles`` when given, else float32.
 
+        ``dispatch_budget`` (seconds) splits the run into multiple scan
+        dispatches of at most that estimated duration (pair throughput from
+        ``pairs_per_sec``, default :data:`dist_svgd_tpu.distsampler.
+        DISPATCH_PAIRS_PER_SEC`) — the built-in form of the chunked-record
+        pattern below, with both of its caveats handled internally: the
+        per-step minibatch key fold is offset per chunk so the stream
+        equals the monolithic one, and chunk histories concatenate without
+        duplicate rows.  A single step that exceeds the budget cannot be
+        subdivided on one device (no hop seam — warn and run one step per
+        dispatch; the ``DistSampler`` ring executor is the tool past that
+        boundary).  Each call writes :attr:`last_run_stats`.
+
         Memory note: with ``record=True`` the whole ``(num_iter, n, d)``
         history stack lives in HBM for the duration of the call, and TPU
         lane padding makes each snapshot physically ``n × max(d, 128)``
         floats.  At large ``n`` drive recorded trajectories in budget-sized
-        chunks via repeated calls with ``initial_particles`` (the pattern
-        ``experiments/logreg.py:record_chunk_steps`` implements for the
-        distributed driver) instead of one long recorded call.  Two chunking
-        caveats: with ``batch_size`` set, vary ``seed`` per chunk (e.g.
-        ``seed=steps_done``) — a fixed seed replays the same minibatch-key
-        stream every chunk instead of a stochastic trajectory — and drop
-        each chunk's trailing history row before concatenating (it is the
-        chunk's final state, which reappears as the next chunk's first
-        pre-update snapshot).
+        chunks — ``dispatch_budget`` above, or manually via repeated calls
+        with ``initial_particles`` (the pattern ``experiments/logreg.py:
+        record_chunk_steps`` implements for the distributed driver).  Two
+        caveats the manual route must handle itself: with ``batch_size``
+        set, vary ``seed`` per chunk (e.g. ``seed=steps_done``) — a fixed
+        seed replays the same minibatch-key stream every chunk instead of a
+        stochastic trajectory — and drop each chunk's trailing history row
+        before concatenating (it is the chunk's final state, which
+        reappears as the next chunk's first pre-update snapshot).
         """
         if initial_particles is not None:
             particles = jnp.asarray(initial_particles, dtype=dtype)
@@ -241,12 +263,65 @@ class Sampler:
             particles = init_particles(as_key(seed), n, self._d, dtype=dtype or jnp.float32)
         if self._median_kernel:
             self._resolve_median_kernel(particles)
-        run = self._run_fn(num_iter, record)
-        final, hist = run(
-            particles, jnp.asarray(step_size, dtype=particles.dtype), minibatch_key(seed)
-        )
+        eps = jnp.asarray(step_size, dtype=particles.dtype)
+        bkey = minibatch_key(seed)
+        steps_per_dispatch = num_iter
+        if dispatch_budget is not None:
+            if dispatch_budget <= 0:
+                raise ValueError(
+                    f"dispatch_budget must be positive, got {dispatch_budget}"
+                )
+            from dist_svgd_tpu.distsampler import DISPATCH_PAIRS_PER_SEC
+
+            pps = float(pairs_per_sec if pairs_per_sec is not None
+                        else DISPATCH_PAIRS_PER_SEC)
+            t_step = float(n) * float(n) / pps
+            if t_step > dispatch_budget:
+                import warnings
+
+                warnings.warn(
+                    f"one {n}-particle step (~{t_step:.1f} s at {pps:.2e} "
+                    f"pairs/s) exceeds dispatch_budget={dispatch_budget} s "
+                    "and the single-device step has no internal seam to "
+                    "split at; running one step per dispatch — shard over "
+                    "DistSampler's ring executor to chunk inside a step",
+                    stacklevel=2,
+                )
+            steps_per_dispatch = max(1, min(num_iter, int(dispatch_budget // max(t_step, 1e-30))))
+        if steps_per_dispatch >= num_iter:
+            run = self._run_fn(num_iter, record)
+            final, hist = run(particles, eps, bkey,
+                              jnp.asarray(0, jnp.int32))
+            self.last_run_stats = {
+                "execution": "monolithic", "num_steps": num_iter,
+                "num_dispatches": 1,
+                "dispatches_per_step": round(1 / max(num_iter, 1), 4),
+                "steps_per_dispatch": num_iter,
+            }
+            if record:
+                hist = jnp.concatenate([hist, final[None]], axis=0)
+            return final, hist
+        from dist_svgd_tpu.distsampler import _chunk_sizes
+
+        hists = []
+        final = particles
+        done = 0
+        sizes = _chunk_sizes(num_iter, steps_per_dispatch)
+        for csize in sizes:  # ≤ 2 distinct sizes → ≤ 2 compiled programs
+            run = self._run_fn(csize, record)
+            final, hist = run(final, eps, bkey, jnp.asarray(done, jnp.int32))
+            if record:
+                hists.append(hist)
+            done += csize
+        self.last_run_stats = {
+            "execution": "scan_chunks", "num_steps": num_iter,
+            "num_dispatches": len(sizes),
+            "dispatches_per_step": round(len(sizes) / num_iter, 4),
+            "steps_per_dispatch": steps_per_dispatch,
+        }
+        hist = None
         if record:
-            hist = jnp.concatenate([hist, final[None]], axis=0)
+            hist = jnp.concatenate(hists + [final[None]], axis=0)
         return final, hist
 
     def sample(

@@ -133,29 +133,41 @@ def assemble_full_state(paths) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     keys = {k for s in states for k in s if not k.endswith("_start")}
     for key in keys:
-        parts = [
-            (int(np.asarray(s.get(key + "_start", 0))), s[key])
-            for s in states if s.get(key) is not None
-        ]
-        if not parts:
+        # classify replicated-vs-block from the first state that actually
+        # CONTAINS the key — classifying from states[0] alone turned a
+        # mixed-version/corrupt save (key present only in later files) into
+        # a bare KeyError instead of the diagnosis below (ADVICE round 5)
+        holders = [s for s in states if s.get(key) is not None]
+        if not holders:
             out[key] = None
             continue
-        if key + "_start" not in states[0]:
-            # a scalar/replicated entry (t): must be identical in every
-            # file — a mismatch means the paths mix two different saves
-            # (the contiguity check below cannot catch that when the row
-            # layouts happen to line up)
-            for s in states[1:]:
+        has_start = any(key + "_start" in s for s in holders)
+        if not has_start:
+            # a scalar/replicated entry (t): must be present and identical
+            # in every file — a mismatch (or partial presence) means the
+            # paths mix two different saves (the contiguity check below
+            # cannot catch that when the row layouts happen to line up)
+            if len(holders) != len(states):
+                raise ValueError(
+                    f"checkpoint files disagree on the presence of {key!r} "
+                    f"({len(holders)} of {len(states)} files carry it) — "
+                    "are these paths from one complete multi-host save?"
+                )
+            for s in holders[1:]:
                 if not np.array_equal(np.asarray(s[key]),
-                                      np.asarray(states[0][key])):
+                                      np.asarray(holders[0][key])):
                     raise ValueError(
                         f"checkpoint files disagree on {key!r} "
-                        f"({np.asarray(states[0][key])} vs "
+                        f"({np.asarray(holders[0][key])} vs "
                         f"{np.asarray(s[key])}) — are these paths from one "
                         "complete multi-host save?"
                     )
-            out[key] = states[0][key]
+            out[key] = holders[0][key]
             continue
+        parts = [
+            (int(np.asarray(s.get(key + "_start", 0))), s[key])
+            for s in holders
+        ]
         parts.sort(key=lambda p: p[0])
         cursor = 0
         for start, rows in parts:

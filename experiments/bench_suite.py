@@ -353,7 +353,71 @@ def scaling_table_10k(iters, world_sizes=(1, 2, 4, 8), n_particles=10_000,
         rows.append(_result(
             f"{label}:ws{ws}", sampler.num_particles, iters, wall,
             num_shards=ws, emulated=_emulated(ws), exchange="partitions",
-            **({"wasserstein": True} if wasserstein else {}),
+            **({"wasserstein": True, "w2_pairing": sampler.w2_pairing}
+               if wasserstein else {}),
+        ))
+    return rows
+
+
+# --------------------------------------------------------------------- #
+# Chunked-vs-monolithic A/B (bounded multi-dispatch stepping)
+
+
+def bench_chunked_ab(iters, num_shards=8, n_particles=10_000):
+    """A/B of the bounded multi-dispatch executor against the monolithic
+    scan at a size where BOTH clear the watchdog — this measures the pure
+    *chunking overhead* (per-dispatch relay cost × dispatches/step), the
+    price the 2M+ rows pay to exist at all (tools/large_n.py measures those;
+    docs/notes.md large-n table).
+
+    Config: banana logreg, ring ``all_particles`` exchange (the
+    implementation with an intra-step hop seam), ``hops_per_dispatch=1`` —
+    the finest chunking, hence the worst-case overhead.  Emits one row per
+    execution; the chunked row records ``dispatches_per_step`` and
+    ``max_dispatch_wall_s`` from ``DistSampler.last_run_stats``."""
+    import jax.numpy as jnp
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.logreg import logreg_logp
+    from dist_svgd_tpu.utils.datasets import load_benchmark
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    fold = load_benchmark("banana", 42)
+    data = (jnp.asarray(fold.x_train), jnp.asarray(fold.t_train.reshape(-1)))
+    d = 1 + fold.x_train.shape[1]
+
+    def build():
+        return dt.DistSampler(
+            num_shards, logreg_logp, None,
+            init_particles_per_shard(0, n_particles, d, num_shards),
+            data=data, exchange_particles=True, exchange_scores=False,
+            include_wasserstein=False, exchange_impl="ring",
+        )
+
+    rows = []
+    for label, kwargs in (
+        ("monolithic", {}),
+        ("chunked", dict(hops_per_dispatch=1)),
+    ):
+        sampler = build()
+        # the timed runs never fence per dispatch (that would serialise the
+        # chained dispatches and bill the relay round-trips to the chunked
+        # leg); per-dispatch walls come from one extra fenced run below
+        wall = _time_dist_steps(sampler, iters, 3e-3, **kwargs)
+        stats = sampler.last_run_stats or {}
+        extra = {"execution": label, "exchange_impl": "ring"}
+        if label == "chunked":
+            sampler.run_steps(iters, 3e-3, hops_per_dispatch=1,
+                              time_dispatches=True)
+            stats = sampler.last_run_stats or {}
+            extra.update(
+                dispatches_per_step=stats.get("dispatches_per_step"),
+                max_dispatch_wall_s=stats.get("max_dispatch_wall_s"),
+                hops_per_dispatch=1,
+            )
+        rows.append(_result(
+            f"chunked-ab:{label}", sampler.num_particles, iters, wall,
+            num_shards=num_shards, emulated=_emulated(num_shards), **extra,
         ))
     return rows
 
@@ -475,6 +539,10 @@ _CONFIGS = {
 @click.option("--scaling-w2/--no-scaling-w2", default=False,
               help="also run the 10k-particle partitions+W2 scaling table "
                    "(the W2 step's own n²/S mechanism; docs/notes.md)")
+@click.option("--chunked-ab/--no-chunked-ab", default=False,
+              help="also run the bounded multi-dispatch chunked-vs-"
+                   "monolithic A/B (ring exchange, hops_per_dispatch=1 — "
+                   "the chunking-overhead measurement; docs/notes.md)")
 @click.option("--table", is_flag=True, help="print markdown tables at the end")
 @click.option("--backend", default="auto",
               type=click.Choice(["auto", "tpu", "cpu"]))
@@ -484,7 +552,7 @@ _CONFIGS = {
                    "for configs 4/5; 'auto' runs it on TPU only (the CPU "
                    "fallback is a smoke run, not an acceptance run)")
 def cli(configs, iters, scaling, scaling_iters, scaling_10k, scaling_w2,
-        table, backend, acceptance):
+        chunked_ab, table, backend, acceptance):
     select_backend(backend)
     acc_on = acceptance == "on" or (
         acceptance == "auto" and _platform() == "tpu"
@@ -509,6 +577,9 @@ def cli(configs, iters, scaling, scaling_iters, scaling_10k, scaling_w2,
             print(json.dumps(r), flush=True)
     if scaling_w2:
         for r in scaling_table_w2(iters):
+            print(json.dumps(r), flush=True)
+    if chunked_ab:
+        for r in bench_chunked_ab(iters):
             print(json.dumps(r), flush=True)
     if table:
         print()

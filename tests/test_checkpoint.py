@@ -366,3 +366,28 @@ def test_assemble_full_state_guards(tmp_path):
     gap = save("e", 8, 3, 2.0)  # rows 4..7 missing
     with pytest.raises(ValueError, match="contiguous"):
         assemble_full_state([a, gap])
+
+
+def test_assemble_full_state_mixed_key_presence_is_valueerror(tmp_path):
+    """A replicated key present only in SOME files (mixed-version or
+    corrupt saves) must raise the 'one complete save?' ValueError — the
+    states[0]-only classification used to turn this into a bare KeyError
+    when the key was missing from the first file (ADVICE round 5)."""
+    from dist_svgd_tpu.utils.checkpoint import assemble_full_state, save_state
+
+    def save(name, state):
+        save_state(str(tmp_path / name), state)
+        return str(tmp_path / name)
+
+    base = {"particles": np.zeros((4, 2), np.float32),
+            "particles_start": np.int64(0), "t": np.int64(1)}
+    other = {"particles": np.ones((4, 2), np.float32),
+             "particles_start": np.int64(4), "t": np.int64(1),
+             "extra_scalar": np.float64(7.0)}  # only in the SECOND file
+    a, b = save("a", base), save("b", other)
+    with pytest.raises(ValueError, match="complete multi-host save"):
+        assemble_full_state([a, b])
+    # same failure regardless of file order (the old bug was order-
+    # dependent: KeyError only when the poor file came first)
+    with pytest.raises(ValueError, match="complete multi-host save"):
+        assemble_full_state([b, a])

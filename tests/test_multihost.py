@@ -13,8 +13,17 @@ import pytest
 
 import dist_svgd_tpu as dt
 from dist_svgd_tpu.models.gmm import gmm_logp
-from dist_svgd_tpu.parallel.mesh import AXIS
+from dist_svgd_tpu.parallel.mesh import AXIS, SHARD_MAP_LEGACY
 from dist_svgd_tpu.parallel import multihost
+
+# The CPU federation legs need cross-process collectives on the CPU backend,
+# which jax < 0.5 does not implement (XlaRuntimeError: "Multiprocess
+# computations aren't implemented on the CPU backend") — the capability the
+# whole federation fixture exists to exercise.
+needs_cpu_multiprocess = pytest.mark.skipif(
+    SHARD_MAP_LEGACY,
+    reason="jax < 0.5 CPU backend lacks multiprocess collectives",
+)
 
 
 def test_initialize_is_noop_single_process():
@@ -158,6 +167,7 @@ def _assemble(tmp_path, nprocs: int, n: int, d: int, rows_tpl: str,
     return got
 
 
+@needs_cpu_multiprocess
 def test_two_process_federation_matches_oracle(tmp_path):
     """REAL multi-process coverage: two OS processes, 4 virtual CPU devices
     each, federated by ``jax.distributed`` into one 8-shard mesh.  Exercises
@@ -203,6 +213,7 @@ def test_two_process_federation_matches_oracle(tmp_path):
     np.testing.assert_allclose(got_l, want_l, rtol=2e-6, atol=2e-7)
 
 
+@needs_cpu_multiprocess
 def test_four_process_federation_matches_oracle(tmp_path):
     """4-process federation, 2 virtual CPU devices per process — the
     granule-major hybrid mesh with >1 device per granule
@@ -236,6 +247,7 @@ def test_four_process_federation_matches_oracle(tmp_path):
     np.testing.assert_allclose(got_s, want_s, rtol=2e-6, atol=2e-7)
 
 
+@needs_cpu_multiprocess
 def test_cross_process_count_restore(tmp_path):
     """Cross-process-count restore (round-5, VERDICT r04 item 7): a
     4-process federation saves mid-trajectory (W2 on — the carried snapshot

@@ -134,6 +134,44 @@ def test_partitions_rejects_global_pairing():
     assert ds._block_w2
 
 
+def test_partitions_accepts_any_pairing_when_w2_off():
+    """With the W2 term off the option is FULLY inert, as documented —
+    generic config code passing the same kwargs with W2 disabled must not
+    get a spurious partitions-mode rejection (ADVICE round 5)."""
+    rng = np.random.default_rng(3)
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, num_shards=2)
+    for pairing in ("auto", "global", "block"):
+        ds = build(particles, data, 2, pairing=pairing, exch_p=False,
+                   w2=False)
+        assert ds.w2_pairing == "block"  # the mode's native pairing
+        assert np.isfinite(np.asarray(ds.make_step(0.05))).all()
+    # typos still rejected, W2 on or off
+    with pytest.raises(ValueError, match="w2_pairing"):
+        build(particles, data, 2, pairing="bogus", exch_p=False, w2=False)
+
+
+def test_state_dict_records_resolved_pairing():
+    """The RESOLVED pairing (after 'auto' routing) travels with the
+    checkpoint, so runs straddling the auto-switch boundary stay
+    distinguishable; restoring under a different resolution warns."""
+    from dist_svgd_tpu.distsampler import W2_PAIRING_CODES
+
+    rng = np.random.default_rng(3)
+    particles, data, _ = make_gaussian_problem(rng, n=8, d=2, num_shards=2)
+    g = build(particles, data, 2, pairing="global")
+    assert g.w2_pairing == "global"
+    state = g.state_dict()
+    assert W2_PAIRING_CODES[int(np.asarray(state["w2_pairing"]))] == "global"
+    # same-pairing restore: silent
+    twin = build(particles, data, 2, pairing="global")
+    twin.load_state_dict(state)
+    # cross-pairing restore: the exact reshard still happens, with a warning
+    blk = build(particles, data, 2, pairing="block")
+    g.make_step(0.05, h=0.5)
+    with pytest.warns(UserWarning, match="different W2 functionals"):
+        blk.load_state_dict(g.state_dict())
+
+
 def test_unknown_pairing_rejected():
     rng = np.random.default_rng(3)
     particles, data, _ = make_gaussian_problem(rng, n=8, d=2, num_shards=2)

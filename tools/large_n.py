@@ -1,4 +1,4 @@
-"""Reproduce the 100k/1M-particle single-chip rows of docs/notes.md.
+"""Reproduce the 100k–4M single-chip rows of docs/notes.md.
 
 Runs the full fused sampler step (Pallas φ + ``vmap(grad)`` banana scores)
 at large n on one chip, where the kernel's VMEM tile streaming is the whole
@@ -9,14 +9,28 @@ repo protocol: chained scanned dispatches, scalar-fetch fenced, best of
 Usage: ``python tools/large_n.py [--n 100000] [--steps 10] [--samples 3]``
 (n=1M takes ~6 s/step — budget a minute per sample).
 
-``--w2`` instead measures the 8-shard scanned **Sinkhorn-W2** step at the
+``--w2`` instead measures the sharded scanned **Sinkhorn-W2** step at the
 same n via the O(n·d)-memory streaming solve with warm-started duals
 (``ops/pallas_ot.py``; each shard's (n/8, n) kernel matrix — 500 GB at
 n=1M — never exists).  Budget minutes per sample at n=1M: a W2 step is
 ~5 streamed passes over n²/8 pairs even fully warm.
+
+**Chunked stepping** (the 2M/4M rows): past ~2M particles one step is a
+single ≳60 s dispatch and the tunnel's execution watchdog kills it — pass
+``--dispatch-budget <seconds>`` (auto-chunking via the measured pairs/sec
+heuristic) or the explicit ``--hops-per-dispatch`` /
+``--max-passes-per-dispatch`` knobs to run the same trajectory as a chain
+of bounded dispatches (``DistSampler.run_steps(dispatch_budget=...)``;
+requires ``--exchange-impl ring`` for the φ split).  ``--ab`` measures the
+chunked execution **and** the monolithic one at the same config (the
+chunking-overhead A/B — only meaningful where the monolithic dispatch
+still clears the watchdog).  Every row is also emitted as a JSON record
+(``--json-out`` appends to a file) carrying ``dispatches_per_step``,
+``max_dispatch_wall_s``, and the **resolved** ``w2_pairing``.
 """
 
 import argparse
+import json
 import sys
 import time
 from pathlib import Path
@@ -28,8 +42,34 @@ import jax
 import jax.numpy as jnp
 
 import dist_svgd_tpu as dt
+from dist_svgd_tpu.distsampler import W2_GLOBAL_PAIRING_MAX_N
 from dist_svgd_tpu.models.logreg import make_logreg_logp
 from dist_svgd_tpu.utils.datasets import load_benchmark
+
+
+def resolve_ring_pairing(n: int, exchange: str, exchange_impl: str,
+                         w2_pairing: str) -> str:
+    """Pre-resolve ``--w2-pairing auto`` for the ring implementation.
+
+    'auto' resolves to the global pairing at or below
+    :data:`~dist_svgd_tpu.distsampler.W2_GLOBAL_PAIRING_MAX_N` (the same
+    constant the library routes on — compared directly so the tool cannot
+    silently desync from it, ADVICE round 5), which the ring implementation
+    rejects (its snapshot is the gathered set) — the only pairing ring can
+    measure is 'block', so select it here rather than erroring after
+    construction."""
+    if (exchange_impl == "ring" and exchange != "partitions"
+            and w2_pairing == "auto" and n <= W2_GLOBAL_PAIRING_MAX_N):
+        return "block"
+    return w2_pairing
+
+
+def emit(record: dict, json_out) -> None:
+    line = json.dumps(record)
+    print(line, flush=True)
+    if json_out:
+        with open(json_out, "a") as f:
+            f.write(line + "\n")
 
 
 def main():
@@ -38,8 +78,12 @@ def main():
     ap.add_argument("--steps", type=int, default=10,
                     help="steps per timed dispatch")
     ap.add_argument("--samples", type=int, default=3)
+    ap.add_argument("--shards", type=int, default=8,
+                    help="mesh size S for --w2 (vmap-emulated on one chip)."
+                         "  Chunking granularity is S: at 2M+ raising S "
+                         "shrinks the per-hop dispatch (n²/S pairs)")
     ap.add_argument("--w2", action="store_true",
-                    help="measure the 8-shard scanned Sinkhorn-W2 step "
+                    help="measure the sharded scanned Sinkhorn-W2 step "
                          "(streaming solve, warm duals) instead of the "
                          "plain step")
     ap.add_argument("--exchange", default="all_particles",
@@ -57,15 +101,18 @@ def main():
                     help="all_* exchange implementation for --w2.  'ring' "
                          "composes with the block W2 pairing only: blockwise "
                          "ppermute φ + block-sized W2 state — no gathered "
-                         "(n, d) set at all, the fully O(n/S)-memory step")
+                         "(n, d) set at all, the fully O(n/S)-memory step, "
+                         "and the only implementation with an intra-step "
+                         "seam for --dispatch-budget / --hops-per-dispatch")
     ap.add_argument("--w2-pairing", default="auto",
                     choices=["auto", "global", "block"],
                     help="exchanged-mode W2 pairing (DistSampler.w2_pairing)."
                          "  'auto' routes to the block pairing above the "
-                         "measured 400k global-pairing ceiling with a "
-                         "warning; 'global' forces the reference pairing "
-                         "onto the cliff (the A/B for the scaling table); "
-                         "'block' forces the scalable pairing at any n")
+                         "measured global-pairing ceiling "
+                         f"({W2_GLOBAL_PAIRING_MAX_N}) with a warning; "
+                         "'global' forces the reference pairing onto the "
+                         "cliff (the A/B for the scaling table); 'block' "
+                         "forces the scalable pairing at any n")
     ap.add_argument("--stepsize", type=float, default=3e-3)
     ap.add_argument("--sinkhorn-iters", type=int, default=200,
                     help="per-step solve iteration cap.  At n = 1M a COLD "
@@ -74,29 +121,57 @@ def main():
                          "across steps — the carried dual makes the solve "
                          "resumable, converging incrementally while "
                          "particles barely move (inexact JKO proximal "
-                         "steps; docs/notes.md round-4)")
+                         "steps; docs/notes.md round-4).  With "
+                         "--max-passes-per-dispatch the cap no longer needs "
+                         "to double as the dispatch bound")
+    ap.add_argument("--dispatch-budget", type=float, default=None,
+                    help="per-dispatch wall budget (seconds): auto-chunk "
+                         "the step so no single dispatch exceeds it "
+                         "(run_steps dispatch_budget; keep it well under "
+                         "the ~60 s watchdog — 10–20 s is comfortable)")
+    ap.add_argument("--pairs-per-sec", type=float, default=None,
+                    help="pair-throughput estimate feeding the budget "
+                         "heuristic (default: the measured v5e rate, "
+                         "distsampler.DISPATCH_PAIRS_PER_SEC)")
+    ap.add_argument("--hops-per-dispatch", type=int, default=None,
+                    help="explicit ring-hop chunk size (1..S); bypasses "
+                         "the budget heuristic")
+    ap.add_argument("--max-passes-per-dispatch", type=int, default=None,
+                    help="explicit Sinkhorn pass chunk size; bypasses the "
+                         "budget heuristic")
+    ap.add_argument("--ab", action="store_true",
+                    help="chunked-vs-monolithic A/B: measure both "
+                         "executions at this config and emit both records")
+    ap.add_argument("--json-out", type=str, default=None,
+                    help="append one JSON record per measured row here")
     args = ap.parse_args()
 
     print("devices:", jax.devices(), flush=True)
     fold = load_benchmark("banana", 42)
     d = 1 + fold.x_train.shape[1]
     n = args.n
+    chunk_kwargs = {}
+    if args.dispatch_budget is not None:
+        chunk_kwargs = dict(dispatch_budget=args.dispatch_budget,
+                            pairs_per_sec=args.pairs_per_sec)
+    elif (args.hops_per_dispatch is not None
+          or args.max_passes_per_dispatch is not None):
+        chunk_kwargs = dict(
+            hops_per_dispatch=args.hops_per_dispatch,
+            max_passes_per_dispatch=args.max_passes_per_dispatch)
+    chunked = bool(chunk_kwargs)
 
     if args.w2:
         from dist_svgd_tpu.models.logreg import logreg_logp
         from dist_svgd_tpu.utils.rng import init_particles_per_shard
 
-        S = 8
-        if (args.exchange_impl == "ring" and args.exchange != "partitions"
-                and args.w2_pairing == "auto" and args.n <= 400_000):
-            # 'auto' resolves to the global pairing below the route
-            # threshold, which the ring implementation rejects (its
-            # snapshot is the gathered set) — the only pairing ring can
-            # measure is 'block', so select it rather than erroring after
-            # construction
+        S = args.shards
+        resolved = resolve_ring_pairing(
+            args.n, args.exchange, args.exchange_impl, args.w2_pairing)
+        if resolved != args.w2_pairing:
             print("exchange-impl=ring: resolving --w2-pairing auto -> "
                   "block (the only ring-compatible pairing)", flush=True)
-            args.w2_pairing = "block"
+            args.w2_pairing = resolved
         ds = dt.DistSampler(
             S, logreg_logp, None, init_particles_per_shard(0, n, d, S),
             data=(jnp.asarray(fold.x_train),
@@ -108,29 +183,80 @@ def main():
             w2_pairing=args.w2_pairing,
             exchange_impl=args.exchange_impl,
         )
+
+        def run_block(num_steps, **kw):
+            np.asarray(ds.run_steps(num_steps, args.stepsize, h=10.0,
+                                    **kw))[0, 0]
+
         # warm up with SINGLE-step dispatches: the very first steps solve
         # cold (w_on=0 placeholder, then a full cold solve) and at n = 1M a
         # multi-step cold dispatch runs long enough to trip the tunnel's
         # execution watchdog (observed as "TPU worker crashed") — warm
-        # steps are several times faster and chain safely
+        # steps are several times faster and chain safely.  Chunked warmup
+        # uses the chunked executor itself, so even the cold solve stays
+        # under the budget
         for _ in range(max(args.steps, 2)):
-            np.asarray(ds.run_steps(1, args.stepsize, h=10.0))[0, 0]
-        # compile the args.steps-length scan untimed (run_steps compiles one
-        # program per num_steps; the solve is warm by now so the multi-step
-        # dispatch stays under the watchdog)
-        np.asarray(ds.run_steps(args.steps, args.stepsize, h=10.0))[0, 0]
-        best = float("inf")
-        for _ in range(args.samples):
-            t0 = time.perf_counter()
-            np.asarray(ds.run_steps(args.steps, args.stepsize, h=10.0))[0, 0]
-            best = min(best, (time.perf_counter() - t0) / args.steps)
-        print(
-            f"n={n} W2 streaming warm ({args.exchange}/{args.exchange_impl}, "
-            f"pairing {ds._w2_pairing}, S={S}, stepsize "
-            f"{args.stepsize}): {best*1e3:.0f} ms/step "
-            f"({n/best/1e3:.0f}k updates/s)",
-            flush=True,
-        )
+            run_block(1, **chunk_kwargs)
+
+        def measure(kw, fenced_stats=False):
+            """Compile untimed, then best-of-samples.  The throughput
+            timing never fences per dispatch (time_dispatches would block
+            the chain and bill the relay round-trips to the chunked leg —
+            the A/B must compare pipelined executions); per-dispatch walls
+            come from ONE extra fenced run afterwards."""
+            run_block(args.steps, **kw)
+            best = float("inf")
+            for _ in range(args.samples):
+                t0 = time.perf_counter()
+                run_block(args.steps, **kw)
+                best = min(best, (time.perf_counter() - t0) / args.steps)
+            stats = ds.last_run_stats
+            if fenced_stats:
+                run_block(args.steps, **dict(kw, time_dispatches=True))
+                stats = ds.last_run_stats
+            return best, stats
+
+        variants = []
+        if chunked:
+            variants.append(("chunked", chunk_kwargs))
+            if args.ab:
+                variants.append(("monolithic", {}))
+        else:
+            variants.append(("monolithic", {}))
+            if args.ab:
+                variants.append(("chunked", dict(hops_per_dispatch=1)))
+        for label, kw in variants:
+            best, stats = measure(kw, fenced_stats=(label == "chunked"))
+            record = {
+                "bench": "large_n_w2", "n": n, "num_shards": S,
+                "execution": label, "exchange": args.exchange,
+                "exchange_impl": args.exchange_impl,
+                "w2_pairing": ds.w2_pairing,
+                "sinkhorn_iters": args.sinkhorn_iters,
+                "stepsize": args.stepsize,
+                "wall_per_step_s": round(best, 4),
+                "updates_per_sec": round(n / best, 1),
+            }
+            if stats is not None and label == "chunked":
+                record.update({
+                    "dispatches_per_step": stats["dispatches_per_step"],
+                    "num_dispatches": stats["num_dispatches"],
+                    "max_dispatch_wall_s":
+                        None if stats["max_dispatch_wall_s"] is None
+                        else round(stats["max_dispatch_wall_s"], 4),
+                    "hops_per_dispatch": stats.get("hops_per_dispatch"),
+                    "max_passes_per_dispatch":
+                        stats.get("max_passes_per_dispatch"),
+                    "dispatch_budget_s": stats.get("dispatch_budget_s"),
+                })
+            emit(record, args.json_out)
+            print(
+                f"n={n} W2 streaming warm ({args.exchange}/"
+                f"{args.exchange_impl}, pairing {ds.w2_pairing}, S={S}, "
+                f"stepsize {args.stepsize}, {label}): {best*1e3:.0f} "
+                f"ms/step ({n/best/1e3:.0f}k updates/s)",
+                flush=True,
+            )
         return
 
     logp = make_logreg_logp(fold.x_train, fold.t_train.reshape(-1))
@@ -138,7 +264,10 @@ def main():
 
     def run_once(parts):
         out, _ = sampler.run(
-            n, args.steps, args.stepsize, record=False, initial_particles=parts
+            n, args.steps, args.stepsize, record=False,
+            initial_particles=parts,
+            dispatch_budget=args.dispatch_budget,
+            pairs_per_sec=args.pairs_per_sec,
         )
         return out
 
@@ -151,6 +280,16 @@ def main():
         out = run_once(out)  # state-chained: no dispatch can be elided
         np.asarray(out)[0, 0]
         best = min(best, (time.perf_counter() - t0) / args.steps)
+    stats = sampler.last_run_stats or {}
+    emit({
+        "bench": "large_n_phi", "n": n, "stepsize": args.stepsize,
+        "execution": stats.get("execution", "monolithic"),
+        "num_dispatches": stats.get("num_dispatches"),
+        "dispatches_per_step": stats.get("dispatches_per_step"),
+        "wall_per_step_s": round(best, 6),
+        "pairs_per_sec": round(n * n / best, 1),
+        "updates_per_sec": round(n / best, 1),
+    }, args.json_out)
     print(
         f"n={n}: {best*1e3:.1f} ms/step  "
         f"({n*n/best/1e9:.0f} G pairs/s, {n/best/1e6:.2f}M updates/s)",
