@@ -11,6 +11,9 @@ implementation `Sandy4321/dist-svgd` (see SURVEY.md):
                      and the Wasserstein/JKO term (host LP + on-device Sinkhorn)
 - `models`         — GMM and Bayesian logistic regression log-densities
 - `parallel`       — mesh utilities + SPMD exchange strategies
+- `serving`        — posterior-predictive serving of checkpointed ensembles
+                     (micro-batched engine + HTTP front end; import
+                     `dist_svgd_tpu.serving` explicitly — not loaded here)
 - `utils`          — datasets, history recording, RNG helpers
 
 Where the reference evaluates k(x, y) and its autograd one particle-pair at a

@@ -1,0 +1,27 @@
+"""Posterior-predictive serving over checkpointed SVGD ensembles.
+
+Training produces a converged particle set — which, per SVGD's construction
+(Liu & Wang 2016, PAPER.md Algorithm 1), *is* the posterior.  This package
+turns a checkpointed ensemble into a low-latency prediction service:
+
+- :mod:`engine`  — :class:`PredictiveEngine`: loads an ensemble from any
+  checkpoint layout (single save, ``CheckpointManager`` root, or a
+  multi-process save's per-process block files), registers per-model jitted
+  predictive kernels, and serves them through a shape-bucketed compile cache
+  (request batches pad up to power-of-two buckets, so steady-state traffic
+  never recompiles);
+- :mod:`batcher` — :class:`MicroBatcher`: coalesces concurrent requests into
+  one fused device call over the whole ensemble, scatters results back
+  per-request, sheds on overflow instead of queueing unboundedly;
+- :mod:`server`  — a thin stdlib HTTP front end (``/predict``, ``/healthz``,
+  ``/metrics``) with graceful drain and structured per-request records.
+
+The load generator lives in ``tools/serve_bench.py``; the covertype
+train → checkpoint → serve demo in ``experiments/serve_covertype.py``.
+"""
+
+from dist_svgd_tpu.serving.batcher import MicroBatcher, Overloaded
+from dist_svgd_tpu.serving.engine import PredictiveEngine
+from dist_svgd_tpu.serving.server import PredictionServer
+
+__all__ = ["PredictiveEngine", "MicroBatcher", "Overloaded", "PredictionServer"]

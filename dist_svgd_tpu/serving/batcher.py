@@ -1,0 +1,330 @@
+"""Micro-batching request queue: coalesce concurrent predict requests into
+one fused device call, scatter results back per-request.
+
+Why: a TPU/XLA predictive kernel has a per-dispatch floor that dwarfs the
+marginal cost of extra rows (docs/notes.md step-floor decomposition) — N
+concurrent 1-row dispatches waste N-1 floors.  The batcher holds the first
+request of a batch for at most ``max_wait_ms`` while coalescing whatever
+else arrives, up to ``max_batch`` rows, then issues ONE dispatch over the
+whole ensemble and slices the result back to each caller's future.
+
+Backpressure is explicit: the queue is bounded at ``max_queue_rows`` and
+``submit`` raises :class:`Overloaded` instead of growing without bound — a
+shed request costs the client one clean error, an unbounded queue costs
+every client unbounded latency.
+
+Oversize requests (> ``max_batch`` rows) split into ``max_batch``-row chunks
+that ride separate batches and reassemble before the future resolves — a
+request can never deadlock waiting for a batch slot bigger than batches get.
+
+Time is injectable (``clock`` + ``wait``) so tests drive ``max_wait_ms``
+expiry deterministically instead of real-sleeping (tier-1 has no
+multi-hundred-ms waits); production uses ``time.monotonic`` and plain
+condition waits.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import CancelledError, Future
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Overloaded(RuntimeError):
+    """Raised by :meth:`MicroBatcher.submit` when the bounded queue is full."""
+
+
+def _default_wait(cond: threading.Condition, timeout: Optional[float]) -> bool:
+    return cond.wait(timeout)
+
+
+class _Request:
+    """One client submit(): a future plus chunk-reassembly state."""
+
+    __slots__ = ("future", "n_chunks", "parts", "enqueued")
+
+    def __init__(self, n_chunks: int, enqueued: float):
+        self.future: Future = Future()
+        self.n_chunks = n_chunks
+        self.parts: List[Optional[Dict[str, np.ndarray]]] = [None] * n_chunks
+        self.enqueued = enqueued
+
+
+class _Chunk:
+    """A ≤ max_batch slice of one request, as queued."""
+
+    __slots__ = ("x", "req", "index")
+
+    def __init__(self, x: np.ndarray, req: _Request, index: int):
+        self.x = x
+        self.req = req
+        self.index = index
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class MicroBatcher:
+    """Coalescing dispatch queue in front of a ``dispatch(x) -> dict`` callable
+    (typically :meth:`PredictiveEngine.predict`).
+
+    Args:
+        dispatch: called with one ``(rows, feature_dim)`` array per batch;
+            must return a dict of arrays with leading dimension ``rows``.
+        max_batch: coalescing ceiling in rows; larger requests split.
+        max_wait_ms: how long the oldest queued request may wait for
+            co-travellers before a partial batch is flushed.
+        max_queue_rows: bound on queued (not-yet-dispatched) rows; beyond it
+            ``submit`` sheds with :class:`Overloaded`.
+        clock / wait: injectable time source and condition-wait, for
+            deterministic tests.  ``wait(cond, timeout)`` must behave like
+            ``cond.wait`` (held lock, returns after notify or timeout).
+        logger: optional ``JsonlLogger``; one record per dispatched batch
+            (rows, request count, queue-wait vs device-time split).
+        autostart: start the worker thread immediately.  Tests that need a
+            deterministic pre-filled queue pass False, submit, then
+            :meth:`start`.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[np.ndarray], Dict[str, np.ndarray]],
+        *,
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+        max_queue_rows: int = 8192,
+        clock: Callable[[], float] = time.monotonic,
+        wait: Callable[[threading.Condition, Optional[float]], bool] = _default_wait,
+        logger=None,
+        autostart: bool = True,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        if max_queue_rows < max_batch:
+            raise ValueError("max_queue_rows must be >= max_batch")
+        self._dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self._max_wait_s = float(max_wait_ms) / 1e3
+        self.max_queue_rows = int(max_queue_rows)
+        self._clock = clock
+        self._wait = wait
+        self._logger = logger
+
+        self._cond = threading.Condition()
+        self._queue: deque = deque()  # of _Chunk
+        self._queued_rows = 0
+        self._open = True
+
+        # metrics (guarded by _cond's lock)
+        self._n_requests = 0
+        self._n_rows = 0
+        self._n_batches = 0
+        self._n_shed = 0
+        self._n_errors = 0
+        self._occupancy: deque = deque(maxlen=4096)  # rows per batch
+        self._requests_per_batch: deque = deque(maxlen=4096)
+        self._queue_wait_ms: deque = deque(maxlen=4096)  # per batch
+        self._device_ms: deque = deque(maxlen=4096)  # per batch
+        self._latency_ms: deque = deque(maxlen=8192)  # per request, end to end
+
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------ #
+    # client side
+
+    def submit(self, x) -> Future:
+        """Enqueue one request; returns a ``Future`` resolving to the dispatch
+        output dict sliced back to this request's rows.
+
+        Raises :class:`Overloaded` when accepting the request would push the
+        queue past ``max_queue_rows`` (all-or-nothing: a request is never
+        partially enqueued), and ``RuntimeError`` after :meth:`close`.
+        """
+        x = np.asarray(x)
+        if x.ndim != 2 or x.shape[0] == 0:
+            raise ValueError(f"expected a non-empty (rows, features) array, got {x.shape}")
+        rows = x.shape[0]
+        with self._cond:
+            if not self._open:
+                raise RuntimeError("batcher is closed")
+            if self._queued_rows + rows > self.max_queue_rows:
+                self._n_shed += 1
+                raise Overloaded(
+                    f"queue full ({self._queued_rows} rows queued, request "
+                    f"of {rows} would exceed max_queue_rows="
+                    f"{self.max_queue_rows}); retry with backoff"
+                )
+            n_chunks = -(-rows // self.max_batch)
+            req = _Request(n_chunks, self._clock())
+            for i in range(n_chunks):
+                chunk = x[i * self.max_batch : (i + 1) * self.max_batch]
+                self._queue.append(_Chunk(chunk, req, i))
+            self._queued_rows += rows
+            self._cond.notify_all()
+            return req.future
+
+    # ------------------------------------------------------------------ #
+    # worker side
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="microbatcher", daemon=True
+            )
+            self._thread.start()
+
+    def _collect(self) -> Optional[List[_Chunk]]:
+        """Block until a batch is ready (max_batch reached, max_wait expired,
+        or draining); None once closed and drained."""
+        with self._cond:
+            while True:
+                while not self._queue and self._open:
+                    self._wait(self._cond, None)
+                if not self._queue:
+                    return None  # closed and drained
+                deadline = self._queue[0].req.enqueued + self._max_wait_s
+                while self._open and self._queue and self._queued_rows < self.max_batch:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        break
+                    self._wait(self._cond, remaining)
+                if not self._queue:
+                    continue  # drained under us (close(drain=False))
+                batch: List[_Chunk] = []
+                rows = 0
+                while self._queue and rows + self._queue[0].x.shape[0] <= self.max_batch:
+                    chunk = self._queue.popleft()
+                    batch.append(chunk)
+                    rows += chunk.x.shape[0]
+                self._queued_rows -= rows
+                return batch
+
+    def _run_batch(self, batch: List[_Chunk]) -> None:
+        rows = sum(c.x.shape[0] for c in batch)
+        t0 = self._clock()
+        queue_wait_ms = (t0 - min(c.req.enqueued for c in batch)) * 1e3
+        try:
+            out = self._dispatch(np.concatenate([c.x for c in batch], axis=0))
+        except Exception as e:
+            with self._cond:
+                self._n_errors += 1
+            for c in batch:
+                if not c.req.future.done():
+                    c.req.future.set_exception(e)
+            return
+        device_ms = (self._clock() - t0) * 1e3
+        done_requests = []
+        offset = 0
+        for c in batch:
+            n = c.x.shape[0]
+            c.req.parts[c.index] = {k: v[offset : offset + n] for k, v in out.items()}
+            offset += n
+            if all(p is not None for p in c.req.parts):
+                done_requests.append(c.req)
+        now = self._clock()
+        with self._cond:
+            self._n_batches += 1
+            self._occupancy.append(rows)
+            self._requests_per_batch.append(len(batch))
+            self._queue_wait_ms.append(queue_wait_ms)
+            self._device_ms.append(device_ms)
+            for req in done_requests:
+                self._n_requests += 1
+                self._n_rows += sum(p[next(iter(p))].shape[0] for p in req.parts)
+                self._latency_ms.append((now - req.enqueued) * 1e3)
+        if self._logger is not None:
+            self._logger.log(
+                event="batch",
+                rows=rows,
+                requests=len(batch),
+                queue_wait_ms=round(queue_wait_ms, 3),
+                device_ms=round(device_ms, 3),
+            )
+        for req in done_requests:
+            keys = req.parts[0].keys()
+            result = {
+                k: np.concatenate([p[k] for p in req.parts], axis=0) for k in keys
+            }
+            if not req.future.done():
+                req.future.set_result(result)
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            self._run_batch(batch)
+
+    # ------------------------------------------------------------------ #
+    # lifecycle / metrics
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting requests.  ``drain=True`` (graceful) dispatches
+        everything already queued before the worker exits; ``drain=False``
+        cancels queued requests with ``CancelledError``."""
+        with self._cond:
+            self._open = False
+            if not drain:
+                cancelled = {c.req for c in self._queue}
+                self._queue.clear()
+                self._queued_rows = 0
+                for req in cancelled:
+                    if not req.future.done():
+                        req.future.set_exception(CancelledError("batcher closed"))
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=True)
+
+    def stats(self) -> Dict[str, Any]:
+        """Aggregate serving metrics (bounded windows for the percentiles).
+
+        Only the snapshot happens under the batcher's lock; the sorts run
+        after release, so a /metrics poll never stalls submit() or the
+        dispatch worker behind an O(window log window) sort."""
+        with self._cond:
+            lat = list(self._latency_ms)
+            qw = list(self._queue_wait_ms)
+            dv = list(self._device_ms)
+            occ = list(self._occupancy)
+            rpb = list(self._requests_per_batch)
+            counters = {
+                "requests": self._n_requests,
+                "rows": self._n_rows,
+                "batches": self._n_batches,
+                "shed": self._n_shed,
+                "dispatch_errors": self._n_errors,
+                "queued_rows": self._queued_rows,
+            }
+        lat.sort()
+        qw.sort()
+        dv.sort()
+        return {
+            **counters,
+            "batch_occupancy_mean": float(np.mean(occ)) if occ else 0.0,
+            "batch_occupancy_max": int(max(occ)) if occ else 0,
+            "requests_per_batch_mean": float(np.mean(rpb)) if rpb else 0.0,
+            "latency_p50_ms": _percentile(lat, 0.50),
+            "latency_p99_ms": _percentile(lat, 0.99),
+            "queue_wait_p50_ms": _percentile(qw, 0.50),
+            "queue_wait_p99_ms": _percentile(qw, 0.99),
+            "device_p50_ms": _percentile(dv, 0.50),
+            "device_p99_ms": _percentile(dv, 0.99),
+        }

@@ -1,0 +1,329 @@
+"""Predictive engine: jitted per-model posterior-predictive kernels over a
+checkpointed ensemble, behind a shape-bucketed compile cache.
+
+The models' one-shot batch helpers (``models/logreg.py:
+posterior_predictive_prob``, ``models/bnn.py:predict``, the GMM density) have
+no request path: every distinct request-batch shape would trace a fresh XLA
+program, and a multi-process checkpoint has no single file to load.  The
+engine closes both gaps:
+
+- **Checkpoint cold start** (:meth:`PredictiveEngine.from_checkpoint`): a
+  single ``save_state`` dir loads via ``load_state``; a ``CheckpointManager``
+  root restores the newest *loadable* step (corrupt/partial newest dirs are
+  skipped — ``utils/checkpoint.py:restore_latest``); a list of paths is
+  treated as one multi-process save and reassembled into the global ensemble
+  via ``assemble_full_state``.
+- **Shape-bucketed compile cache**: a request batch of ``b`` rows pads up to
+  the next power-of-two bucket (≥ ``min_bucket``) and runs the bucket's
+  cached jitted kernel, so at most ``log2(max_bucket/min_bucket)+1`` programs
+  are ever traced regardless of traffic mix.  Hits/misses are counted
+  (:meth:`stats`) — steady-state traffic must be all hits.
+
+Padding is exact, not approximate: every per-row output depends only on that
+row (row-wise matmul + elementwise + particle-axis reduction), so the served
+values are bitwise-equal to a direct full-batch call on the same ensemble
+(pinned by ``tests/test_serving.py:test_end_to_end_bitwise``).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_svgd_tpu.models import bnn as bnn_model
+from dist_svgd_tpu.models.logreg import posterior_predictive_prob
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+MODELS = ("logreg", "bnn", "gmm")
+
+
+def bucket_for(rows: int, min_bucket: int) -> int:
+    """Smallest power-of-two ≥ ``rows``, clamped up to ``min_bucket``."""
+    if rows <= 0:
+        raise ValueError(f"rows must be positive, got {rows}")
+    return max(min_bucket, 1 << (rows - 1).bit_length())
+
+
+def _looks_like_manager_root(path: str) -> bool:
+    from dist_svgd_tpu.utils.checkpoint import _STEP_DIR_RE
+
+    return any(
+        _STEP_DIR_RE.match(name) and os.path.isdir(os.path.join(path, name))
+        for name in os.listdir(path)
+    )
+
+
+class PredictiveEngine:
+    """Low-latency posterior-predictive evaluation of one particle ensemble.
+
+    Args:
+        model: ``'logreg'`` (class-probability mean + variance over the
+            ensemble, the ``posterior_predictive_prob`` semantics — α decoded
+            but unused, reference quirk), ``'bnn'`` (regression mean + std on
+            the original target scale, ``models/bnn.py:unpack`` layout), or
+            ``'gmm'`` (ensemble KDE log-density — the particle set *is* the
+            posterior sample, so the served density is the mixture of
+            ``N(θ_p, kde_bandwidth²·I)`` over particles).
+        particles: ``(n, d)`` ensemble array (any array-like).
+        n_features / n_hidden: BNN layout parameters (``n_features`` is
+            required for ``'bnn'``; ``d`` must equal ``num_params``).
+        y_mean / y_std: BNN target destandardisation (the training drivers
+            standardise targets; serving reports original-scale values).
+        kde_bandwidth: GMM KDE kernel width.
+        min_bucket / max_bucket: padding-bucket range, each rounded UP to a
+            power of two (so ``warmup()`` provably covers every reachable
+            bucket).  Requests larger than the rounded ``max_bucket`` are
+            rejected — the batcher splits oversize requests *before* the
+            engine sees them.
+    """
+
+    def __init__(
+        self,
+        model: str,
+        particles,
+        *,
+        n_features: Optional[int] = None,
+        n_hidden: int = 50,
+        y_mean: float = 0.0,
+        y_std: float = 1.0,
+        kde_bandwidth: float = 1.0,
+        min_bucket: int = 8,
+        max_bucket: int = 4096,
+    ):
+        if model not in MODELS:
+            raise ValueError(f"unknown model {model!r}; expected one of {MODELS}")
+        if min_bucket < 1 or max_bucket < min_bucket:
+            raise ValueError(
+                f"need 1 <= min_bucket <= max_bucket, got {min_bucket}/{max_bucket}"
+            )
+        # normalise both ends up to powers of two: a non-pow2 max_bucket
+        # (e.g. --max-batch 100) would otherwise admit requests whose bucket
+        # (128) warmup() never traced — an in-window recompile that breaks
+        # the steady-state contract
+        min_bucket = 1 << (min_bucket - 1).bit_length()
+        max_bucket = 1 << (max_bucket - 1).bit_length()
+        self._particles = jnp.asarray(particles)
+        if self._particles.ndim != 2:
+            raise ValueError(
+                f"particles must be (n, d), got shape {self._particles.shape}"
+            )
+        self.model = model
+        n, d = self._particles.shape
+        if model == "logreg":
+            if d < 2:
+                raise ValueError("logreg particles need d >= 2 (log α, w)")
+            self._feature_dim = d - 1
+        elif model == "bnn":
+            if n_features is None:
+                raise ValueError("model='bnn' requires n_features")
+            want = bnn_model.num_params(n_features, n_hidden)
+            if d != want:
+                raise ValueError(
+                    f"bnn particles have d={d}, but num_params(n_features="
+                    f"{n_features}, n_hidden={n_hidden}) = {want}"
+                )
+            self._feature_dim = n_features
+        else:  # gmm: queries live in particle space
+            self._feature_dim = d
+        self._n_features = n_features
+        self._n_hidden = n_hidden
+        self._y_mean = float(y_mean)
+        self._y_std = float(y_std)
+        if kde_bandwidth <= 0:
+            raise ValueError("kde_bandwidth must be positive")
+        self._kde_bandwidth = float(kde_bandwidth)
+        self.min_bucket = int(min_bucket)
+        self.max_bucket = int(max_bucket)
+        # bucket -> jitted kernel; guarded for concurrent predict() callers
+        # (the batcher serialises dispatches, but the engine is also usable
+        # directly from request threads)
+        self._kernels: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+
+    # ------------------------------------------------------------------ #
+    # construction from checkpoints
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        source: Union[str, Sequence[str]],
+        model: str,
+        *,
+        key: str = "particles",
+        **kwargs,
+    ) -> "PredictiveEngine":
+        """Build an engine from any of the repo's checkpoint layouts.
+
+        ``source`` may be: a single checkpoint dir (``save_state`` layout), a
+        ``CheckpointManager`` root (``step_<t>/`` children — the newest
+        *loadable* step is restored, skipping corrupt/partial ones), or a
+        list/tuple of per-process paths from ONE multi-host save (reassembled
+        with ``assemble_full_state``).  ``key`` selects the ensemble entry
+        (``'particles'`` in every sampler ``state_dict``).
+        """
+        from dist_svgd_tpu.utils.checkpoint import (
+            CheckpointManager,
+            assemble_full_state,
+            load_state,
+        )
+
+        if isinstance(source, (list, tuple)):
+            state = assemble_full_state(list(source))
+        else:
+            path = os.fspath(source)
+            if not os.path.isdir(path):
+                raise FileNotFoundError(f"checkpoint path {path!r} is not a directory")
+            if _looks_like_manager_root(path):
+                state = CheckpointManager(path).restore_latest()
+                if state is None:
+                    raise ValueError(
+                        f"no restorable checkpoint under manager root {path!r}"
+                    )
+            else:
+                state = load_state(path)
+        if state.get(key) is None:
+            raise KeyError(
+                f"checkpoint has no {key!r} entry (keys: {sorted(state)})"
+            )
+        return cls(model, np.asarray(state[key]), **kwargs)
+
+    # ------------------------------------------------------------------ #
+    # kernels
+
+    @property
+    def particles(self) -> jax.Array:
+        """The served ensemble (read-only by convention)."""
+        return self._particles
+
+    @property
+    def n_particles(self) -> int:
+        return int(self._particles.shape[0])
+
+    @property
+    def feature_dim(self) -> int:
+        """Expected per-row input width for :meth:`predict`."""
+        return self._feature_dim
+
+    def _build_kernel(self):
+        """The model's padded-batch predictive program (traced per bucket)."""
+        particles = self._particles
+        if self.model == "logreg":
+
+            def kernel(x):
+                probs = posterior_predictive_prob(particles, x)  # (n, b)
+                return {
+                    "mean": jnp.mean(probs, axis=0),
+                    "var": jnp.var(probs, axis=0),
+                }
+
+        elif self.model == "bnn":
+            nf, nh = self._n_features, self._n_hidden
+            y_mean, y_std = self._y_mean, self._y_std
+
+            def kernel(x):
+                preds = jax.vmap(
+                    lambda t: bnn_model.predict(t, x, nf, nh)
+                )(particles)  # (n, b)
+                mean = jnp.mean(preds, axis=0) * y_std + y_mean
+                ens_var = jnp.var(preds, axis=0) * y_std**2
+                # predictive std folds in the mean observation-noise
+                # variance E[1/γ] over the ensemble (original scale)
+                noise = jnp.mean(jnp.exp(-particles[:, -2])) * y_std**2
+                return {"mean": mean, "std": jnp.sqrt(ens_var + noise)}
+
+        else:  # gmm — ensemble KDE density
+            h = self._kde_bandwidth
+            d = self._feature_dim
+
+            def kernel(x):
+                sq = jnp.sum(
+                    (x[:, None, :] - particles[None, :, :]) ** 2, axis=-1
+                )  # (b, n)
+                logk = -0.5 * sq / (h * h) - d * math.log(h) - 0.5 * d * _LOG_2PI
+                log_density = jax.scipy.special.logsumexp(
+                    logk, axis=1
+                ) - math.log(particles.shape[0])
+                return {"log_density": log_density}
+
+        return jax.jit(kernel)
+
+    def _kernel_for(self, bucket: int):
+        with self._lock:
+            fn = self._kernels.get(bucket)
+            if fn is None:
+                self._misses += 1
+                fn = self._kernels[bucket] = self._build_kernel()
+            else:
+                self._hits += 1
+            return fn
+
+    # ------------------------------------------------------------------ #
+    # serving
+
+    def predict(self, x) -> Dict[str, np.ndarray]:
+        """Evaluate one request batch ``x`` of shape ``(b, feature_dim)``.
+
+        Pads to the power-of-two bucket, runs the bucket's cached jitted
+        kernel, slices the padding back off.  Returns plain numpy arrays of
+        leading dimension ``b`` (the device→host fetch doubles as the fence
+        the batcher's device-time split relies on).
+        """
+        x = np.asarray(x)
+        if x.ndim != 2 or x.shape[1] != self._feature_dim:
+            raise ValueError(
+                f"expected (b, {self._feature_dim}) inputs, got shape {x.shape}"
+            )
+        b = x.shape[0]
+        if b > self.max_bucket:
+            raise ValueError(
+                f"request of {b} rows exceeds max_bucket={self.max_bucket}; "
+                "split it upstream (MicroBatcher max_batch does this)"
+            )
+        bucket = bucket_for(b, self.min_bucket)
+        fn = self._kernel_for(bucket)
+        xb = jnp.asarray(x, dtype=self._particles.dtype)
+        if bucket != b:
+            xb = jnp.concatenate(
+                [xb, jnp.zeros((bucket - b, x.shape[1]), xb.dtype)], axis=0
+            )
+        out = fn(xb)
+        return {k: np.asarray(v[:b]) for k, v in out.items()}
+
+    def warmup(self, batch_sizes: Optional[List[int]] = None) -> List[int]:
+        """Pre-trace kernels so first requests don't pay XLA compiles.
+
+        Defaults to every bucket from ``min_bucket`` up to ``max_bucket``.
+        Returns the bucket list compiled.
+        """
+        if batch_sizes is None:
+            buckets = []
+            bkt = self.min_bucket
+            while bkt <= self.max_bucket:
+                buckets.append(bkt)
+                bkt *= 2
+        else:
+            buckets = sorted({bucket_for(b, self.min_bucket) for b in batch_sizes})
+        for bkt in buckets:
+            self.predict(np.zeros((bkt, self._feature_dim), np.float32))
+        return buckets
+
+    def stats(self) -> Dict[str, Any]:
+        """Compile-cache and ensemble identity counters for ``/metrics``."""
+        with self._lock:
+            return {
+                "model": self.model,
+                "n_particles": self.n_particles,
+                "feature_dim": self._feature_dim,
+                "bucket_hits": self._hits,
+                "bucket_misses": self._misses,
+                "compiled_buckets": sorted(self._kernels),
+            }

@@ -1,0 +1,266 @@
+"""Thin stdlib HTTP front end over the engine + batcher.
+
+JSON in/out, three routes:
+
+- ``POST /predict``  — ``{"inputs": [[...], ...]}`` → the engine's output
+  dict as lists, plus this request's latency split;
+- ``GET  /healthz``  — liveness + ensemble identity;
+- ``GET  /metrics``  — the batcher's occupancy/latency aggregates (queue
+  wait vs device time, p50/p99), the engine's bucket-cache counters, and
+  the server's request/error counts.
+
+No framework dependency by design: the container bakes only the jax_graft
+toolchain, and the request path is one ``json.loads`` + a batcher future —
+``ThreadingHTTPServer`` (one thread per in-flight request, parked on the
+future) is exactly the concurrency the micro-batcher wants to coalesce
+across.  Graceful drain on shutdown: stop accepting, finish in-flight
+handlers, flush the batcher queue.
+
+Structured per-request records go through ``utils/metrics.py:JsonlLogger``
+(one line per request: route, rows, status, latency) when a logger is given.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dist_svgd_tpu.serving.batcher import MicroBatcher, Overloaded
+from dist_svgd_tpu.serving.engine import PredictiveEngine
+
+
+class PredictionServer:
+    """HTTP serving front end.  ``port=0`` binds an ephemeral port (tests).
+
+    The server owns its batcher unless one is passed in; :meth:`shutdown`
+    drains it either way (stop accepting → finish in-flight handlers →
+    dispatch everything still queued).
+    """
+
+    def __init__(
+        self,
+        engine: PredictiveEngine,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+        max_queue_rows: int = 8192,
+        request_timeout_s: float = 30.0,
+        logger=None,
+        batcher: Optional[MicroBatcher] = None,
+    ):
+        self.engine = engine
+        self.batcher = batcher or MicroBatcher(
+            engine.predict,
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            max_queue_rows=max_queue_rows,
+            logger=None,  # batch records would interleave with request records
+        )
+        self._logger = logger
+        self._request_timeout_s = request_timeout_s
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._errors = 0
+        self._started = time.time()
+
+        server = self  # close over for the handler class
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            # join in-flight handler threads on server_close — the drain
+            # guarantee (ThreadingHTTPServer defaults them to daemons)
+            daemon_threads = False
+
+            def log_message(self, fmt, *args):  # stderr chatter off
+                pass
+
+            def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, server.health())
+                elif self.path == "/metrics":
+                    self._reply(200, server.metrics())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                t0 = time.perf_counter()
+                code, payload, rows = server._predict(self._read_body())
+                payload.setdefault(
+                    "latency_ms", round((time.perf_counter() - t0) * 1e3, 3)
+                )
+                self._reply(code, payload)
+                if server._logger is not None:
+                    server._logger.log(
+                        route="/predict",
+                        status=code,
+                        rows=rows,
+                        latency_ms=payload["latency_ms"],
+                    )
+
+            def _read_body(self) -> bytes:
+                length = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(length) if length else b""
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def address(self):
+        """``(host, port)`` actually bound (resolves ``port=0``)."""
+        return self._httpd.server_address
+
+    @property
+    def url(self) -> str:
+        host, port = self.address[:2]
+        return f"http://{host}:{port}"
+
+    def _predict(self, body: bytes):
+        """Returns ``(status_code, payload, rows)``; never raises."""
+        try:
+            doc = json.loads(body or b"null")
+            inputs = doc["inputs"] if isinstance(doc, dict) else None
+            if inputs is None:
+                raise ValueError('body must be {"inputs": [[...], ...]}')
+            x = np.asarray(inputs, dtype=np.float32)
+            if x.ndim == 1:  # single row shorthand
+                x = x[None, :]
+            future = self.batcher.submit(x)
+            out = future.result(timeout=self._request_timeout_s)
+        except Overloaded as e:
+            with self._lock:
+                self._errors += 1
+            return 503, {"error": str(e)}, 0
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            with self._lock:
+                self._errors += 1
+            return 400, {"error": str(e)}, 0
+        except Exception as e:  # dispatch failure / timeout
+            with self._lock:
+                self._errors += 1
+            return 500, {"error": f"{type(e).__name__}: {e}"}, 0
+        with self._lock:
+            self._requests += 1
+        return 200, {"outputs": {k: v.tolist() for k, v in out.items()}}, x.shape[0]
+
+    def health(self) -> Dict[str, Any]:
+        st = self.engine.stats()
+        return {
+            "status": "ok",
+            "model": st["model"],
+            "n_particles": st["n_particles"],
+            "feature_dim": st["feature_dim"],
+            "uptime_s": round(time.time() - self._started, 1),
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            server_side = {"http_requests": self._requests, "http_errors": self._errors}
+        return {**server_side, "batcher": self.batcher.stats(),
+                "engine": self.engine.stats()}
+
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> "PredictionServer":
+        """Serve in a background thread (returns self for chaining)."""
+        if self._serve_thread is None:
+            self._serve_thread = threading.Thread(
+                target=self._httpd.serve_forever, name="http-serve", daemon=True
+            )
+            self._serve_thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Blocking serve (the CLI path); KeyboardInterrupt drains."""
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        """Graceful drain: stop accepting, finish in-flight handlers, flush
+        the batcher queue."""
+        self._httpd.shutdown()
+        self._httpd.server_close()  # joins non-daemon handler threads
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10)
+            self._serve_thread = None
+        self.batcher.close(drain=True)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+def main(argv=None):
+    """``python -m dist_svgd_tpu.serving.server --checkpoint <dir> ...``"""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--checkpoint", action="append", required=True,
+                    help="checkpoint dir, CheckpointManager root, or repeat "
+                         "the flag with every per-process path of one "
+                         "multi-host save")
+    ap.add_argument("--model", choices=("logreg", "bnn", "gmm"), default="logreg")
+    ap.add_argument("--n-features", type=int, default=None,
+                    help="BNN input width (required for --model bnn)")
+    ap.add_argument("--n-hidden", type=int, default=50)
+    ap.add_argument("--kde-bandwidth", type=float, default=1.0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue-rows", type=int, default=8192)
+    ap.add_argument("--request-log", default=None,
+                    help="JSONL per-request record path (utils/metrics.py)")
+    ap.add_argument("--warmup", default=True,
+                    action=argparse.BooleanOptionalAction,
+                    help="pre-trace every padding bucket up to max-batch "
+                         "before binding the port")
+    args = ap.parse_args(argv)
+
+    from dist_svgd_tpu.utils.metrics import JsonlLogger
+
+    source = args.checkpoint[0] if len(args.checkpoint) == 1 else args.checkpoint
+    engine = PredictiveEngine.from_checkpoint(
+        source, args.model, n_features=args.n_features, n_hidden=args.n_hidden,
+        kde_bandwidth=args.kde_bandwidth, max_bucket=args.max_batch,
+    )
+    if args.warmup:
+        compiled = engine.warmup()
+        print(json.dumps({"warmup_buckets": compiled}), flush=True)
+    logger = JsonlLogger(path=args.request_log) if args.request_log else None
+    srv = PredictionServer(
+        engine, host=args.host, port=args.port, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue_rows=args.max_queue_rows,
+        logger=logger,
+    )
+    print(json.dumps({"serving": srv.url, **srv.health()}), flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

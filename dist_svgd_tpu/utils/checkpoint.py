@@ -95,13 +95,41 @@ def save_state(path: str, state: Dict[str, Any]) -> str:
     return path
 
 
+#: Files whose presence marks an orbax-layout checkpoint (PyTreeCheckpointer
+#: writes `_METADATA`/`_CHECKPOINT_METADATA` plus ocdbt manifests).
+_ORBAX_MARKERS = ("_METADATA", "_CHECKPOINT_METADATA", "manifest.ocdbt")
+
+
+def _looks_like_orbax(path: str, entries) -> bool:
+    return any(m in entries for m in _ORBAX_MARKERS) or any(
+        e.startswith("ocdbt.process_") for e in entries
+    )
+
+
 def load_state(path: str) -> Dict[str, Any]:
-    """Load a checkpoint written by :func:`save_state` (auto-detects layout)."""
+    """Load a checkpoint written by :func:`save_state` (auto-detects layout).
+
+    A directory holding neither layout — empty, or stray files without the
+    npz or any orbax marker (partial writes from a killed pre-rename-era
+    writer) — raises ``ValueError`` *before* the orbax import, so
+    ``CheckpointManager.restore_latest`` can classify it as corruption and
+    fall back to an older step even when orbax is not installed
+    (``ImportError`` is reserved for a checkpoint that IS orbax-layout in an
+    orbax-less environment, which must propagate)."""
     path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint directory at {path}")
     npz = os.path.join(path, _NPZ_NAME)
     if os.path.exists(npz):
         with np.load(npz) as data:
             return {k: data[k] for k in data.files}
+    entries = os.listdir(path)
+    if not _looks_like_orbax(path, entries):
+        raise ValueError(
+            f"checkpoint directory {path} holds neither layout "
+            f"(entries: {sorted(entries)[:5]}) — partial write from a "
+            "killed save?"
+        )
     import orbax.checkpoint as ocp
 
     with ocp.PyTreeCheckpointer() as ckptr:

@@ -29,7 +29,7 @@ def history_to_dataframe(
     ``value`` (numpy ``(d,)`` vector), matching ``dsvgd/sampler.py:66,74``.
     """
     history = np.asarray(history)
-    T, n, _ = history.shape
+    T, n, d = history.shape
     if timesteps is None:
         timesteps = np.arange(T)
     if particle_ids is None:
@@ -37,7 +37,10 @@ def history_to_dataframe(
     rows = {
         "timestep": np.repeat(np.asarray(timesteps), n),
         "particle": np.tile(np.asarray(particle_ids), T),
-        "value": [history[t, i] for t in range(T) for i in range(n)],
+        # one reshape, not a T×n Python double loop (millions of iterations
+        # at 10k particles × 500 steps); row (t, i) of the reshape IS
+        # history[t, i], so the schema is unchanged
+        "value": list(history.reshape(T * n, d)),
     }
     if not include_particle_column:
         del rows["particle"]

@@ -1,0 +1,36 @@
+"""history_to_dataframe (utils/history.py): reference DataFrame schema, and
+the reshape-based value column matching the old per-(t, i) indexing exactly.
+"""
+
+import numpy as np
+import pytest
+
+from dist_svgd_tpu.utils.history import history_to_dataframe
+
+
+@pytest.fixture
+def history():
+    return np.random.default_rng(3).normal(size=(4, 5, 2))
+
+
+def test_schema_and_values(history):
+    df = history_to_dataframe(history)
+    T, n, d = history.shape
+    assert list(df.columns) == ["timestep", "particle", "value"]
+    assert len(df) == T * n
+    # the reference layout: row (t * n + i) carries history[t, i]
+    for t in range(T):
+        for i in range(n):
+            row = df.iloc[t * n + i]
+            assert row["timestep"] == t and row["particle"] == i
+            np.testing.assert_array_equal(row["value"], history[t, i])
+    assert df["value"].iloc[0].shape == (d,)
+
+
+def test_custom_ids_and_no_particle_column(history):
+    df = history_to_dataframe(
+        history, timesteps=[10, 11, 12, 13], particle_ids=[7, 8, 9, 10, 11]
+    )
+    assert df["timestep"].iloc[0] == 10 and df["particle"].iloc[-1] == 11
+    df2 = history_to_dataframe(history, include_particle_column=False)
+    assert list(df2.columns) == ["timestep", "value"]

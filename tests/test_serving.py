@@ -1,0 +1,572 @@
+"""Serving subsystem (dist_svgd_tpu/serving/): engine bucket cache and
+checkpoint cold start, micro-batcher edge cases (driven through the
+injectable clock — no real waits beyond a few ms), HTTP front end, and the
+end-to-end train → checkpoint → serve bitwise acceptance test.
+"""
+
+import json
+import math
+import threading
+import urllib.error
+import urllib.request
+from concurrent.futures import CancelledError
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dist_svgd_tpu.models.logreg import posterior_predictive_prob
+from dist_svgd_tpu.serving import (
+    MicroBatcher,
+    Overloaded,
+    PredictionServer,
+    PredictiveEngine,
+)
+from dist_svgd_tpu.serving.engine import bucket_for
+from dist_svgd_tpu.utils.checkpoint import CheckpointManager, save_state
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _logreg_engine(rng, n=32, k=4, **kw):
+    parts = rng.normal(size=(n, 1 + k)).astype(np.float32)
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("max_bucket", 64)
+    return PredictiveEngine("logreg", parts, **kw), parts
+
+
+# --------------------------------------------------------------------- #
+# injectable time: tests drive max_wait_ms expiry without real sleeps
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_fake_wait(clock):
+    """Timed condition waits advance the fake clock instead of sleeping;
+    untimed waits stay real (they wake on submit's notify)."""
+
+    def wait(cond, timeout):
+        if timeout is None:
+            return threading.Condition.wait(cond)
+        clock.t += timeout
+        return False
+
+    return wait
+
+
+def make_batcher(dispatch, **kw):
+    clock = ManualClock()
+    kw.setdefault("clock", clock)
+    kw.setdefault("wait", make_fake_wait(clock))
+    kw.setdefault("autostart", False)
+    return MicroBatcher(dispatch, **kw), clock
+
+
+# --------------------------------------------------------------------- #
+# engine: buckets + compile cache
+
+
+def test_bucket_for():
+    assert [bucket_for(b, 4) for b in (1, 3, 4, 5, 8, 9, 17)] == [
+        4, 4, 4, 8, 8, 16, 32,
+    ]
+    with pytest.raises(ValueError):
+        bucket_for(0, 4)
+
+
+def test_engine_pads_exactly(rng):
+    """Padding to the bucket and slicing back is bitwise-invisible: every
+    request size gives the same rows as one direct full-batch call."""
+    eng, parts = _logreg_engine(rng)
+    x = rng.normal(size=(11, 4)).astype(np.float32)
+    ref = np.asarray(jnp.mean(posterior_predictive_prob(
+        jnp.asarray(parts), jnp.asarray(x)), axis=0))
+    for a, b in ((0, 1), (1, 4), (4, 11)):
+        out = eng.predict(x[a:b])
+        assert out["mean"].shape == (b - a,)
+        np.testing.assert_array_equal(out["mean"], ref[a:b])
+
+
+def test_engine_bucket_cache_hits_and_misses(rng):
+    eng, _ = _logreg_engine(rng)
+    for b in (1, 2, 3, 4):  # all land in bucket 4: 1 miss, 3 hits
+        eng.predict(np.zeros((b, 4), np.float32))
+    st = eng.stats()
+    assert st["compiled_buckets"] == [4]
+    assert (st["bucket_misses"], st["bucket_hits"]) == (1, 3)
+    eng.predict(np.zeros((5, 4), np.float32))  # bucket 8: second miss
+    assert eng.stats()["compiled_buckets"] == [4, 8]
+    # traffic mix over the whole range compiles at most log2 buckets
+    for b in range(1, 65):
+        eng.predict(np.zeros((b, 4), np.float32))
+    assert len(eng.stats()["compiled_buckets"]) <= math.ceil(math.log2(64)) + 1
+
+
+def test_engine_rejects_oversize_and_bad_shapes(rng):
+    eng, _ = _logreg_engine(rng, max_bucket=16)
+    with pytest.raises(ValueError, match="max_bucket"):
+        eng.predict(np.zeros((17, 4), np.float32))
+    with pytest.raises(ValueError, match="expected"):
+        eng.predict(np.zeros((3, 5), np.float32))
+    with pytest.raises(ValueError, match="unknown model"):
+        PredictiveEngine("mystery", np.zeros((4, 3)))
+
+
+def test_engine_warmup_precompiles(rng):
+    eng, _ = _logreg_engine(rng, min_bucket=4, max_bucket=32)
+    assert eng.warmup() == [4, 8, 16, 32]
+    misses = eng.stats()["bucket_misses"]
+    eng.predict(np.zeros((13, 4), np.float32))
+    assert eng.stats()["bucket_misses"] == misses  # steady state: no compiles
+
+
+def test_engine_non_pow2_max_bucket_normalised(rng):
+    """max_bucket=100 rounds up to 128, so warmup() provably covers every
+    reachable bucket — a 100-row request must NOT compile post-warmup."""
+    eng, _ = _logreg_engine(rng, min_bucket=4, max_bucket=100)
+    assert eng.max_bucket == 128
+    assert eng.warmup()[-1] == 128
+    misses = eng.stats()["bucket_misses"]
+    eng.predict(np.zeros((100, 4), np.float32))
+    assert eng.stats()["bucket_misses"] == misses
+    with pytest.raises(ValueError, match="max_bucket"):
+        eng.predict(np.zeros((129, 4), np.float32))
+
+
+def test_engine_bnn_kernel_matches_direct(rng):
+    from dist_svgd_tpu.models import bnn
+
+    nf, nh, n = 3, 4, 10
+    parts = rng.normal(size=(n, bnn.num_params(nf, nh))).astype(np.float32)
+    x = rng.normal(size=(5, nf)).astype(np.float32)
+    eng = PredictiveEngine("bnn", parts, n_features=nf, n_hidden=nh,
+                           y_mean=2.0, y_std=3.0)
+    out = eng.predict(x)
+    preds = np.stack([
+        np.asarray(bnn.predict(jnp.asarray(p), jnp.asarray(x), nf, nh))
+        for p in parts
+    ])
+    mean = preds.mean(0) * 3.0 + 2.0
+    var = preds.var(0) * 9.0 + np.mean(np.exp(-parts[:, -2])) * 9.0
+    np.testing.assert_allclose(out["mean"], mean, rtol=1e-5)
+    np.testing.assert_allclose(out["std"], np.sqrt(var), rtol=1e-5)
+
+
+def test_engine_bnn_requires_layout():
+    with pytest.raises(ValueError, match="requires n_features"):
+        PredictiveEngine("bnn", np.zeros((4, 10), np.float32))
+    with pytest.raises(ValueError, match="num_params"):
+        PredictiveEngine("bnn", np.zeros((4, 10), np.float32), n_features=3)
+
+
+def test_engine_gmm_kde_matches_direct(rng):
+    n, d, h = 20, 2, 0.7
+    parts = rng.normal(size=(n, d)).astype(np.float32)
+    x = rng.normal(size=(6, d)).astype(np.float32)
+    eng = PredictiveEngine("gmm", parts, kde_bandwidth=h)
+    out = eng.predict(x)
+    sq = ((x[:, None, :] - parts[None]) ** 2).sum(-1)
+    logk = -0.5 * sq / h**2 - d * np.log(h) - 0.5 * d * np.log(2 * np.pi)
+    ref = np.log(np.exp(logk).sum(1)) - np.log(n)
+    np.testing.assert_allclose(out["log_density"], ref, rtol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# engine: checkpoint cold start (all three layouts)
+
+
+def test_from_checkpoint_single_save(tmp_path, rng):
+    parts = rng.normal(size=(8, 3)).astype(np.float32)
+    save_state(str(tmp_path / "c"), {"particles": parts, "t": 3})
+    eng = PredictiveEngine.from_checkpoint(str(tmp_path / "c"), "logreg")
+    np.testing.assert_array_equal(np.asarray(eng.particles), parts)
+
+
+def test_from_checkpoint_manager_root_skips_corrupt_newest(tmp_path, rng):
+    """Cold start survives a run killed mid-save: the corrupt newest step is
+    skipped with a warning and the previous one serves."""
+    import os
+
+    parts = rng.normal(size=(8, 3)).astype(np.float32)
+    mgr = CheckpointManager(str(tmp_path / "root"), every=1)
+    mgr.save(1, {"particles": parts, "t": 1})
+    os.makedirs(os.path.join(mgr.root, "step_2"))  # partial write
+    with pytest.warns(UserWarning, match="skipping unloadable"):
+        eng = PredictiveEngine.from_checkpoint(str(tmp_path / "root"), "logreg")
+    np.testing.assert_array_equal(np.asarray(eng.particles), parts)
+
+
+def test_from_checkpoint_multiprocess_blocks(tmp_path, rng):
+    """A list of per-process block files is ONE multi-host save: the global
+    ensemble reassembles regardless of which process's file comes first."""
+    rows = rng.normal(size=(8, 3)).astype(np.float32)
+    a = str(tmp_path / "p0")
+    b = str(tmp_path / "p1")
+    save_state(a, {"particles": rows[:4], "particles_start": np.int64(0),
+                   "t": np.int64(2)})
+    save_state(b, {"particles": rows[4:], "particles_start": np.int64(4),
+                   "t": np.int64(2)})
+    eng = PredictiveEngine.from_checkpoint([b, a], "logreg")
+    np.testing.assert_array_equal(np.asarray(eng.particles), rows)
+
+
+def test_from_checkpoint_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        PredictiveEngine.from_checkpoint(str(tmp_path / "nope"), "logreg")
+    save_state(str(tmp_path / "c"), {"other": np.ones((2, 2))})
+    with pytest.raises(KeyError, match="particles"):
+        PredictiveEngine.from_checkpoint(str(tmp_path / "c"), "logreg")
+    CheckpointManager(str(tmp_path / "empty_root"), every=1)
+    with pytest.raises(ValueError, match="empty"):
+        # no step dirs -> treated as a save_state dir, which it isn't either
+        PredictiveEngine.from_checkpoint(str(tmp_path / "empty_root"), "logreg")
+
+
+# --------------------------------------------------------------------- #
+# batcher edge cases (ISSUE satellite): all through the injectable clock
+
+
+def _echo_dispatch(calls):
+    """Dispatch that records batch sizes and returns row indices, so scatter
+    correctness is visible in the results."""
+
+    def dispatch(x):
+        calls.append(x.shape[0])
+        return {"val": x[:, 0].copy()}
+
+    return dispatch
+
+
+def test_partial_flush_on_max_wait_expiry(rng):
+    """A lone small request must not wait forever for co-travellers: the
+    max_wait_ms deadline flushes a partial batch."""
+    calls = []
+    bat, clock = make_batcher(_echo_dispatch(calls), max_batch=64, max_wait_ms=5.0)
+    fut = bat.submit(np.arange(3, dtype=np.float32)[:, None])
+    bat.start()
+    out = fut.result(timeout=10)
+    np.testing.assert_array_equal(out["val"], [0, 1, 2])
+    assert calls == [3]  # flushed well under max_batch
+    assert clock.t >= 5e-3  # and only after the wait window expired
+    bat.close()
+
+
+def test_oversize_request_splits_not_deadlocks(rng):
+    """A single request > max_batch splits into max_batch-row chunks and
+    reassembles in order — it can never wait for an impossible batch slot."""
+    calls = []
+    bat, _ = make_batcher(_echo_dispatch(calls), max_batch=8, max_wait_ms=1.0)
+    x = np.arange(20, dtype=np.float32)[:, None]
+    fut = bat.submit(x)
+    bat.start()
+    out = fut.result(timeout=10)
+    np.testing.assert_array_equal(out["val"], np.arange(20))
+    assert calls == [8, 8, 4]
+    bat.close()
+
+
+def test_bucket_boundary_batches(rng):
+    """Exactly-at and one-past the coalescing ceiling: 16 rows ride one
+    dispatch, 17 rows split 16+1; engine buckets follow suit (16 stays in
+    the 16-bucket, 17 pads to 32) without extra compiles thereafter."""
+    eng, _ = _logreg_engine(rng, min_bucket=4, max_bucket=32)
+    bat, _ = make_batcher(eng.predict, max_batch=16, max_wait_ms=1.0)
+    futs = [bat.submit(np.zeros((8, 4), np.float32)) for _ in range(2)]
+    bat.start()
+    for f in futs:
+        f.result(timeout=10)
+    st = bat.stats()
+    assert (st["batches"], st["batch_occupancy_max"]) == (1, 16)
+    assert eng.stats()["compiled_buckets"] == [16]
+
+    # one past: 17 rows -> 16 + 1, second batch pads into bucket 4
+    f17 = bat.submit(np.zeros((17, 4), np.float32))
+    f17.result(timeout=10)
+    st = bat.stats()
+    assert st["batches"] == 3 and st["batch_occupancy_max"] == 16
+    assert eng.stats()["compiled_buckets"] == [4, 16]
+    bat.close()
+
+
+def test_shed_on_overflow_is_clean(rng):
+    """Past max_queue_rows, submit fails fast with Overloaded — the client
+    gets an immediate clean error, never a hang — and nothing already
+    queued is lost."""
+    calls = []
+    bat, _ = make_batcher(
+        _echo_dispatch(calls), max_batch=4, max_wait_ms=1.0, max_queue_rows=8
+    )
+    f1 = bat.submit(np.ones((4, 1), np.float32))
+    f2 = bat.submit(np.ones((4, 1), np.float32))
+    with pytest.raises(Overloaded, match="queue full"):
+        bat.submit(np.ones((1, 1), np.float32))
+    assert bat.stats()["shed"] == 1
+    bat.start()
+    for f in (f1, f2):
+        assert f.result(timeout=10)["val"].shape == (4,)
+    bat.close()
+
+
+def test_close_drains_queued_requests():
+    calls = []
+    bat, _ = make_batcher(_echo_dispatch(calls), max_batch=4, max_wait_ms=1.0)
+    futs = [bat.submit(np.full((2, 1), i, np.float32)) for i in range(3)]
+    bat.start()
+    bat.close(drain=True)
+    for i, f in enumerate(futs):
+        np.testing.assert_array_equal(f.result(timeout=1)["val"], [i, i])
+    with pytest.raises(RuntimeError, match="closed"):
+        bat.submit(np.ones((1, 1), np.float32))
+
+
+def test_close_without_drain_cancels():
+    bat, _ = make_batcher(_echo_dispatch([]), max_batch=4, max_wait_ms=1.0)
+    fut = bat.submit(np.ones((2, 1), np.float32))
+    bat.close(drain=False)
+    with pytest.raises(CancelledError):
+        fut.result(timeout=1)
+
+
+def test_dispatch_error_propagates_to_futures():
+    def boom(x):
+        raise RuntimeError("device on fire")
+
+    bat, _ = make_batcher(boom, max_batch=4, max_wait_ms=1.0)
+    fut = bat.submit(np.ones((2, 1), np.float32))
+    bat.start()
+    with pytest.raises(RuntimeError, match="device on fire"):
+        fut.result(timeout=10)
+    assert bat.stats()["dispatch_errors"] == 1
+    bat.close()
+
+
+def test_batcher_validates_args():
+    with pytest.raises(ValueError, match="max_batch"):
+        MicroBatcher(lambda x: {}, max_batch=0, autostart=False)
+    with pytest.raises(ValueError, match="max_queue_rows"):
+        MicroBatcher(lambda x: {}, max_batch=8, max_queue_rows=4, autostart=False)
+    bat = MicroBatcher(lambda x: {}, autostart=False)
+    with pytest.raises(ValueError, match="non-empty"):
+        bat.submit(np.zeros((0, 3), np.float32))
+    bat.close()
+
+
+# --------------------------------------------------------------------- #
+# the end-to-end acceptance test (ISSUE 2): train -> checkpoint -> serve
+
+
+def test_end_to_end_bitwise(tmp_path, rng):
+    """Train a small logreg ensemble, checkpoint it, serve it through the
+    batcher under concurrent mixed-size requests, and pin:
+
+    (a) served predictions bitwise-equal a direct
+        posterior_predictive_prob call on the same ensemble;
+    (b) at most ceil(log2(max_batch)) + 1 distinct compiled shapes;
+    (c) batch occupancy > 1 under concurrent load.
+    """
+    from dist_svgd_tpu import Sampler
+    from dist_svgd_tpu.models.logreg import make_logreg_logp
+
+    k = 6
+    x_train = rng.normal(size=(40, k))
+    t_train = np.where(rng.normal(size=40) > 0, 1.0, -1.0)
+    sampler = Sampler(1 + k, make_logreg_logp(x_train, t_train))
+    final, _ = sampler.run(48, 15, 1e-2, seed=3, record=False)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), every=5)
+    mgr.save(15, {"particles": np.asarray(final), "t": 15})
+
+    max_batch = 32
+    engine = PredictiveEngine.from_checkpoint(
+        str(tmp_path / "ckpt"), "logreg", min_bucket=4, max_bucket=max_batch
+    )
+    bat, _ = make_batcher(engine.predict, max_batch=max_batch, max_wait_ms=2.0)
+
+    x_test = rng.normal(size=(37, k)).astype(np.float32)
+    sizes = [1, 3, 4, 7, 2, 16, 1, 3]
+    assert sum(sizes) == len(x_test)
+    offsets = np.cumsum([0] + sizes)
+    # all requests queued before the worker starts: concurrent arrival,
+    # deterministic coalescing
+    futs = [
+        bat.submit(x_test[offsets[i]:offsets[i + 1]]) for i in range(len(sizes))
+    ]
+    bat.start()
+    served = np.concatenate([f.result(timeout=30)["mean"] for f in futs])
+    bat.close()
+
+    # (a) bitwise equality with the one-shot helper on the same ensemble
+    direct = np.asarray(jnp.mean(
+        posterior_predictive_prob(engine.particles, jnp.asarray(x_test)), axis=0
+    ))
+    np.testing.assert_array_equal(served, direct)
+
+    # (b) the bucket cache bounds traced shapes at ceil(log2) of max_batch
+    st = engine.stats()
+    assert st["bucket_misses"] == len(st["compiled_buckets"])
+    assert st["bucket_misses"] <= math.ceil(math.log2(max_batch)) + 1
+
+    # (c) the batcher actually coalesced concurrent requests
+    bst = bat.stats()
+    assert bst["requests"] == len(sizes)
+    assert bst["batch_occupancy_mean"] > 1
+    assert bst["requests_per_batch_mean"] > 1
+
+
+# --------------------------------------------------------------------- #
+# HTTP front end
+
+
+def _get(url, path):
+    return json.loads(urllib.request.urlopen(url + path, timeout=10).read())
+
+
+def _post(url, path, doc):
+    req = urllib.request.Request(
+        url + path, json.dumps(doc).encode(), {"Content-Type": "application/json"}
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+
+def test_server_routes_and_drain(rng):
+    eng, parts = _logreg_engine(rng)
+    with PredictionServer(eng, port=0, max_batch=16, max_wait_ms=2.0) as srv:
+        health = _get(srv.url, "/healthz")
+        assert health["status"] == "ok"
+        assert health["n_particles"] == 32 and health["feature_dim"] == 4
+
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        out = _post(srv.url, "/predict", {"inputs": x.tolist()})["outputs"]
+        ref = np.asarray(jnp.mean(posterior_predictive_prob(
+            jnp.asarray(parts), jnp.asarray(x)), axis=0))
+        np.testing.assert_allclose(out["mean"], ref, rtol=1e-6)
+        assert len(out["var"]) == 3
+
+        # single-row shorthand
+        one = _post(srv.url, "/predict", {"inputs": x[0].tolist()})["outputs"]
+        assert len(one["mean"]) == 1
+
+        metrics = _get(srv.url, "/metrics")
+        assert metrics["http_requests"] == 2
+        assert metrics["batcher"]["requests"] == 2
+        assert metrics["engine"]["model"] == "logreg"
+    # graceful drain: batcher closed behind the context manager
+    with pytest.raises(RuntimeError, match="closed"):
+        srv.batcher.submit(x)
+
+
+def test_server_error_codes(rng):
+    eng, _ = _logreg_engine(rng)
+    with PredictionServer(eng, port=0, max_wait_ms=1.0) as srv:
+        for body, want in ((b"not json", 400), (b'{"no_inputs": 1}', 400)):
+            req = urllib.request.Request(
+                srv.url + "/predict", body, {"Content-Type": "application/json"}
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == want
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/nope", timeout=10)
+        assert ei.value.code == 404
+        assert _get(srv.url, "/metrics")["http_errors"] == 2
+
+
+def test_server_concurrent_load_coalesces(rng):
+    """Acceptance (c) over real HTTP: concurrent requests land in shared
+    batches — /metrics shows occupancy > 1."""
+    eng, _ = _logreg_engine(rng)
+    # 80 ms window: every thread below submits well inside it
+    with PredictionServer(eng, port=0, max_batch=64, max_wait_ms=80.0) as srv:
+        barrier = threading.Barrier(8)
+        errs = []
+
+        def fire():
+            try:
+                barrier.wait(timeout=10)
+                _post(srv.url, "/predict",
+                      {"inputs": np.zeros((2, 4)).tolist()})
+            except Exception as e:  # pragma: no cover - diagnostic
+                errs.append(e)
+
+        threads = [threading.Thread(target=fire) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        m = _get(srv.url, "/metrics")
+        assert m["batcher"]["requests"] == 8
+        assert m["batcher"]["batch_occupancy_mean"] > 1
+        assert m["batcher"]["requests_per_batch_mean"] > 1
+
+
+def test_server_sheds_with_503(rng):
+    """Overload surfaces as HTTP 503, not a hung connection: the batcher
+    never starts, so queued rows accumulate until the bound trips."""
+    eng, _ = _logreg_engine(rng)
+    bat, _ = make_batcher(eng.predict, max_batch=4, max_queue_rows=4,
+                          max_wait_ms=1.0)
+    srv = PredictionServer(eng, port=0, batcher=bat).start()
+    try:
+        t = threading.Thread(
+            target=lambda: _post(srv.url, "/predict",
+                                 {"inputs": np.zeros((4, 4)).tolist()})
+        )
+        t.start()  # fills the queue (worker not started -> stays queued)
+        poll = threading.Event()
+        for _ in range(1000):  # ≤ 5 s, normally a handful of ms
+            if bat.stats()["queued_rows"] >= 4:
+                break
+            poll.wait(0.005)
+        assert bat.stats()["queued_rows"] >= 4
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req = urllib.request.Request(
+                srv.url + "/predict",
+                json.dumps({"inputs": np.zeros((4, 4)).tolist()}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        bat.start()
+        t.join(timeout=10)
+    finally:
+        bat.start()
+        srv.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# serve_bench emits the BENCH-style row
+
+
+def test_serve_bench_row_schema():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import serve_bench
+
+    row = serve_bench.run_bench(
+        model="logreg", n_particles=64, n_features=4, clients=4, requests=40,
+        rows=(1, 4), max_batch=16, max_wait_ms=1.0,
+        open_rate=2000.0, open_requests=20,
+    )
+    for key in ("metric", "value", "unit", "p50_ms", "p99_ms",
+                "queue_wait_p50_ms", "device_p50_ms", "batch_occupancy_mean",
+                "recompiles", "bucket_hit_rate", "shed", "open_loop"):
+        assert key in row, key
+    assert row["metric"] == "serve_throughput"
+    assert row["value"] > 0
+    assert row["recompiles"] == 0  # warmup precedes the timed window
+    assert row["open_loop"]["completed"] == 20
+    json.dumps(row)  # one BENCH-style JSON line, serialisable as-is

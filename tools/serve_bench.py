@@ -1,0 +1,318 @@
+"""Serving load generator: closed- and open-loop traffic against the
+micro-batched predictive engine, one BENCH-style JSON row out.
+
+Two loops because they answer different questions (classic load-gen
+distinction):
+
+- **closed loop** (`--clients` threads, each issuing its next request only
+  after the previous resolves) measures sustainable throughput and the
+  latency the system settles into at its own pace — coordinated omission
+  included by construction, so it flatters latency under saturation;
+- **open loop** (requests issued on a fixed-rate schedule regardless of
+  completions, latency measured from the *scheduled* arrival) is the honest
+  latency probe at a target arrival rate, and shows shed-on-overflow doing
+  its job when the rate exceeds capacity.
+
+The timed window excludes engine warm-up (every padding bucket pre-traced),
+so ``recompiles`` reports steady-state bucket-cache misses — the engine's
+contract is that this is 0.
+
+In-process by default (engine + batcher, no network noise — the number
+``perf_regress.py``'s ``serve_throughput`` incumbent gates); ``--url`` points
+the closed loop at a live ``serving.server`` instead (adds HTTP+JSON cost).
+
+Output: one JSON row, e.g.::
+
+    {"metric": "serve_throughput", "value": 1234.5, "unit": "requests/sec",
+     "rows_per_sec": 8641.5, "p50_ms": 3.1, "p99_ms": 9.8,
+     "queue_wait_p50_ms": 1.2, "device_p50_ms": 1.7,
+     "batch_occupancy_mean": 7.0, "requests_per_batch_mean": 5.2,
+     "recompiles": 0, "bucket_hit_rate": 1.0, "shed": 0,
+     "open_loop": {"rate_rps": 500, "achieved_rps": 499.1, "p50_ms": 2.9,
+                   "p99_ms": 11.0, "shed": 0}, ...}
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dist_svgd_tpu.serving.batcher import _percentile  # noqa: E402
+
+
+def build_engine(model="logreg", n_particles=10_000, n_features=54,
+                 checkpoint=None, seed=0, max_bucket=256):
+    """Checkpointed ensemble when given, else a seeded synthetic one —
+    serving throughput depends on shapes, not on convergence."""
+    import numpy as np
+
+    from dist_svgd_tpu.serving import PredictiveEngine
+
+    if checkpoint:
+        source = checkpoint if len(checkpoint) > 1 else checkpoint[0]
+        return PredictiveEngine.from_checkpoint(
+            source, model, n_features=n_features if model == "bnn" else None,
+            max_bucket=max_bucket,
+        )
+    rng = np.random.default_rng(seed)
+    if model == "logreg":
+        parts = rng.normal(size=(n_particles, 1 + n_features))
+    elif model == "bnn":
+        from dist_svgd_tpu.models.bnn import num_params
+
+        parts = rng.normal(size=(n_particles, num_params(n_features)))
+    else:  # gmm
+        parts = rng.normal(size=(n_particles, n_features))
+    return PredictiveEngine(
+        model, parts.astype(np.float32),
+        n_features=n_features if model == "bnn" else None,
+        max_bucket=max_bucket,
+    )
+
+
+def _request_pool(feature_dim, rows_cycle, pool=256, seed=1):
+    """Pre-generated request arrays (generation cost must not be timed)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [
+        rng.normal(size=(rows_cycle[i % len(rows_cycle)], feature_dim))
+        .astype(np.float32)
+        for i in range(pool)
+    ]
+
+
+def closed_loop(submit, pool, clients, requests):
+    """`clients` threads, next request only after the last resolved."""
+    from dist_svgd_tpu.serving.batcher import Overloaded
+
+    lock = threading.Lock()
+    issued = [0]
+    lats, shed = [], [0]
+
+    def worker():
+        while True:
+            with lock:
+                if issued[0] >= requests:
+                    return
+                i = issued[0]
+                issued[0] += 1
+            t0 = time.perf_counter()
+            try:
+                submit(pool[i % len(pool)]).result(timeout=60)
+            except Overloaded:
+                with lock:
+                    shed[0] += 1
+                continue
+            lat = (time.perf_counter() - t0) * 1e3
+            with lock:
+                lats.append(lat)
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lats.sort()
+    return {
+        "wall_s": wall,
+        "completed": len(lats),
+        "shed": shed[0],
+        "rps": len(lats) / wall if wall > 0 else 0.0,
+        "p50_ms": _percentile(lats, 0.50),
+        "p99_ms": _percentile(lats, 0.99),
+    }
+
+
+def open_loop(submit, pool, rate_rps, requests):
+    """Fixed-rate arrivals; latency from the scheduled arrival time, so a
+    backed-up queue is charged to the system, not hidden by the generator
+    (no coordinated omission)."""
+    from dist_svgd_tpu.serving.batcher import Overloaded
+
+    lock = threading.Lock()
+    lats, shed = [], [0]
+    done = threading.Semaphore(0)
+    interval = 1.0 / rate_rps
+    start = time.perf_counter()
+
+    def on_done(scheduled, fut):
+        lat = (time.perf_counter() - scheduled) * 1e3
+        with lock:
+            if fut.exception() is None:
+                lats.append(lat)
+        done.release()
+
+    for i in range(requests):
+        scheduled = start + i * interval
+        now = time.perf_counter()
+        if scheduled > now:
+            time.sleep(scheduled - now)
+        try:
+            fut = submit(pool[i % len(pool)])
+        except Overloaded:
+            with lock:
+                shed[0] += 1
+            done.release()
+            continue
+        fut.add_done_callback(
+            lambda f, s=max(scheduled, now): on_done(s, f)
+        )
+    for _ in range(requests):
+        done.acquire(timeout=60)
+    wall = time.perf_counter() - start
+    lats.sort()
+    return {
+        "rate_rps": rate_rps,
+        "achieved_rps": len(lats) / wall if wall > 0 else 0.0,
+        "completed": len(lats),
+        "shed": shed[0],
+        "p50_ms": _percentile(lats, 0.50),
+        "p99_ms": _percentile(lats, 0.99),
+    }
+
+
+def _http_submit(url):
+    """Closed-loop transport for --url: one blocking HTTP round trip per
+    request, dressed as a resolved future."""
+    import urllib.request
+    from concurrent.futures import Future
+
+    def submit(x):
+        req = urllib.request.Request(
+            url.rstrip("/") + "/predict",
+            json.dumps({"inputs": x.tolist()}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        body = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        fut = Future()
+        if "outputs" in body:
+            fut.set_result(body["outputs"])
+        else:
+            fut.set_exception(RuntimeError(body.get("error", "bad reply")))
+        return fut
+
+    return submit
+
+
+def run_bench(model="logreg", n_particles=10_000, n_features=54,
+              clients=16, requests=2000, rows=(1, 4, 16), max_batch=256,
+              max_wait_ms=2.0, max_queue_rows=8192, open_rate=0.0,
+              open_requests=500, checkpoint=None, seed=0, url=None):
+    """Measure and return the JSON row (importable — perf_regress uses this)."""
+    import jax
+
+    from dist_svgd_tpu.serving import MicroBatcher
+
+    engine = build_engine(model, n_particles, n_features, checkpoint, seed,
+                          max_bucket=max_batch)
+    pool = _request_pool(engine.feature_dim, list(rows))
+    row = {
+        "metric": "serve_throughput",
+        "unit": "requests/sec",
+        "platform": jax.devices()[0].platform,
+        "model": engine.model,
+        "n_particles": engine.n_particles,
+        "feature_dim": engine.feature_dim,
+        "clients": clients,
+        "requests": requests,
+        "rows_per_request": list(rows),
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+    }
+    if url:
+        closed = closed_loop(_http_submit(url), pool, clients, requests)
+        row.update(transport="http", url=url, value=round(closed["rps"], 1),
+                   p50_ms=round(closed["p50_ms"], 3),
+                   p99_ms=round(closed["p99_ms"], 3), shed=closed["shed"])
+        return row
+
+    engine.warmup()  # steady-state measurement: no compiles in the window
+    misses_before = engine.stats()["bucket_misses"]
+    batcher = MicroBatcher(
+        engine.predict, max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue_rows=max_queue_rows,
+    )
+    try:
+        closed = closed_loop(batcher.submit, pool, clients, requests)
+        open_row = None
+        if open_rate > 0:
+            open_row = open_loop(batcher.submit, pool, open_rate, open_requests)
+    finally:
+        batcher.close(drain=True)
+    bstats = batcher.stats()
+    estats = engine.stats()
+    lookups = estats["bucket_hits"] + estats["bucket_misses"] - misses_before
+    mean_rows = sum(rows) / len(rows)
+    row.update(
+        transport="inprocess",
+        value=round(closed["rps"], 1),
+        rows_per_sec=round(closed["rps"] * mean_rows, 1),
+        wall_s=round(closed["wall_s"], 3),
+        p50_ms=round(closed["p50_ms"], 3),
+        p99_ms=round(closed["p99_ms"], 3),
+        queue_wait_p50_ms=round(bstats["queue_wait_p50_ms"], 3),
+        queue_wait_p99_ms=round(bstats["queue_wait_p99_ms"], 3),
+        device_p50_ms=round(bstats["device_p50_ms"], 3),
+        device_p99_ms=round(bstats["device_p99_ms"], 3),
+        batch_occupancy_mean=round(bstats["batch_occupancy_mean"], 2),
+        requests_per_batch_mean=round(bstats["requests_per_batch_mean"], 2),
+        recompiles=estats["bucket_misses"] - misses_before,
+        bucket_hit_rate=round(estats["bucket_hits"] / lookups, 4)
+        if lookups else 1.0,
+        # closed_loop's own count, NOT plus the batcher's _n_shed — the
+        # batcher increments before raising the same Overloaded the loop
+        # counts, and its total also includes open-loop sheds
+        shed=closed["shed"],
+    )
+    if open_row is not None:
+        row["open_loop"] = {k: round(v, 3) if isinstance(v, float) else v
+                            for k, v in open_row.items()}
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=("logreg", "bnn", "gmm"), default="logreg")
+    ap.add_argument("--n-particles", type=int, default=10_000)
+    ap.add_argument("--n-features", type=int, default=54,
+                    help="feature width (logreg/bnn inputs; gmm particle dim)")
+    ap.add_argument("--checkpoint", action="append", default=None,
+                    help="serve a real ensemble (repeatable for one "
+                         "multi-host save); default is a seeded synthetic one")
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--rows", default="1,4,16",
+                    help="comma-separated request sizes, cycled")
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue-rows", type=int, default=8192)
+    ap.add_argument("--open-rate", type=float, default=0.0,
+                    help="also run an open loop at this requests/sec (0 = off)")
+    ap.add_argument("--open-requests", type=int, default=500)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--url", default=None,
+                    help="closed-loop against a live serving.server "
+                         "instead of in-process")
+    args = ap.parse_args()
+
+    rows = tuple(int(r) for r in args.rows.split(","))
+    out = run_bench(
+        model=args.model, n_particles=args.n_particles,
+        n_features=args.n_features, clients=args.clients,
+        requests=args.requests, rows=rows, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue_rows=args.max_queue_rows,
+        open_rate=args.open_rate, open_requests=args.open_requests,
+        checkpoint=args.checkpoint, seed=args.seed, url=args.url,
+    )
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
