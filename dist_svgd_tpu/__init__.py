@@ -12,8 +12,14 @@ implementation `Sandy4321/dist-svgd` (see SURVEY.md):
 - `models`         — GMM and Bayesian logistic regression log-densities
 - `parallel`       — mesh utilities + SPMD exchange strategies
 - `serving`        — posterior-predictive serving of checkpointed ensembles
-                     (micro-batched engine + HTTP front end; import
-                     `dist_svgd_tpu.serving` explicitly — not loaded here)
+                     (micro-batched engine + HTTP front end + checkpoint
+                     hot reload; import `dist_svgd_tpu.serving` explicitly
+                     — not loaded here)
+- `resilience`     — fault-tolerant training: supervised segmented runs
+                     with periodic/signal checkpointing, bitwise-exact
+                     resume, retry/backoff, numerical guards, and a
+                     deterministic fault-injection harness (import
+                     `dist_svgd_tpu.resilience` explicitly)
 - `utils`          — datasets, history recording, RNG helpers
 
 Where the reference evaluates k(x, y) and its autograd one particle-pair at a

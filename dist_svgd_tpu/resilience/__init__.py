@@ -1,0 +1,59 @@
+"""Fault-tolerant training: supervised runs, fault injection, numerical
+guards.
+
+A multi-hour DistSampler run is glass without this package: one preemption,
+transient dispatch failure, or NaN blowup loses the whole trajectory.  The
+subsystem wraps both samplers with the recovery behaviours the serving path
+already has for overload:
+
+- :mod:`supervisor` — :class:`RunSupervisor`: bounded segments on an
+  absolute step grid, periodic + signal-triggered checkpointing
+  (``utils/checkpoint.py`` layouts), bitwise-exact resume-from-latest,
+  retry with exponential backoff and a bounded restart budget;
+- :mod:`guards` — jitted NaN/Inf / norm-explosion / step-divergence checks
+  with a rollback + step-size-backoff policy;
+- :mod:`faults` — deterministic fault injection (raise-on-step-k, NaN into
+  the carry, simulated preemption, simulated hard kill, artificial slow
+  dispatch) so every recovery path runs in tier-1 on CPU.
+
+The serve side composes through
+``serving/engine.py:CheckpointHotReloader`` (a live server picks up the
+supervisor's checkpoints between micro-batches — train-while-serving);
+``tools/fault_drill.py`` measures recovery wall / steps lost / checkpoint
+overhead as one BENCH-style JSON row, and
+``experiments/resilient_covertype.py`` demonstrates kill → resume → serve.
+"""
+
+from dist_svgd_tpu.resilience.faults import (
+    FaultPlan,
+    HardKillAt,
+    InjectNaNAt,
+    PreemptAt,
+    RaiseAt,
+    SimulatedHardKill,
+    SlowSegmentAt,
+    TransientDispatchError,
+)
+from dist_svgd_tpu.resilience.guards import GuardConfig, GuardViolation, check_state
+from dist_svgd_tpu.resilience.supervisor import (
+    RestartBudgetExhausted,
+    RetryPolicy,
+    RunSupervisor,
+)
+
+__all__ = [
+    "RunSupervisor",
+    "RetryPolicy",
+    "RestartBudgetExhausted",
+    "GuardConfig",
+    "GuardViolation",
+    "check_state",
+    "FaultPlan",
+    "RaiseAt",
+    "InjectNaNAt",
+    "PreemptAt",
+    "HardKillAt",
+    "SlowSegmentAt",
+    "TransientDispatchError",
+    "SimulatedHardKill",
+]

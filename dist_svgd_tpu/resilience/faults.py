@@ -1,0 +1,131 @@
+"""Deterministic fault injection for supervised runs.
+
+A multi-hour training run meets faults the test suite cannot wait for —
+preemptions, transient dispatch failures, NaN blowups, pool slowdowns.  This
+module makes every one of them a **scheduled, deterministic event** so each
+recovery path in :mod:`~dist_svgd_tpu.resilience.supervisor` runs in tier-1
+on CPU with no real signals, sleeps, or flaky hardware:
+
+- faults are keyed by **absolute step index** and fire at the first segment
+  boundary whose step counter reaches it (the same quantisation a real
+  SIGTERM gets: the supervisor finishes the in-flight dispatch first, then
+  acts).  Run with ``segment_steps=1`` to pin a fault to an exact step.
+- each fault fires **once** — a retried/rolled-back segment replays clean,
+  which is exactly how a transient fault behaves.
+
+The injection surface is the supervisor itself (the ``ctx`` argument):
+``ctx.t``, ``ctx.corrupt_particles()``, ``ctx.request_stop()``,
+``ctx.advance_clock()`` — the same hooks a signal handler or a watchdog
+would use, so injected faults and real ones share one recovery code path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class TransientDispatchError(RuntimeError):
+    """Stand-in for a transient device/dispatch failure (the retryable kind:
+    a pool hiccup, a severed tunnel, a watchdog kill).  The supervisor's
+    default retry policy catches it alongside ``jax.errors.JaxRuntimeError``."""
+
+
+class SimulatedHardKill(RuntimeError):
+    """Stand-in for SIGKILL / power loss: deliberately **not** in the default
+    retryable set, so it unwinds straight through the supervisor without a
+    checkpoint — the process is simply gone.  Recovery is a fresh
+    ``RunSupervisor(...).run(resume=True)``, which is what
+    ``tools/fault_drill.py`` measures."""
+
+
+class Fault:
+    """One scheduled fault.  Fires once, at the first segment boundary with
+    step counter ``>= step``."""
+
+    def __init__(self, step: int):
+        self.step = int(step)
+        self.fired = False
+
+    def fire(self, ctx) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(step={self.step}, fired={self.fired})"
+
+
+class RaiseAt(Fault):
+    """Raise a transient dispatch failure — exercises retry + exponential
+    backoff + rollback-to-last-checkpoint."""
+
+    def __init__(self, step: int, exc: Optional[Exception] = None):
+        super().__init__(step)
+        self.exc = exc
+
+    def fire(self, ctx) -> None:
+        raise self.exc if self.exc is not None else TransientDispatchError(
+            f"injected transient dispatch failure at step {ctx.t}"
+        )
+
+
+class InjectNaNAt(Fault):
+    """Overwrite one entry of the carried particle state with NaN — the
+    minimal numerical blowup the guards must detect and roll back."""
+
+    def fire(self, ctx) -> None:
+        ctx.corrupt_particles()
+
+
+class PreemptAt(Fault):
+    """Simulated preemption notice (SIGTERM-shaped): requests a stop, which
+    the supervisor honours at the boundary with a final checkpoint and a
+    ``'preempted'`` report — resume-exact by construction."""
+
+    def fire(self, ctx) -> None:
+        ctx.request_stop(f"injected preemption at step {ctx.t}")
+
+
+class HardKillAt(Fault):
+    """Simulated SIGKILL: raises :class:`SimulatedHardKill`, which the
+    supervisor does NOT catch — no checkpoint, no cleanup, state as of the
+    last periodic save.  The fault-drill's kill-mid-run event."""
+
+    def fire(self, ctx) -> None:
+        raise SimulatedHardKill(f"injected hard kill at step {ctx.t}")
+
+
+class SlowSegmentAt(Fault):
+    """Artificial slow dispatch: advances the supervisor's (injectable)
+    clock by ``seconds`` so the next segment wall measures slow — exercises
+    the ``slow_segment_warn_s`` watchdog without real waiting."""
+
+    def __init__(self, step: int, seconds: float):
+        super().__init__(step)
+        self.seconds = float(seconds)
+
+    def fire(self, ctx) -> None:
+        ctx.advance_clock(self.seconds)
+
+
+class FaultPlan:
+    """An ordered schedule of faults, consumed by the supervisor at every
+    segment boundary.  ``fire_due`` fires every not-yet-fired fault whose
+    step has been reached, in step order; a raising fault leaves later ones
+    pending for the retried boundary (each still fires exactly once)."""
+
+    def __init__(self, *faults: Fault):
+        if len(faults) == 1 and isinstance(faults[0], (list, tuple)):
+            faults = tuple(faults[0])
+        self.faults: Sequence[Fault] = sorted(faults, key=lambda f: f.step)
+
+    def fire_due(self, ctx) -> None:
+        for f in self.faults:
+            if not f.fired and f.step <= ctx.t:
+                f.fired = True  # before fire(): a raising fault is spent
+                f.fire(ctx)
+
+    @property
+    def exhausted(self) -> bool:
+        return all(f.fired for f in self.faults)
+
+    def __repr__(self):
+        return f"FaultPlan({list(self.faults)!r})"

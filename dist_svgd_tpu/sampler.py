@@ -169,6 +169,42 @@ class Sampler:
             self._kernel = RBF(h)
             self._phi = resolve_phi_fn(self._kernel, self._phi_impl)
 
+    def freeze_median_kernel(self, particles) -> float:
+        """Resolve ``kernel='median'`` from ``particles`` NOW and pin the
+        resulting bandwidth for every later :meth:`run` call.
+
+        A segmented drive (``resilience.RunSupervisor``, or any manual
+        chunking via repeated ``initial_particles`` calls) must not let each
+        segment re-resolve the bandwidth from its own start state — that
+        would optimise a different kernel per segment and break
+        resume-exactness.  Returns the pinned bandwidth (record it in resume
+        state; re-pin with ``freeze_median_kernel`` is idempotent).  No-op
+        (returns the current bandwidth) for fixed-bandwidth kernels; raises
+        for ``'median_step'``, whose per-step re-resolution lives inside the
+        jitted scan and is already segment-invariant."""
+        if isinstance(self._kernel, AdaptiveRBF):
+            raise ValueError(
+                "kernel='median_step' re-resolves inside the scan and needs "
+                "no freezing"
+            )
+        if self._median_kernel:
+            self._resolve_median_kernel(jnp.asarray(particles))
+            self._median_kernel = False
+        return float(self._kernel.bandwidth)
+
+    def pin_kernel_bandwidth(self, bandwidth: float) -> None:
+        """Bind a fixed ``RBF(bandwidth)`` and disable any pending
+        ``kernel='median'`` per-run resolution — the restore path of
+        :meth:`freeze_median_kernel` (a resumed supervised run re-pins the
+        bandwidth recorded in its checkpoint instead of re-resolving from
+        the resumed particles)."""
+        from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
+
+        self._median_kernel = False
+        if self._kernel != RBF(float(bandwidth)):
+            self._kernel = RBF(float(bandwidth))
+            self._phi = resolve_phi_fn(self._kernel, self._phi_impl)
+
     def _run_fn(self, num_iter: int, record: bool):
         """Build (and cache) the jitted scan over `num_iter` steps."""
         cache_key = (num_iter, record, self._kernel.bandwidth
@@ -223,13 +259,34 @@ class Sampler:
         dtype=None,
         dispatch_budget: Optional[float] = None,
         pairs_per_sec: Optional[float] = None,
+        step_offset: int = 0,
     ):
         """Raw-array variant of :meth:`sample`.
 
         Returns ``(final_particles, history)`` where ``history`` is a
-        ``(num_iter + 1, n, d)`` device array (pre-update snapshots plus the
+        ``(num_iter + 1, n, d)`` array (pre-update snapshots plus the
         final state) or ``None`` when ``record=False``.  ``dtype`` defaults to
         the dtype of ``initial_particles`` when given, else float32.
+
+        ``step_offset`` is the absolute index of this call's first step in a
+        longer logical run: it offsets the per-step minibatch key fold (and
+        nothing else), so a segmented drive — ``resilience.RunSupervisor``,
+        or manual resume via ``initial_particles`` — draws the exact
+        minibatch stream the monolithic run would.  Without ``batch_size``
+        it is inert.
+
+        Recorded histories are **HBM-budget chunked** automatically: when the
+        ``(num_iter, n, d)`` pre-update stack would exceed
+        :data:`~dist_svgd_tpu.utils.history.RECORD_HBM_BUDGET_BYTES`
+        (TPU lane padding counted — each snapshot is physically
+        ``n × max(d, 128)`` floats), the run splits into
+        :func:`~dist_svgd_tpu.utils.history.record_chunk_steps`-sized scan
+        dispatches whose history chunks are fetched to host while the next
+        chunk's scan runs (the D2H copy overlaps compute on hosts with an
+        async transfer engine).  Whenever the run chunks with
+        ``record=True`` the returned history is a **host** ``np.ndarray``
+        (holding it on device would defeat the budget); monolithic runs
+        return the device array as before.
 
         ``dispatch_budget`` (seconds) splits the run into multiple scan
         dispatches of at most that estimated duration (pair throughput from
@@ -243,18 +300,14 @@ class Sampler:
         dispatch; the ``DistSampler`` ring executor is the tool past that
         boundary).  Each call writes :attr:`last_run_stats`.
 
-        Memory note: with ``record=True`` the whole ``(num_iter, n, d)``
-        history stack lives in HBM for the duration of the call, and TPU
-        lane padding makes each snapshot physically ``n × max(d, 128)``
-        floats.  At large ``n`` drive recorded trajectories in budget-sized
-        chunks — ``dispatch_budget`` above, or manually via repeated calls
-        with ``initial_particles`` (the pattern ``experiments/logreg.py:
-        record_chunk_steps`` implements for the distributed driver).  Two
-        caveats the manual route must handle itself: with ``batch_size``
-        set, vary ``seed`` per chunk (e.g. ``seed=steps_done``) — a fixed
-        seed replays the same minibatch-key stream every chunk instead of a
-        stochastic trajectory — and drop each chunk's trailing history row
-        before concatenating (it is the chunk's final state, which
+        Memory note: the history HBM budget above is enforced
+        automatically — callers no longer chunk recorded runs by hand.  A
+        manual segmented drive (repeated calls with ``initial_particles``,
+        e.g. for checkpointed resume) should keep ``seed`` FIXED and pass
+        ``step_offset=steps_done`` so the minibatch key stream continues
+        the monolithic one exactly (``resilience/supervisor.py`` does
+        this); when recording manually, drop each chunk's trailing history
+        row before concatenating (it is the chunk's final state, which
         reappears as the next chunk's first pre-update snapshot).
         """
         if initial_particles is not None:
@@ -288,10 +341,19 @@ class Sampler:
                     stacklevel=2,
                 )
             steps_per_dispatch = max(1, min(num_iter, int(dispatch_budget // max(t_step, 1e-30))))
+        if record:
+            # HBM-budget history chunking (generalised out of the logreg
+            # driver, round 8) — runtime module-attr lookup so tests can
+            # monkeypatch the sizing
+            from dist_svgd_tpu.utils import history as _history
+
+            steps_per_dispatch = min(
+                steps_per_dispatch, _history.record_chunk_steps(n, self._d)
+            )
         if steps_per_dispatch >= num_iter:
             run = self._run_fn(num_iter, record)
             final, hist = run(particles, eps, bkey,
-                              jnp.asarray(0, jnp.int32))
+                              jnp.asarray(step_offset, jnp.int32))
             self.last_run_stats = {
                 "execution": "monolithic", "num_steps": num_iter,
                 "num_dispatches": 1,
@@ -306,12 +368,19 @@ class Sampler:
         hists = []
         final = particles
         done = 0
+        pending = None  # previous chunk's device history: its D2H fetch is
+        # issued only after the NEXT chunk's dispatch, so on a host with an
+        # async transfer engine the copy rides the transfer engine while
+        # that chunk computes (the logreg driver's round-5 overlap pattern)
         sizes = _chunk_sizes(num_iter, steps_per_dispatch)
         for csize in sizes:  # ≤ 2 distinct sizes → ≤ 2 compiled programs
             run = self._run_fn(csize, record)
-            final, hist = run(final, eps, bkey, jnp.asarray(done, jnp.int32))
+            final, hist = run(final, eps, bkey,
+                              jnp.asarray(step_offset + done, jnp.int32))
             if record:
-                hists.append(hist)
+                if pending is not None:
+                    hists.append(np.asarray(pending))
+                pending = hist
             done += csize
         self.last_run_stats = {
             "execution": "scan_chunks", "num_steps": num_iter,
@@ -321,7 +390,11 @@ class Sampler:
         }
         hist = None
         if record:
-            hist = jnp.concatenate(hists + [final[None]], axis=0)
+            if pending is not None:
+                hists.append(np.asarray(pending))
+            # host concatenation: a chunked recorded run exists because the
+            # stack does NOT fit the HBM budget (or dispatch budget) whole
+            hist = np.concatenate(hists + [np.asarray(final)[None]], axis=0)
         return final, hist
 
     def sample(

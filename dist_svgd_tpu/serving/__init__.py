@@ -9,7 +9,10 @@ turns a checkpointed ensemble into a low-latency prediction service:
   multi-process save's per-process block files), registers per-model jitted
   predictive kernels, and serves them through a shape-bucketed compile cache
   (request batches pad up to power-of-two buckets, so steady-state traffic
-  never recompiles);
+  never recompiles), with **checkpoint hot reload**
+  (:class:`CheckpointHotReloader` watches a manager root and atomically
+  swaps the served ensemble between micro-batches — train-while-serving
+  with ``resilience.RunSupervisor``);
 - :mod:`batcher` — :class:`MicroBatcher`: coalesces concurrent requests into
   one fused device call over the whole ensemble, scatters results back
   per-request, sheds on overflow instead of queueing unboundedly;
@@ -21,7 +24,13 @@ train → checkpoint → serve demo in ``experiments/serve_covertype.py``.
 """
 
 from dist_svgd_tpu.serving.batcher import MicroBatcher, Overloaded
-from dist_svgd_tpu.serving.engine import PredictiveEngine
+from dist_svgd_tpu.serving.engine import CheckpointHotReloader, PredictiveEngine
 from dist_svgd_tpu.serving.server import PredictionServer
 
-__all__ = ["PredictiveEngine", "MicroBatcher", "Overloaded", "PredictionServer"]
+__all__ = [
+    "PredictiveEngine",
+    "CheckpointHotReloader",
+    "MicroBatcher",
+    "Overloaded",
+    "PredictionServer",
+]

@@ -143,11 +143,18 @@ class PredictiveEngine:
         self.max_bucket = int(max_bucket)
         # bucket -> jitted kernel; guarded for concurrent predict() callers
         # (the batcher serialises dispatches, but the engine is also usable
-        # directly from request threads)
+        # directly from request threads).  reload() swaps (_particles,
+        # _kernels) as a pair under the same lock, so every predict sees a
+        # consistent ensemble/kernel view — the hot-reload atomicity
         self._kernels: Dict[int, Any] = {}
         self._lock = threading.Lock()
         self._hits = 0
         self._misses = 0
+        self._reloads = 0
+        self._ensemble_tag: Optional[str] = None
+        #: Manager-root step this ensemble was cold-started from (set by
+        #: :meth:`from_checkpoint`; ``None`` for direct/array construction).
+        self.checkpoint_step: Optional[int] = None
 
     # ------------------------------------------------------------------ #
     # construction from checkpoints
@@ -176,6 +183,7 @@ class PredictiveEngine:
             load_state,
         )
 
+        loaded_step = None
         if isinstance(source, (list, tuple)):
             state = assemble_full_state(list(source))
         else:
@@ -183,7 +191,9 @@ class PredictiveEngine:
             if not os.path.isdir(path):
                 raise FileNotFoundError(f"checkpoint path {path!r} is not a directory")
             if _looks_like_manager_root(path):
-                state = CheckpointManager(path).restore_latest()
+                loaded_step, state = CheckpointManager(path).restore_latest(
+                    with_step=True
+                )
                 if state is None:
                     raise ValueError(
                         f"no restorable checkpoint under manager root {path!r}"
@@ -194,7 +204,12 @@ class PredictiveEngine:
             raise KeyError(
                 f"checkpoint has no {key!r} entry (keys: {sorted(state)})"
             )
-        return cls(model, np.asarray(state[key]), **kwargs)
+        engine = cls(model, np.asarray(state[key]), **kwargs)
+        # which step this ensemble came from (None for non-manager layouts):
+        # CheckpointHotReloader's baseline — a corrupt newest dir or a save
+        # racing the cold start must not be marked "already served"
+        engine.checkpoint_step = loaded_step
+        return engine
 
     # ------------------------------------------------------------------ #
     # kernels
@@ -213,9 +228,10 @@ class PredictiveEngine:
         """Expected per-row input width for :meth:`predict`."""
         return self._feature_dim
 
-    def _build_kernel(self):
-        """The model's padded-batch predictive program (traced per bucket)."""
-        particles = self._particles
+    def _build_kernel(self, particles):
+        """The padded-batch predictive program over ``particles`` (traced
+        per bucket; the ensemble is closed over, so a hot reload builds a
+        fresh kernel set instead of mutating served ones)."""
         if self.model == "logreg":
 
             def kernel(x):
@@ -257,14 +273,17 @@ class PredictiveEngine:
         return jax.jit(kernel)
 
     def _kernel_for(self, bucket: int):
+        """Returns ``(fn, dtype)`` snapshotted under one lock acquisition:
+        a concurrent :meth:`reload` can never hand a caller the new
+        ensemble's dtype with the old ensemble's kernel (or vice versa)."""
         with self._lock:
             fn = self._kernels.get(bucket)
             if fn is None:
                 self._misses += 1
-                fn = self._kernels[bucket] = self._build_kernel()
+                fn = self._kernels[bucket] = self._build_kernel(self._particles)
             else:
                 self._hits += 1
-            return fn
+            return fn, self._particles.dtype
 
     # ------------------------------------------------------------------ #
     # serving
@@ -289,8 +308,8 @@ class PredictiveEngine:
                 "split it upstream (MicroBatcher max_batch does this)"
             )
         bucket = bucket_for(b, self.min_bucket)
-        fn = self._kernel_for(bucket)
-        xb = jnp.asarray(x, dtype=self._particles.dtype)
+        fn, dtype = self._kernel_for(bucket)
+        xb = jnp.asarray(x, dtype=dtype)
         if bucket != b:
             xb = jnp.concatenate(
                 [xb, jnp.zeros((bucket - b, x.shape[1]), xb.dtype)], axis=0
@@ -316,6 +335,63 @@ class PredictiveEngine:
             self.predict(np.zeros((bkt, self._feature_dim), np.float32))
         return buckets
 
+    # ------------------------------------------------------------------ #
+    # hot reload (round 8: train-while-serving)
+
+    def reload(self, particles, *, warm: bool = True,
+               tag: Optional[str] = None) -> Dict[str, Any]:
+        """Atomically swap the served ensemble.
+
+        A fresh kernel is built per currently-compiled bucket over the NEW
+        particle array and (with ``warm=True``) pre-traced **before** the
+        swap — the compile cost is paid off the request path, and the
+        steady-state no-recompile contract survives the reload.  The swap
+        itself is one lock-guarded pointer exchange of the
+        ``(_particles, _kernels)`` pair: each ``predict`` call snapshots
+        both under the same lock, so every micro-batch is served entirely
+        by one ensemble generation (in-flight dispatches finish on the old
+        one; the next batch sees the new one).
+
+        The particle count may change (more training steps, a bigger
+        ensemble); the feature layout may not — a reload can never
+        repurpose a server to a different model shape.  Returns a summary
+        dict; ``tag`` labels the generation in :meth:`stats`.
+        """
+        particles = jnp.asarray(particles)
+        if particles.ndim != 2 or particles.shape[1] != self._particles.shape[1]:
+            raise ValueError(
+                f"reload particles {particles.shape} incompatible with the "
+                f"served layout (n, {self._particles.shape[1]})"
+            )
+        new_kernels: Dict[int, Any] = {}
+        with self._lock:
+            buckets = sorted(self._kernels)
+        while True:
+            # build + warm outside the lock (seconds of jit tracing must
+            # not block the request path) for every bucket not yet staged
+            for b in buckets:
+                if b not in new_kernels:
+                    fn = self._build_kernel(particles)
+                    if warm:
+                        fn(jnp.zeros((b, self._feature_dim),
+                                     particles.dtype))
+                    new_kernels[b] = fn
+            with self._lock:
+                # a predict may have compiled a NEW bucket while we warmed
+                # — swapping now would drop it and recompile on the request
+                # path; re-stage until the staged set covers the live set
+                # (bounded: the bucket lattice is finite, log2(max/min)+1)
+                missing = [b for b in self._kernels if b not in new_kernels]
+                if not missing:
+                    self._particles = particles
+                    self._kernels = new_kernels
+                    self._reloads += 1
+                    self._ensemble_tag = tag
+                    break
+                buckets = missing
+        return {"n_particles": int(particles.shape[0]),
+                "warmed_buckets": sorted(new_kernels), "tag": tag}
+
     def stats(self) -> Dict[str, Any]:
         """Compile-cache and ensemble identity counters for ``/metrics``."""
         with self._lock:
@@ -326,4 +402,132 @@ class PredictiveEngine:
                 "bucket_hits": self._hits,
                 "bucket_misses": self._misses,
                 "compiled_buckets": sorted(self._kernels),
+                "reloads": self._reloads,
+                "ensemble_tag": self._ensemble_tag,
             }
+
+
+class CheckpointHotReloader:
+    """Watch a ``CheckpointManager`` root; hot-swap the engine's ensemble
+    when training writes a newer step.
+
+    Composes a supervised trainer (``resilience.RunSupervisor`` writing
+    periodic checkpoints) with a live server into train-while-serving: the
+    server cold-starts from the newest step, the reloader polls the root,
+    and each newer restorable step is loaded off the request path and
+    swapped in between micro-batches (:meth:`PredictiveEngine.reload`).
+    A corrupt/partial newest step dir is simply skipped by the restore
+    fallback — the server keeps serving the previous generation.
+
+    Drive it explicitly with :meth:`poll_once` (tests, single-threaded
+    drivers) or as a background thread via :meth:`start`/``with`` (the
+    poll interval waits on an event, so :meth:`stop` returns promptly).
+
+    Args:
+        engine: the live :class:`PredictiveEngine`.
+        root: the manager root being written by the trainer.
+        key: ensemble entry in the checkpoint state dict.
+        interval_s: background-thread poll cadence.
+        baseline_step: the step already being served — newer steps trigger
+            a swap.  Default ``'auto'`` uses the step the engine actually
+            cold-started from (``engine.checkpoint_step``, recorded by
+            ``from_checkpoint`` on a manager root — a save racing the cold
+            start, or a corrupt newest dir the restore fell back past, is
+            then correctly treated as *not yet served*); falls back to the
+            root's current latest when the engine wasn't built from a
+            manager root.  Pass ``None`` to force the first poll to load
+            whatever is restorable, or an explicit step number.
+        logger: optional ``JsonlLogger`` — one record per swap.
+    """
+
+    def __init__(self, engine: PredictiveEngine, root: str, *,
+                 key: str = "particles", interval_s: float = 5.0,
+                 baseline_step="auto", logger=None):
+        from dist_svgd_tpu.utils.checkpoint import CheckpointManager
+
+        self.engine = engine
+        self._mgr = CheckpointManager(os.fspath(root))
+        self._key = key
+        self._interval_s = float(interval_s)
+        self._logger = logger
+        if baseline_step == "auto":
+            baseline_step = getattr(engine, "checkpoint_step", None)
+            if baseline_step is None:
+                baseline_step = self._mgr.latest_step()
+        self.loaded_step: Optional[int] = baseline_step
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> Optional[int]:
+        """Check the root once; swap if a newer restorable step exists.
+        Returns the newly served step, or ``None`` when nothing changed."""
+        latest = self._mgr.latest_step()
+        if latest is None or (self.loaded_step is not None
+                              and latest <= self.loaded_step):
+            return None
+        step, state = self._mgr.restore_latest(with_step=True)
+        if step is None or (self.loaded_step is not None
+                            and step <= self.loaded_step):
+            # every newer dir was corrupt/partial: keep serving the
+            # current generation and try again next poll
+            return None
+        arr = state.get(self._key)
+        if arr is None:
+            raise KeyError(
+                f"checkpoint step_{step} has no {self._key!r} entry "
+                f"(keys: {sorted(state)})"
+            )
+        info = self.engine.reload(np.asarray(arr), tag=f"step_{step}")
+        self.loaded_step = step
+        if self._logger is not None:
+            self._logger.log(event="hot_reload", step=step, **info)
+        return step
+
+    def start(self) -> "CheckpointHotReloader":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="ckpt-hot-reload", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # keep watching: one bad poll must not
+                # kill the reloader thread (the server stays on the old
+                # generation either way)
+                try:
+                    if self._logger is not None:
+                        self._logger.log(event="hot_reload_error",
+                                         error=f"{type(e).__name__}: {e}")
+                except Exception:  # a closed/broken logger must not kill
+                    pass           # the watcher either
+            self._stop.wait(self._interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # a poll hung (e.g. a slow restore over a network fs): keep
+                # the reference so start() can't spawn a duplicate poller
+                # and a later stop() can retry the join
+                try:
+                    if self._logger is not None:
+                        self._logger.log(
+                            event="hot_reload_stop_timeout",
+                            detail="poller still joining; reference kept",
+                        )
+                except Exception:
+                    pass
+                return
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

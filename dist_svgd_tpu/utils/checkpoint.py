@@ -65,15 +65,22 @@ def _use_orbax() -> bool:
         return False
 
 
-def save_state(path: str, state: Dict[str, Any]) -> str:
+def save_state(path: str, state: Dict[str, Any], backend: str = "auto") -> str:
     """Persist a flat dict of arrays/scalars (``None`` values are elided).
 
-    Backend per :func:`_use_orbax`: Orbax on provably single-process runs,
-    ``.npz`` otherwise (multi-process per-path saves deadlock Orbax's
-    barriers).  ``path`` is a directory; an existing checkpoint there is
-    replaced atomically enough for single-writer use (removed then
-    rewritten).
+    ``backend='auto'`` picks per :func:`_use_orbax`: Orbax on provably
+    single-process runs, ``.npz`` otherwise (multi-process per-path saves
+    deadlock Orbax's barriers).  ``backend='npz'`` forces the plain layout —
+    the right choice for **high-frequency periodic** saves (the resilience
+    supervisor's cadence): an orbax save costs a fixed ~quarter second of
+    directory/manifest machinery regardless of array size, while an npz of
+    sampler-sized state is ~a millisecond; both layouts are self-describing
+    and :func:`load_state` auto-detects them, so readers never care.
+    ``path`` is a directory; an existing checkpoint there is replaced
+    atomically enough for single-writer use (removed then rewritten).
     """
+    if backend not in ("auto", "npz"):
+        raise ValueError(f"unknown checkpoint backend {backend!r}")
     state = _to_numpy_tree(state)
     path = os.path.abspath(path)
     # write-tmp-then-rename: a crash mid-write leaves only a stale .tmp dir,
@@ -81,7 +88,7 @@ def save_state(path: str, state: Dict[str, Any]) -> str:
     tmp = path + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
-    if _use_orbax():
+    if backend == "auto" and _use_orbax():
         import orbax.checkpoint as ocp
 
         with ocp.PyTreeCheckpointer() as ckptr:
@@ -214,14 +221,20 @@ class CheckpointManager:
     """Every-K-steps checkpointing with retention.
 
     Layout: ``<root>/step_<t>/`` per checkpoint, newest ``max_to_keep`` kept.
+    ``backend`` forwards to :func:`save_state` (``'npz'`` for high-frequency
+    periodic cadences — see its docstring; reads auto-detect either way).
     """
 
-    def __init__(self, root: str, every: int = 100, max_to_keep: int = 3):
+    def __init__(self, root: str, every: int = 100, max_to_keep: int = 3,
+                 backend: str = "auto"):
         if every <= 0:
             raise ValueError("every must be positive")
+        if backend not in ("auto", "npz"):
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.root = os.path.abspath(root)
         self.every = every
         self.max_to_keep = max_to_keep
+        self.backend = backend
         os.makedirs(self.root, exist_ok=True)
 
     def _step_dirs(self) -> List[int]:
@@ -236,7 +249,8 @@ class CheckpointManager:
         return step > 0 and step % self.every == 0
 
     def save(self, step: int, state: Dict[str, Any]) -> str:
-        path = save_state(os.path.join(self.root, f"step_{step}"), state)
+        path = save_state(os.path.join(self.root, f"step_{step}"), state,
+                          backend=self.backend)
         for old in self._step_dirs()[: -self.max_to_keep or None]:
             if old != step:
                 shutil.rmtree(os.path.join(self.root, f"step_{old}"), ignore_errors=True)
@@ -246,14 +260,18 @@ class CheckpointManager:
         steps = self._step_dirs()
         return steps[-1] if steps else None
 
-    def restore_latest(self) -> Optional[Dict[str, Any]]:
+    def restore_latest(self, with_step: bool = False):
         """Restore the newest *loadable* checkpoint, falling back past any
         that fail to load (e.g. a partial write from a pre-rename crash of an
-        older writer) and warning about the skip."""
+        older writer) and warning about the skip.  ``with_step=True``
+        returns ``(step, state)`` instead of ``state`` alone (``(None,
+        None)`` when nothing is restorable) — the hot-reload watcher needs
+        the step to tell a *new* checkpoint from the one already served."""
         for step in reversed(self._step_dirs()):
             path = os.path.join(self.root, f"step_{step}")
             try:
-                return load_state(path)
+                state = load_state(path)
+                return (step, state) if with_step else state
             except ImportError:
                 # environment problem (orbax-format checkpoint, no orbax
                 # installed) — not corruption; skipping would silently restart
@@ -265,7 +283,7 @@ class CheckpointManager:
                 warnings.warn(
                     f"skipping unloadable checkpoint {path}: {type(e).__name__}: {e}"
                 )
-        return None
+        return (None, None) if with_step else None
 
     def clear(self) -> None:
         """Delete every checkpoint under the root (fresh-run hygiene: a new

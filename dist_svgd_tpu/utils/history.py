@@ -15,6 +15,32 @@ from typing import Optional, Sequence
 import numpy as np
 import pandas as pd
 
+#: Upper bound on steps per recorded dispatch, and the HBM budget that sizes
+#: the actual chunk (:func:`record_chunk_steps`).  Chunking bounds the device
+#: history buffer at (chunk, n, d) instead of (niter, n, d) and caps the
+#: number of compiled scan programs at two (the chunk length plus one
+#: remainder length).  Round 5 (in the logreg driver), generalised into the
+#: samplers in round 8: the chunk is sized from the budget, not fixed — a
+#: fixed 500 held a ~25 GB lane-padded history stack at n=100k (each
+#: (n, d≤128) f32 snapshot is physically n×128 floats on TPU), OOMing the
+#: history path long before the step.
+RECORD_CHUNK_MAX = 500
+RECORD_HBM_BUDGET_BYTES = 2 << 30  # 2 GiB for history; steps keep the rest
+
+
+def record_chunk_steps(n: int, d: int) -> int:
+    """Steps per recorded dispatch such that the on-device pre-update
+    history stack stays within :data:`RECORD_HBM_BUDGET_BYTES`.
+
+    TPU tiles every trailing-2-D f32 page to (8, 128), so one (n, d)
+    snapshot costs ``n × max(d, 128) × 4`` bytes regardless of small d —
+    the lane padding is the whole story at d=3 (docs/notes.md lane-dense
+    OT operands note).  Clamped to [1, RECORD_CHUNK_MAX].  Shared by
+    ``Sampler.run`` and ``DistSampler.run_steps`` (both auto-chunk recorded
+    trajectories through it) and the experiment drivers."""
+    bytes_per_step = n * max(d, 128) * 4
+    return max(1, min(RECORD_CHUNK_MAX, RECORD_HBM_BUDGET_BYTES // bytes_per_step))
+
 
 def history_to_dataframe(
     history: np.ndarray,

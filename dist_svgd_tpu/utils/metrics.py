@@ -32,26 +32,70 @@ class JsonlLogger:
 
     Each :meth:`log` call writes one line ``{"ts": <unix>, **record}``.
     ``path`` and ``stream`` may both be given (e.g. file + stderr echo).
+
+    Lifecycle contract (round 8 — crash-log integrity for supervised runs):
+    every line is flushed as it is written (``fsync=True`` additionally
+    forces it to the OS disk cache per line, the right setting for the
+    resilience supervisor's crash logs — a SIGKILL then truncates nothing),
+    writers from several threads interleave whole lines (internal lock, the
+    batcher + server + supervisor share one logger), :meth:`close` is
+    idempotent, logging after close raises ``ValueError`` instead of
+    silently dropping records, and the context manager closes on the way
+    out of a crashing ``with`` block.
     """
 
-    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None):
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO] = None,
+                 fsync: bool = False):
+        import threading
+
         self._fh = open(path, "a") if path is not None else None
         self._stream = stream
+        self._fsync = bool(fsync)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    @property
+    def closed(self) -> bool:
+        """True once :meth:`close` ran (a sink-less ``JsonlLogger()`` is a
+        valid null sink and stays open until closed)."""
+        return self._closed
 
     def log(self, **record) -> dict:
         record = {"ts": round(time.time(), 3), **record}
         line = json.dumps(record, default=_json_default)
-        if self._fh is not None:
-            self._fh.write(line + "\n")
-            self._fh.flush()
-        if self._stream is not None:
-            self._stream.write(line + "\n")
+        with self._lock:
+            if self.closed:
+                raise ValueError("log() after close(): the record would be "
+                                 "silently dropped")
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                if self._fsync:
+                    import os
+
+                    os.fsync(self._fh.fileno())
+            if self._stream is not None:
+                self._stream.write(line + "\n")
         return record
 
+    def flush(self) -> None:
+        """Flush the file handle (and fsync when enabled) — for callers that
+        batch several :meth:`log` lines and want a durability point."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                if self._fsync:
+                    import os
+
+                    os.fsync(self._fh.fileno())
+
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            self._closed = True
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            self._stream = None  # caller-owned: dropped, not closed
 
     def __enter__(self):
         return self

@@ -36,28 +36,15 @@ from logreg_plots import get_results_dir, make_plots
 from dist_svgd_tpu.utils.platform import select_backend
 
 
-#: Upper bound on steps per recorded ``run_steps`` dispatch, and the HBM
-#: budget that sizes the actual chunk (:func:`record_chunk_steps`).
-#: Chunking bounds the device history buffer at (chunk, n, d) instead of
-#: (niter, n, d) and caps the number of compiled scan programs at two (the
-#: chunk length plus one remainder length).  Round 5: the chunk is sized
-#: from the budget, not fixed — a fixed 500 held a ~25 GB lane-padded
-#: history stack at n=100k (each (n, d≤128) f32 snapshot is physically
-#: n×128 floats on TPU), OOMing the history path long before the step.
-RECORD_CHUNK_MAX = 500
-RECORD_HBM_BUDGET_BYTES = 2 << 30  # 2 GiB for history; steps keep the rest
-
-
-def record_chunk_steps(n: int, d: int) -> int:
-    """Steps per recorded dispatch such that the on-device pre-update
-    history stack stays within :data:`RECORD_HBM_BUDGET_BYTES`.
-
-    TPU tiles every trailing-2-D f32 page to (8, 128), so one (n, d)
-    snapshot costs ``n × max(d, 128) × 4`` bytes regardless of small d —
-    the lane padding is the whole story at d=3 (docs/notes.md lane-dense
-    OT operands note).  Clamped to [1, RECORD_CHUNK_MAX]."""
-    bytes_per_step = n * max(d, 128) * 4
-    return max(1, min(RECORD_CHUNK_MAX, RECORD_HBM_BUDGET_BYTES // bytes_per_step))
+# HBM-budget-sized history chunking moved into the library (round 8): the
+# samplers auto-chunk recorded trajectories through utils/history.py, so
+# every driver — logreg, covertype, bnn, gmm — gets it.  Re-exported here
+# for tools/record_overhead.py and the sizing tests.
+from dist_svgd_tpu.utils.history import (  # noqa: F401
+    RECORD_CHUNK_MAX,
+    RECORD_HBM_BUDGET_BYTES,
+    record_chunk_steps,
+)
 
 
 def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
@@ -122,42 +109,26 @@ def run(num_shards, dataset_name, fold, nparticles, niter, stepsize, exchange,
             sampler.make_step(stepsize, h=10.0)  # h=10 matches logreg.py:83
         slice_snapshot(np.asarray(sampler.particles))
     else:
-        # whole trajectory (with pre-update history) in scanned dispatches —
-        # one per HBM-budget-sized history chunk (record_chunk_steps);
-        # with --wasserstein-solver sinkhorn
+        # whole trajectory (with pre-update history) in scanned dispatches.
+        # The samplers HBM-budget-chunk recorded histories themselves now
+        # (round 8; `DistSampler.run_steps` docstring — chunk sizing via
+        # utils/history.py:record_chunk_steps, each chunk's D2H copy
+        # overlapped with the next chunk's scan).  Note the axon-relay
+        # caveat still applies to the pool: its tunnel serialises D2H with
+        # execution server-side (~46 MB/s, zero overlap — docs/notes.md
+        # round-5, tools/record_overhead.py); that is a property of the
+        # relay, not of the chunking.  With --wasserstein-solver sinkhorn
         # the W2 snapshot state rides the scan carry on device, so the
-        # reference's flagship --wasserstein sweep config runs at scan speed
-        # instead of ~15 ms of tunnel dispatch per step (docs/notes.md)
+        # reference's flagship --wasserstein sweep config runs at scan
+        # speed instead of ~15 ms of tunnel dispatch per step.
         h = 10.0 if wasserstein else 1.0  # h inert when the term is off
-        chunk = record_chunk_steps(n_used, d)
-        chunks = []
-        final = sampler.particles  # niter=0: single t=0 snapshot, no dispatch
-        done = 0
-        pending = None  # previous chunk's device history, copied D2H while
-        # the next chunk's scan runs — the copy starts only after its own
-        # chunk finished (device program order), so it rides the tunnel
-        # concurrently with the next dispatch's compute instead of
-        # serialising after it
-        while done < niter:
-            k = min(chunk, niter - done)
-            final, hist = sampler.run_steps(k, stepsize, record=True, h=h)
-            if pending is not None:
-                chunks.append(np.asarray(pending))  # overlapped host copy
-            # The fetch above runs AFTER the next chunk's dispatch, so on a
-            # normal TPU host the D2H copy of chunk i rides the transfer
-            # engine while chunk i+1 computes (history overhead → the
-            # trailing chunk only).  Through the axon *relay* specifically,
-            # transfers serialise with execution server-side — measured
-            # 46 MB/s fetch with zero overlap regardless of ordering,
-            # copy_to_host_async, or a fetcher thread — so recorded runs
-            # there pay ~26 ms per fetched MB (tools/record_overhead.py,
-            # docs/notes.md round-5).  That is a property of the shared
-            # pool's tunnel, not of this loop.
-            pending = hist
-            done += k
-        if pending is not None:
-            chunks.append(np.asarray(pending))
-        snaps = np.concatenate(chunks + [np.asarray(final)[None]])
+        if niter:
+            final, hist = sampler.run_steps(niter, stepsize, record=True, h=h)
+            snaps = np.concatenate(
+                [np.asarray(hist), np.asarray(final)[None]]
+            )
+        else:  # niter=0: single t=0 snapshot, no dispatch
+            snaps = np.asarray(sampler.particles)[None]
         for t in range(niter + 1):
             slice_snapshot(snaps[t], t)
 

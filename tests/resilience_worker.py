@@ -1,0 +1,153 @@
+"""Worker process for the resilience fault drills (slow tier).
+
+Not a test module.  Two modes:
+
+- ``single``: one jax process running a supervised DistSampler (vmap
+  emulation) with real SIGTERM/SIGINT handlers installed — the parent test
+  kills it mid-run (SIGTERM → graceful preemption checkpoint; SIGKILL →
+  nothing) and relaunches with ``--resume`` to verify the bitwise-exact
+  recovery (tests/test_fault_drill.py).
+- ``fed``: one rank of a multi-process federation (jax.distributed) running
+  a supervised DistSampler over a shared mesh with per-process checkpoint
+  roots — the kill-one-worker → resume drill.  Requires a jax whose CPU
+  backend implements multiprocess collectives (skipped on legacy jax via
+  ``needs_cpu_multiprocess``).
+
+The run is paced by sleeping a few hundred ms at every segment boundary
+(duck-typed through the supervisor's fault hook) so the parent can land a
+real signal mid-run deterministically; tier-1 never runs this file.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# drill geometry shared with test_fault_drill.py: 40 steps, checkpoints
+# every 8, segments of 4
+N, D, STEPS, EVERY, SEGMENT, EPS = 32, 2, 40, 8, 4, 0.05
+
+
+class Pacer:
+    """Duck-typed FaultPlan: real-sleeps at every segment boundary so the
+    parent's signal lands mid-run (slow tier only)."""
+
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+
+    def fire_due(self, ctx) -> None:
+        time.sleep(self.seconds)
+
+
+def build_sampler(mesh=None, particles=None):
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    num_shards = mesh.size if mesh is not None else 2
+    if particles is None:
+        particles = init_particles_per_shard(0, N, D, num_shards)
+    return dt.DistSampler(
+        num_shards, lambda th, _: gmm_logp(th), None, particles,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False, mesh=mesh if mesh is not None else "auto",
+    )
+
+
+def run_single(args):
+    import _jax_env
+
+    _jax_env.setup_cpu(device_count=2)
+    import numpy as np
+
+    from dist_svgd_tpu.resilience import RunSupervisor
+
+    ds = build_sampler()
+    sup = RunSupervisor(
+        ds, STEPS, EPS, checkpoint_dir=os.path.join(args.outdir, "ckpt"),
+        checkpoint_every=EVERY, segment_steps=SEGMENT,
+        faults=Pacer(args.pace),
+    )
+    sup.install_signal_handlers()
+    report = sup.run(resume=args.resume)
+    np.save(os.path.join(args.outdir, "final.npy"), np.asarray(sup.particles))
+    with open(os.path.join(args.outdir, "report.json"), "w") as fh:
+        json.dump(report, fh)
+
+
+def run_fed(args):
+    import _jax_env
+
+    _jax_env.setup_cpu(device_count=args.devcount)
+    import numpy as np
+
+    from dist_svgd_tpu.parallel import multihost
+    from dist_svgd_tpu.resilience import RunSupervisor
+    from dist_svgd_tpu.utils.checkpoint import load_state
+
+    assert multihost.initialize(
+        coordinator_address=args.coordinator, num_processes=args.nprocs,
+        process_id=args.rank,
+    )
+    mesh = multihost.make_particle_mesh()
+    start, count = multihost.process_local_rows(N, mesh)
+    full = np.random.default_rng(0).normal(size=(N, D)).astype(np.float32)
+    particles = multihost.make_global_particles(
+        full[start:start + count], mesh, n_global=N
+    )
+    ds = build_sampler(mesh=mesh, particles=particles)
+    root = os.path.join(args.outdir, f"ckpt_rank{args.rank}")
+    if args.resume_from is not None:
+        # the federation resumes from the newest step present in EVERY
+        # rank's root (the parent computes it): load that exact step
+        ds.load_state_dict(load_state(
+            os.path.join(root, f"step_{args.resume_from}")
+        ))
+        # same absolute segment grid as the killed run — the bitwise-resume
+        # invariant needs the identical sequence of run_steps calls
+        sup = RunSupervisor(ds, STEPS, EPS, segment_steps=SEGMENT,
+                            faults=Pacer(args.pace))
+    else:
+        sup = RunSupervisor(
+            ds, STEPS, EPS, checkpoint_dir=root, checkpoint_every=EVERY,
+            segment_steps=SEGMENT, faults=Pacer(args.pace),
+        )
+        sup.install_signal_handlers()
+    report = sup.run()
+    rows = np.concatenate([
+        np.asarray(s.data) for s in sorted(
+            ds.particles.addressable_shards,
+            key=lambda s: s.index[0].start or 0,
+        )
+    ])
+    np.save(os.path.join(args.outdir, f"rows_{args.rank}.npy"), rows)
+    with open(os.path.join(args.outdir, f"report_{args.rank}.json"), "w") as fh:
+        json.dump(report, fh)
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=("single", "fed"))
+    ap.add_argument("outdir")
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--pace", type=float, default=0.25,
+                    help="seconds slept per segment boundary")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--nprocs", type=int, default=2)
+    ap.add_argument("--coordinator", default="127.0.0.1:0")
+    ap.add_argument("--devcount", type=int, default=2)
+    ap.add_argument("--resume-from", type=int, default=None)
+    args = ap.parse_args()
+    if args.mode == "single":
+        run_single(args)
+    else:
+        run_fed(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -168,11 +168,15 @@ def test_logreg_driver_record_chunking_is_semantics_neutral(monkeypatch):
     """Chunked trajectory recording (record_chunk_steps) must reproduce the
     single-dispatch history exactly (ADVICE r1: bound the (niter, n, d)
     device history buffer; round 5: the chunk is HBM-budget-sized and the
-    D2H copy of chunk k overlaps chunk k+1's scan)."""
+    D2H copy of chunk k overlaps chunk k+1's scan; round 8: the chunking
+    lives in the samplers — patch the library sizing, and the driver's
+    single run_steps call must route through it)."""
+    from dist_svgd_tpu.utils import history
+
     logreg, get_results_dir = _import_logreg_driver()
     kw = dict(wasserstein=False, niter=6)
     whole = _driver_run_final(logreg, get_results_dir, "lp", **kw)
-    monkeypatch.setattr(logreg, "record_chunk_steps",
+    monkeypatch.setattr(history, "record_chunk_steps",
                         lambda n, d: 4)  # 6 = 4 + 2 → two chunks
     chunked = _driver_run_final(logreg, get_results_dir, "lp", **kw)
     np.testing.assert_array_equal(whole, chunked)

@@ -81,3 +81,80 @@ def test_profiler_trace_noop_and_real(tmp_path):
     import os
 
     assert os.path.isdir(logdir)
+
+
+# --------------------------------------------------------------------- #
+# JsonlLogger lifecycle (round 8: crash-log integrity for supervised runs)
+
+
+def test_jsonl_logger_context_manager_closes_on_crash(tmp_path):
+    path = str(tmp_path / "crash.jsonl")
+    with pytest.raises(RuntimeError, match="boom"):
+        with JsonlLogger(path=path) as logger:
+            logger.log(a=1)
+            raise RuntimeError("boom")
+    # the line written before the crash is intact on disk (per-line flush)
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["a"] for l in lines] == [1]
+
+
+def test_jsonl_logger_close_is_idempotent_and_log_after_close_raises(tmp_path):
+    logger = JsonlLogger(path=str(tmp_path / "x.jsonl"))
+    logger.log(a=1)
+    assert not logger.closed
+    logger.close()
+    logger.close()  # idempotent
+    assert logger.closed
+    with pytest.raises(ValueError, match="after close"):
+        logger.log(a=2)
+
+
+def test_jsonl_logger_fsync_and_flush(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    with JsonlLogger(path=path, fsync=True) as logger:
+        logger.log(a=1)
+        logger.flush()
+        # durable before close: a concurrent reader sees the whole line
+        assert json.loads(open(path).read().strip())["a"] == 1
+
+
+def test_jsonl_logger_stream_not_closed_by_close():
+    stream = io.StringIO()
+    logger = JsonlLogger(stream=stream)
+    logger.log(a=1)
+    logger.close()
+    assert logger.closed
+    assert not stream.closed  # caller-owned stream survives
+    assert json.loads(stream.getvalue().strip())["a"] == 1
+
+
+def test_jsonl_logger_threaded_lines_whole(tmp_path):
+    import threading
+
+    path = str(tmp_path / "t.jsonl")
+    with JsonlLogger(path=path) as logger:
+        threads = [
+            threading.Thread(
+                target=lambda i=i: [logger.log(i=i, k=j) for j in range(20)]
+            )
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    lines = [json.loads(l) for l in open(path)]  # every line parses whole
+    assert len(lines) == 80
+
+
+def test_jsonl_logger_null_sink_stays_open():
+    """JsonlLogger() with neither path nor stream is a valid null sink:
+    log() writes nowhere but still returns the stamped record, until an
+    explicit close()."""
+    logger = JsonlLogger()
+    assert not logger.closed
+    rec = logger.log(a=1)
+    assert rec["a"] == 1 and "ts" in rec
+    logger.close()
+    with pytest.raises(ValueError, match="after close"):
+        logger.log(a=2)

@@ -1,0 +1,93 @@
+"""Noise-aware perf gating (tools/perf_regress.py, round 8): the pure
+median+MAD judging helpers run on CPU; the measuring half needs the TPU and
+is exercised by running the tool there."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from perf_regress import (  # noqa: E402
+    _mad,
+    _median,
+    incumbent_history,
+    judge_row,
+    record_result,
+)
+
+
+def test_median_and_mad():
+    assert _median([3.0, 1.0, 2.0]) == 2.0
+    assert _median([1.0, 2.0, 3.0, 4.0]) == 2.5
+    assert _mad([1.0, 2.0, 3.0]) == 1.0
+    assert _mad([5.0]) == 0.0
+
+
+def test_incumbent_history_legacy_scalar_seeds_window():
+    incumbents = {"north_star_ups": 100.0}
+    assert incumbent_history(incumbents, "north_star_ups") == [100.0]
+    assert incumbent_history(incumbents, "missing") == []
+
+
+def test_incumbent_history_prefers_window():
+    incumbents = {"k": 100.0, "_history": {"k": [90.0, 110.0, 100.0]}}
+    assert incumbent_history(incumbents, "k") == [90.0, 110.0, 100.0]
+
+
+def test_judge_row_no_incumbent():
+    status, info = judge_row(50.0, [], 0.35, True)
+    assert status == "NO_INCUMBENT"
+
+
+def test_judge_row_tight_window_uses_tol():
+    """A quiet window (MAD ≈ 0) keeps the plain tol band — the legacy
+    single-point behaviour."""
+    hist = [100.0, 100.0, 100.0]
+    assert judge_row(100.0, hist, 0.35, True)[0] == "PASS"
+    assert judge_row(70.0, hist, 0.35, True)[0] == "WARN"   # > tol/2 below
+    assert judge_row(60.0, hist, 0.35, True)[0] == "FAIL"
+    # lower-is-better orientation (ms/step rows)
+    assert judge_row(160.0, hist, 0.35, False)[0] == "FAIL"
+    assert judge_row(100.0, hist, 0.35, False)[0] == "PASS"
+
+
+def test_judge_row_noisy_window_widens_band():
+    """Pool noise is distinguishable from regression: a window whose own
+    relative MAD exceeds tol/mad_scale widens the band, so a value inside
+    the window's historical spread cannot FAIL."""
+    hist = [60.0, 100.0, 140.0, 80.0, 120.0]  # median 100, MAD 20
+    # band = max(0.35, 3*20/100) = 0.6 → FAIL only below 40
+    status, info = judge_row(45.0, hist, 0.35, True)
+    assert status != "FAIL"
+    assert info["band"] == pytest.approx(0.6)
+    assert judge_row(35.0, hist, 0.35, True)[0] == "FAIL"
+
+
+def test_judge_row_band_capped():
+    hist = [1.0, 100.0, 1000.0]
+    _, info = judge_row(50.0, hist, 0.35, True)
+    assert info["band"] <= 0.9
+
+
+def test_record_result_window_and_median():
+    incumbents = {"k": 100.0}
+    for v in (90.0, 110.0, 120.0):
+        record_result(incumbents, "k", v, window=3)
+    # legacy scalar seeded the window, then trimmed to the newest 3
+    assert incumbents["_history"]["k"] == [90.0, 110.0, 120.0]
+    assert incumbents["k"] == 110.0  # scalar refreshed to the median
+
+
+def test_record_result_fresh_key():
+    incumbents = {}
+    record_result(incumbents, "new", 5.0, window=8)
+    assert incumbents["_history"]["new"] == [5.0]
+    assert incumbents["new"] == 5.0
+
+
+def test_record_result_rejects_bad_window():
+    with pytest.raises(ValueError, match="window"):
+        record_result({}, "k", 1.0, window=0)

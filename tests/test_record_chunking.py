@@ -1,0 +1,156 @@
+"""HBM-budget history chunking, generalised into the samplers (round 8):
+``Sampler.run`` / ``DistSampler.run_steps`` with ``record=True`` auto-split
+into ``utils/history.py:record_chunk_steps``-sized dispatches whose chunks
+are fetched to host — identical trajectories and histories, bounded device
+history buffer, every driver (logreg/covertype/bnn/gmm) gets it for free."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import dist_svgd_tpu as dt
+from dist_svgd_tpu.models.gmm import gmm_logp
+from dist_svgd_tpu.utils import history
+from dist_svgd_tpu.utils.history import (
+    RECORD_CHUNK_MAX,
+    RECORD_HBM_BUDGET_BYTES,
+    record_chunk_steps,
+)
+from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+
+def test_record_chunk_steps_sizing_lib():
+    """The sizing lives in the library now (the logreg driver re-exports
+    it); lane padding + clamping semantics unchanged."""
+    assert record_chunk_steps(100, 3) == RECORD_CHUNK_MAX
+    assert record_chunk_steps(100_000, 3) == 41
+    assert (record_chunk_steps(100_000, 256)
+            == RECORD_HBM_BUDGET_BYTES // (100_000 * 256 * 4))
+    assert record_chunk_steps(10 ** 9, 3) == 1
+
+
+def make_dist(**kw):
+    parts = init_particles_per_shard(0, 32, 2, 4)
+    kw.setdefault("exchange_particles", True)
+    kw.setdefault("exchange_scores", False)
+    kw.setdefault("include_wasserstein", False)
+    return dt.DistSampler(4, lambda th, _: gmm_logp(th), None, parts, **kw)
+
+
+def test_distsampler_record_chunks_match_monolithic(monkeypatch):
+    want_final, want_hist = make_dist().run_steps(7, 0.05, record=True)
+    monkeypatch.setattr(history, "record_chunk_steps", lambda n, d: 3)
+    ds = make_dist()
+    got_final, got_hist = ds.run_steps(7, 0.05, record=True)
+    assert ds.last_run_stats["execution"] == "record_chunks"
+    assert ds.last_run_stats["record_hbm_chunked"]
+    assert ds.last_run_stats["num_dispatches"] == 3  # 3 + 3 + 1
+    assert isinstance(got_hist, np.ndarray)  # host history when chunked
+    np.testing.assert_array_equal(np.asarray(want_hist), got_hist)
+    np.testing.assert_array_equal(np.asarray(want_final),
+                                  np.asarray(got_final))
+
+
+def test_distsampler_record_chunks_compose_with_w2(monkeypatch):
+    """The W2 scan path carries prev/duals in sampler state, so recorded
+    chunking composes with it unchanged."""
+    def make_w2():
+        return make_dist(include_wasserstein=True,
+                         wasserstein_solver="sinkhorn")
+
+    want_final, want_hist = make_w2().run_steps(6, 0.05, record=True, h=1.0)
+    monkeypatch.setattr(history, "record_chunk_steps", lambda n, d: 2)
+    ds = make_w2()
+    got_final, got_hist = ds.run_steps(6, 0.05, record=True, h=1.0)
+    np.testing.assert_allclose(np.asarray(want_hist), got_hist,
+                               rtol=1e-12, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(want_final),
+                               np.asarray(got_final),
+                               rtol=1e-12, atol=1e-14)
+
+
+def test_distsampler_record_chunks_lagged_cadence(monkeypatch):
+    """Lagged exchange chunks at whole-cadence granularity (each chunk a
+    multiple of exchange_every)."""
+    def make_lagged():
+        return make_dist(exchange_every=3)
+
+    want_final, want_hist = make_lagged().run_steps(9, 0.05, record=True)
+    monkeypatch.setattr(history, "record_chunk_steps", lambda n, d: 4)
+    ds = make_lagged()
+    got_final, got_hist = ds.run_steps(9, 0.05, record=True)
+    # 4 rounds down to 3 (the cadence): chunks 3 + 3 + 3
+    assert ds.last_run_stats["num_dispatches"] == 3
+    np.testing.assert_array_equal(np.asarray(want_hist), got_hist)
+    np.testing.assert_array_equal(np.asarray(want_final),
+                                  np.asarray(got_final))
+
+
+def test_sampler_record_chunks_match_monolithic(monkeypatch):
+    logp = lambda th: -0.5 * jnp.sum(th ** 2)
+    want_final, want_hist = dt.Sampler(2, logp).run(8, 7, 0.1, seed=1)
+    monkeypatch.setattr(history, "record_chunk_steps", lambda n, d: 3)
+    s = dt.Sampler(2, logp)
+    got_final, got_hist = s.run(8, 7, 0.1, seed=1)
+    assert s.last_run_stats["execution"] == "scan_chunks"
+    assert isinstance(got_hist, np.ndarray)
+    assert got_hist.shape == (8, 8, 2)  # pre-update snapshots + final
+    np.testing.assert_array_equal(np.asarray(want_hist), got_hist)
+    np.testing.assert_array_equal(np.asarray(want_final),
+                                  np.asarray(got_final))
+
+
+def test_sampler_record_chunks_minibatch_stream(monkeypatch):
+    """Chunk boundaries stay invisible to the minibatch key stream (the i0
+    offset), recorded or not."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(40, 2)).astype(np.float32))
+    logp = lambda th, b: -0.5 * jnp.sum(th ** 2) + 0.0 * jnp.sum(b)
+
+    def make_s():
+        return dt.Sampler(3, logp, data=x, batch_size=5)
+
+    want_final, want_hist = make_s().run(8, 7, 1e-2, seed=2)
+    monkeypatch.setattr(history, "record_chunk_steps", lambda n, d: 2)
+    got_final, got_hist = make_s().run(8, 7, 1e-2, seed=2)
+    np.testing.assert_array_equal(np.asarray(want_hist),
+                                  np.asarray(got_hist))
+    np.testing.assert_array_equal(np.asarray(want_final),
+                                  np.asarray(got_final))
+
+
+def test_sampler_dispatch_budget_record_returns_host_history():
+    """dispatch_budget + record: chunk histories are host-fetched too (a
+    chunked recorded run must not keep the whole stack in HBM)."""
+    logp = lambda th: -0.5 * jnp.sum(th ** 2)
+    s = dt.Sampler(2, logp)
+    want_final, want_hist = s.run(8, 6, 0.1, seed=1)
+    s2 = dt.Sampler(2, logp)
+    got_final, got_hist = s2.run(
+        8, 6, 0.1, seed=1, dispatch_budget=1.0,
+        pairs_per_sec=8 * 8 / 0.5,  # one ~0.5 s step estimate → 2-step chunks
+    )
+    assert s2.last_run_stats["execution"] == "scan_chunks"
+    assert isinstance(got_hist, np.ndarray)
+    np.testing.assert_array_equal(np.asarray(want_hist), got_hist)
+    np.testing.assert_array_equal(np.asarray(want_final),
+                                  np.asarray(got_final))
+
+
+def test_intra_step_record_history_is_host_side():
+    """The intra-step executor's recorded history is host-fetched (one
+    device snapshot resident at a time) and still matches the monolithic
+    trajectory — the HBM-budget contract holds in the large-n tier too."""
+    want_final, want_hist = make_dist(exchange_impl="ring").run_steps(
+        4, 0.05, record=True)  # ring monolithic: same accumulation order
+    ds = make_dist(exchange_impl="ring")
+    got_final, got_hist = ds.run_steps(4, 0.05, record=True,
+                                       hops_per_dispatch=2)
+    assert ds.last_run_stats["execution"] == "intra_step"
+    assert isinstance(got_hist, np.ndarray)
+    np.testing.assert_allclose(np.asarray(want_hist), got_hist,
+                               rtol=1e-12, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(want_final),
+                               np.asarray(got_final),
+                               rtol=1e-12, atol=1e-14)

@@ -1,0 +1,419 @@
+"""Resilience subsystem (dist_svgd_tpu/resilience/): supervised segmented
+runs, bitwise-exact resume, retry/backoff, numerical guards with rollback +
+step-size backoff, deterministic fault injection.  Everything runs on CPU
+with injected faults, an injectable sleep, and (where needed) a manual
+clock — no real signals or waits (the real-signal drills live in the slow
+tier, tests/test_fault_drill.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import dist_svgd_tpu as dt
+from dist_svgd_tpu.models.gmm import gmm_logp
+from dist_svgd_tpu.resilience import (
+    FaultPlan,
+    GuardConfig,
+    GuardViolation,
+    HardKillAt,
+    InjectNaNAt,
+    PreemptAt,
+    RaiseAt,
+    RestartBudgetExhausted,
+    RetryPolicy,
+    RunSupervisor,
+    SimulatedHardKill,
+    SlowSegmentAt,
+    TransientDispatchError,
+    check_state,
+)
+from dist_svgd_tpu.utils.checkpoint import CheckpointManager
+from dist_svgd_tpu.utils.metrics import JsonlLogger
+from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+
+def no_sleep(_s):
+    pass
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def make_dist(n=32, num_shards=4, **kw):
+    parts = init_particles_per_shard(0, n, 2, num_shards)
+    kw.setdefault("exchange_particles", True)
+    kw.setdefault("exchange_scores", False)
+    kw.setdefault("include_wasserstein", False)
+    return dt.DistSampler(num_shards, lambda th, _: gmm_logp(th), None,
+                          parts, **kw)
+
+
+def supervise(sampler, tmp_path, name, steps=12, eps=0.05, every=4, **kw):
+    kw.setdefault("segment_steps", every)
+    kw.setdefault("sleep", no_sleep)
+    return RunSupervisor(sampler, steps, eps,
+                         checkpoint_dir=os.path.join(str(tmp_path), name),
+                         checkpoint_every=every, **kw)
+
+
+def reference_final(tmp_path, steps=12, **kw):
+    sup = supervise(make_dist(), tmp_path, "reference", steps=steps, **kw)
+    assert sup.run()["status"] == "completed"
+    return np.asarray(sup.particles)
+
+
+# --------------------------------------------------------------------- #
+# resume exactness (the acceptance pin, both sampler kinds)
+
+
+@pytest.mark.parametrize("preempt_step", [3, 4, 7])
+def test_distsampler_preempt_resume_bitwise(tmp_path, preempt_step):
+    """An injected preemption at an arbitrary step (honoured at the next
+    boundary, like a real SIGTERM) then resume-from-latest reproduces the
+    uninterrupted supervised run's final state BITWISE — the absolute
+    segment grid guarantees the same sequence of run_steps programs."""
+    want = reference_final(tmp_path)
+    sup1 = supervise(make_dist(), tmp_path, "killed",
+                     faults=FaultPlan(PreemptAt(preempt_step)))
+    r1 = sup1.run()
+    assert r1["status"] == "preempted"
+    assert r1["t"] < 12 and r1["t"] >= preempt_step
+    # signal-triggered checkpoint at the stop boundary
+    mgr = CheckpointManager(os.path.join(str(tmp_path), "killed"))
+    assert mgr.latest_step() == r1["t"]
+    sup2 = supervise(make_dist(), tmp_path, "killed")
+    r2 = sup2.run(resume=True)
+    assert r2["status"] == "completed"
+    assert r2["resumed_from"] == r1["t"]
+    np.testing.assert_array_equal(want, np.asarray(sup2.particles))
+
+
+def test_sampler_minibatched_preempt_resume_bitwise(tmp_path):
+    """Single-device path: the minibatch key stream continues across
+    segments (step_offset), so supervised == monolithic and the resumed
+    run matches both bitwise."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    t = jnp.asarray((rng.random(64) > 0.5).astype(np.float32))
+
+    def make_s():
+        return dt.Sampler(
+            4, lambda th, batch: -0.5 * jnp.sum(th ** 2)
+            + 0.0 * jnp.sum(batch[0]), data=(x, t), batch_size=8,
+        )
+
+    mono, _ = make_s().run(16, 12, 1e-2, seed=3, record=False)
+    sup1 = supervise(make_s(), tmp_path, "a", n=16, seed=3, eps=1e-2)
+    sup1.run()
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(sup1.particles))
+    sup2 = supervise(make_s(), tmp_path, "b", n=16, seed=3, eps=1e-2,
+                     faults=FaultPlan(PreemptAt(5)))
+    assert sup2.run()["status"] == "preempted"
+    sup3 = supervise(make_s(), tmp_path, "b", n=16, seed=3, eps=1e-2)
+    assert sup3.run(resume=True)["status"] == "completed"
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(sup3.particles))
+
+
+def test_sampler_step_offset_continues_stream():
+    """Sampler.run(step_offset=k) is the resumable-drive primitive: two
+    chunked calls reproduce the monolithic minibatch trajectory bitwise."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(40, 2)).astype(np.float32))
+    s = dt.Sampler(3, lambda th, b: -0.5 * jnp.sum(th ** 2)
+                   + 0.0 * jnp.sum(b), data=x, batch_size=5)
+    whole, _ = s.run(8, 10, 1e-2, seed=7, record=False)
+    part, _ = s.run(8, 6, 1e-2, seed=7, record=False)
+    part, _ = s.run(8, 4, 1e-2, seed=7, record=False,
+                    initial_particles=part, step_offset=6)
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(part))
+
+
+def test_sampler_median_kernel_frozen_across_segments(tmp_path):
+    """kernel='median' resolves ONCE from the run-initial particles: the
+    supervised segmented run must match the monolithic run (which resolves
+    from the same initial particles), and a resumed run re-pins the
+    checkpointed bandwidth instead of re-resolving."""
+    def make_s():
+        return dt.Sampler(2, lambda th: -0.5 * jnp.sum(th ** 2),
+                          kernel="median")
+
+    mono, _ = make_s().run(10, 12, 0.1, seed=0, record=False)
+    sup = supervise(make_s(), tmp_path, "m", n=10, seed=0, eps=0.1)
+    sup.run()
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(sup.particles))
+    sup2 = supervise(make_s(), tmp_path, "m2", n=10, seed=0, eps=0.1,
+                     faults=FaultPlan(PreemptAt(5)))
+    sup2.run()
+    sup3 = supervise(make_s(), tmp_path, "m2", n=10, seed=0, eps=0.1)
+    sup3.run(resume=True)
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(sup3.particles))
+
+
+def test_distsampler_w2_lp_supervised_resume(tmp_path):
+    """The eager host-LP W2 path (make_step-only) supervises through the
+    harness's make_step loop; preempt + resume stays bitwise (the W2
+    previous-snapshot and step counter ride state_dict)."""
+    def make_w2():
+        return make_dist(n=8, num_shards=2, include_wasserstein=True,
+                         wasserstein_solver="lp")
+
+    ref = supervise(make_w2(), tmp_path, "wref", steps=6, every=2)
+    ref.run()
+    want = np.asarray(ref.particles)
+    k1 = supervise(make_w2(), tmp_path, "wkill", steps=6, every=2,
+                   faults=FaultPlan(PreemptAt(3)))
+    assert k1.run()["status"] == "preempted"
+    k2 = supervise(make_w2(), tmp_path, "wkill", steps=6, every=2)
+    assert k2.run(resume=True)["status"] == "completed"
+    np.testing.assert_array_equal(want, np.asarray(k2.particles))
+
+
+# --------------------------------------------------------------------- #
+# retry / backoff / budget
+
+
+def test_retry_exponential_backoff_and_replay(tmp_path):
+    want = reference_final(tmp_path)
+    slept = []
+    sup = supervise(make_dist(), tmp_path, "retry",
+                    faults=FaultPlan(RaiseAt(4), RaiseAt(4)),
+                    sleep=slept.append,
+                    retry=RetryPolicy(max_restarts=3, backoff_base_s=0.5,
+                                      backoff_factor=2.0))
+    r = sup.run()
+    assert r["status"] == "completed"
+    assert r["restarts"] == 2
+    assert slept == [0.5, 1.0]  # exponential in consecutive failures
+    # the replayed trajectory is the uninterrupted one exactly
+    np.testing.assert_array_equal(want, np.asarray(sup.particles))
+
+
+def test_restart_budget_exhausted(tmp_path):
+    sup = supervise(make_dist(), tmp_path, "budget",
+                    faults=FaultPlan(RaiseAt(0), RaiseAt(0), RaiseAt(0)),
+                    retry=RetryPolicy(max_restarts=2, backoff_base_s=0.0))
+    with pytest.raises(RestartBudgetExhausted) as ei:
+        sup.run()
+    assert isinstance(ei.value.last_error, TransientDispatchError)
+
+
+def test_backoff_delay_capped():
+    rp = RetryPolicy(backoff_base_s=1.0, backoff_factor=10.0, max_backoff_s=5.0)
+    assert rp.delay_s(1) == 1.0
+    assert rp.delay_s(2) == 5.0
+
+
+# --------------------------------------------------------------------- #
+# guards: NaN rollback + step-size backoff
+
+
+def test_nan_injection_rolls_back_and_backs_off(tmp_path):
+    log_path = os.path.join(str(tmp_path), "events.jsonl")
+    with JsonlLogger(path=log_path) as logger:
+        sup = supervise(make_dist(), tmp_path, "nan",
+                        guard=GuardConfig(backoff_factor=0.5),
+                        faults=FaultPlan(InjectNaNAt(4)), logger=logger)
+        r = sup.run()
+    assert r["status"] == "completed"
+    assert r["restarts"] == 1
+    assert r["step_size"] == pytest.approx(0.025)  # 0.05 backed off once
+    assert np.isfinite(np.asarray(sup.particles)).all()
+    events = [json.loads(l) for l in open(log_path)]
+    kinds = [e["event"] for e in events]
+    assert "guard_violation" in kinds and "rollback" in kinds
+    gv = next(e for e in events if e["event"] == "guard_violation")
+    assert gv["nonfinite_entries"] > 0
+    assert gv["new_step_size"] == pytest.approx(0.025)
+
+
+def test_check_state_unit():
+    ok = np.zeros((4, 2)) + 0.5
+    report = check_state(ok, config=GuardConfig(max_particle_norm=10.0))
+    assert report["nonfinite_entries"] == 0
+    with pytest.raises(GuardViolation, match="non-finite"):
+        check_state(np.array([[np.nan, 1.0]]))
+    with pytest.raises(GuardViolation, match="norm exceeds"):
+        check_state(np.full((3, 2), 100.0),
+                    config=GuardConfig(max_particle_norm=1.0))
+    # per-step displacement: 4 units over 2 steps = 2/step > 1
+    with pytest.raises(GuardViolation, match="displacement"):
+        check_state(np.full((2, 2), 4.0), prev=np.zeros((2, 2)), steps=2,
+                    config=GuardConfig(max_step_norm=1.0))
+    # NaN norms trip the norm guard even with the finite check off
+    with pytest.raises(GuardViolation, match="norm exceeds"):
+        check_state(np.array([[np.nan, 1.0]]),
+                    config=GuardConfig(check_finite=False,
+                                       max_particle_norm=10.0))
+
+
+def test_guard_displacement_via_supervisor(tmp_path):
+    """max_step_norm snapshots the pre-segment state and trips on a huge
+    step size, backing ε off until the run completes."""
+    sup = supervise(make_dist(), tmp_path, "diverge", eps=50.0, steps=4,
+                    guard=GuardConfig(max_step_norm=1.0, backoff_factor=0.1),
+                    retry=RetryPolicy(max_restarts=5, backoff_base_s=0.0))
+    r = sup.run()
+    assert r["status"] == "completed"
+    assert r["restarts"] >= 1
+    assert r["step_size"] < 50.0
+
+
+# --------------------------------------------------------------------- #
+# hard kill, corrupt-newest resume, slow-segment watchdog
+
+
+def test_hard_kill_propagates_then_resume_bitwise(tmp_path):
+    want = reference_final(tmp_path)
+    sup = supervise(make_dist(), tmp_path, "hk",
+                    faults=FaultPlan(HardKillAt(6)))
+    with pytest.raises(SimulatedHardKill):
+        sup.run()
+    killed_at = sup.t
+    assert killed_at < 12
+    sup2 = supervise(make_dist(), tmp_path, "hk")
+    r2 = sup2.run(resume=True)
+    assert r2["resumed_from"] <= killed_at  # steps since last save replay
+    np.testing.assert_array_equal(want, np.asarray(sup2.particles))
+
+
+def test_resume_skips_corrupt_newest_checkpoint(tmp_path):
+    """PR 2's corrupt-newest fallback, extended to the training path: a
+    resume whose newest step dir was half-written falls back to the
+    previous step, replays, and still lands bitwise on the uninterrupted
+    final state."""
+    want = reference_final(tmp_path)
+    sup = supervise(make_dist(), tmp_path, "cc",
+                    faults=FaultPlan(PreemptAt(6)))
+    r = sup.run()
+    assert r["status"] == "preempted" and r["t"] == 8
+    # corrupt the newest step dir in place (half-written save shape)
+    root = os.path.join(str(tmp_path), "cc")
+    newest = os.path.join(root, "step_8")
+    for name in os.listdir(newest):
+        os.remove(os.path.join(newest, name))
+    with open(os.path.join(newest, "garbage"), "w") as fh:
+        fh.write("not a checkpoint")
+    sup2 = supervise(make_dist(), tmp_path, "cc")
+    with pytest.warns(UserWarning, match="skipping unloadable checkpoint"):
+        r2 = sup2.run(resume=True)
+    assert r2["status"] == "completed"
+    assert r2["resumed_from"] == 4  # fell back past the corrupt step_8
+    np.testing.assert_array_equal(want, np.asarray(sup2.particles))
+
+
+def test_slow_segment_watchdog_manual_clock(tmp_path):
+    clock = ManualClock()
+    log_path = os.path.join(str(tmp_path), "slow.jsonl")
+    with JsonlLogger(path=log_path) as logger:
+        sup = supervise(make_dist(), tmp_path, "slow",
+                        faults=FaultPlan(SlowSegmentAt(4, 9.0)),
+                        clock=clock, slow_segment_warn_s=5.0, logger=logger)
+        r = sup.run()
+    assert r["status"] == "completed"
+    events = [json.loads(l) for l in open(log_path)]
+    slow = [e for e in events if e["event"] == "slow_segment"]
+    assert len(slow) == 1 and slow[0]["wall_s"] >= 9.0
+    assert r["max_segment_wall_s"] >= 9.0
+
+
+# --------------------------------------------------------------------- #
+# supervisor plumbing
+
+
+def test_segment_and_checkpoint_events_logged(tmp_path):
+    log_path = os.path.join(str(tmp_path), "ev.jsonl")
+    with JsonlLogger(path=log_path) as logger:
+        sup = supervise(make_dist(), tmp_path, "ev", logger=logger)
+        r = sup.run()
+    events = [json.loads(l) for l in open(log_path)]
+    kinds = [e["event"] for e in events]
+    assert kinds.count("segment") == r["segments"] == 3
+    # initial baseline + one per cadence boundary (4, 8, 12)
+    assert kinds.count("checkpoint") == r["checkpoints"] == 4
+    assert kinds[-1] == "completed"
+    assert r["checkpoint_overhead_frac"] >= 0
+
+
+def test_fresh_run_clears_stale_root(tmp_path):
+    root = os.path.join(str(tmp_path), "stale")
+    mgr = CheckpointManager(root, every=4)
+    mgr.save(999, {"particles": np.zeros((4, 2)), "t": np.asarray(999)})
+    sup = supervise(make_dist(), tmp_path, "stale")
+    sup.run()  # resume=False clears the stale step_999
+    assert CheckpointManager(root).latest_step() == 12
+
+
+def test_supervisor_argument_validation(tmp_path):
+    with pytest.raises(ValueError, match="num_steps"):
+        RunSupervisor(make_dist(), 0, 0.05)
+    with pytest.raises(ValueError, match="requires n"):
+        RunSupervisor(dt.Sampler(2, lambda th: -jnp.sum(th ** 2)), 4, 0.05)
+    with pytest.raises(ValueError, match="not both"):
+        RunSupervisor(make_dist(), 4, 0.05,
+                      checkpoint_dir=str(tmp_path),
+                      manager=CheckpointManager(str(tmp_path)))
+    with pytest.raises(ValueError, match="segment_steps"):
+        RunSupervisor(make_dist(), 4, 0.05, segment_steps=0)
+
+
+def test_unmanaged_run_rolls_back_to_start(tmp_path):
+    """No checkpointing: retry still recovers (in-memory run-start
+    snapshot) and the trajectory stays the reference one."""
+    want = reference_final(tmp_path)
+    sup = RunSupervisor(make_dist(), 12, 0.05, segment_steps=4,
+                        faults=FaultPlan(RaiseAt(8)), sleep=no_sleep)
+    r = sup.run()
+    assert r["status"] == "completed" and r["restarts"] == 1
+    np.testing.assert_array_equal(want, np.asarray(sup.particles))
+
+
+def test_fault_plan_fire_once_and_order():
+    fired = []
+
+    class Probe:
+        def __init__(self, step, tag):
+            self.step = step
+            self.fired = False
+            self.tag = tag
+
+        def fire(self, ctx):
+            fired.append(self.tag)
+
+    class Ctx:
+        t = 10
+
+    plan = FaultPlan(Probe(5, "b"), Probe(1, "a"))
+    plan.fire_due(Ctx())
+    plan.fire_due(Ctx())  # spent faults stay spent
+    assert fired == ["a", "b"]
+    assert plan.exhausted
+
+
+def test_rerun_resets_counters_and_budget(tmp_path):
+    """A preempted supervisor re-run on the SAME object starts with fresh
+    totals and a fresh restart budget (the preempt→resume pattern)."""
+    sup = supervise(make_dist(), tmp_path, "rerun",
+                    faults=FaultPlan(RaiseAt(0), PreemptAt(5)),
+                    retry=RetryPolicy(max_restarts=1, backoff_base_s=0.0))
+    r1 = sup.run()
+    assert r1["status"] == "preempted" and r1["restarts"] == 1
+    sup._faults = FaultPlan(RaiseAt(8))  # run 2 needs budget for one retry
+    r2 = sup.run(resume=True)
+    assert r2["status"] == "completed"
+    assert r2["restarts"] == 1  # budget was NOT depleted by run 1
+    # only run 2's work is counted: the RaiseAt fires before its segment
+    # dispatches, so one successful segment (8→12) after the rollback
+    assert r2["segments"] == 1
+    assert r2["resumed_from"] == 8

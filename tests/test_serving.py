@@ -570,3 +570,148 @@ def test_serve_bench_row_schema():
     assert row["recompiles"] == 0  # warmup precedes the timed window
     assert row["open_loop"]["completed"] == 20
     json.dumps(row)  # one BENCH-style JSON line, serialisable as-is
+
+
+# --------------------------------------------------------------------- #
+# checkpoint hot reload (round 8: train-while-serving)
+
+
+def test_engine_reload_swaps_atomically(rng):
+    eng, parts1 = _logreg_engine(rng)
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    before = eng.predict(x)
+    parts2 = rng.normal(size=(48, 5)).astype(np.float32)  # n may change
+    info = eng.reload(parts2, tag="gen2")
+    assert info["n_particles"] == 48
+    # the compiled buckets were rebuilt for the new ensemble BEFORE the
+    # swap, so the first post-reload predict is a cache hit, not a miss
+    misses = eng.stats()["bucket_misses"]
+    after = eng.predict(x)
+    assert eng.stats()["bucket_misses"] == misses
+    eng2 = PredictiveEngine("logreg", parts2, min_bucket=4, max_bucket=64)
+    np.testing.assert_array_equal(after["mean"], eng2.predict(x)["mean"])
+    assert not np.array_equal(before["mean"], after["mean"])
+    st = eng.stats()
+    assert st["reloads"] == 1 and st["ensemble_tag"] == "gen2"
+
+
+def test_engine_reload_rejects_layout_change(rng):
+    eng, _ = _logreg_engine(rng)
+    with pytest.raises(ValueError, match="incompatible"):
+        eng.reload(rng.normal(size=(32, 9)).astype(np.float32))
+    with pytest.raises(ValueError, match="incompatible"):
+        eng.reload(rng.normal(size=(32,)).astype(np.float32))
+
+
+def test_engine_reload_under_concurrent_predicts(rng):
+    """Predicts racing a reload each see ONE consistent ensemble (old or
+    new) — the (particles, kernels) pair swaps under a single lock."""
+    eng, parts1 = _logreg_engine(rng, n=64)
+    parts2 = rng.normal(size=(64, 5)).astype(np.float32)
+    x = rng.normal(size=(4, 4)).astype(np.float32)
+    want_old = eng.predict(x)["mean"]
+    eng2 = PredictiveEngine("logreg", parts2, min_bucket=4, max_bucket=64)
+    want_new = eng2.predict(x)["mean"]
+    results, errors = [], []
+
+    def hammer():
+        try:
+            for _ in range(30):
+                results.append(eng.predict(x)["mean"])
+        except Exception as e:  # pragma: no cover - failure surface
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    eng.reload(parts2)
+    for t in threads:
+        t.join()
+    assert not errors
+    for mean in results:
+        assert (np.array_equal(mean, want_old)
+                or np.array_equal(mean, want_new))
+
+
+def test_hot_reloader_polls_and_swaps(tmp_path, rng):
+    from dist_svgd_tpu.serving import CheckpointHotReloader
+
+    parts1 = rng.normal(size=(16, 5)).astype(np.float32)
+    parts2 = rng.normal(size=(16, 5)).astype(np.float32)
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root, every=1, backend="npz")
+    mgr.save(10, {"particles": parts1})
+    eng = PredictiveEngine.from_checkpoint(root, "logreg", min_bucket=4,
+                                           max_bucket=16)
+    hr = CheckpointHotReloader(eng, root)
+    assert hr.loaded_step == 10
+    assert hr.poll_once() is None  # nothing newer
+    mgr.save(20, {"particles": parts2})
+    assert hr.poll_once() == 20
+    assert hr.poll_once() is None  # already serving step 20
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    eng2 = PredictiveEngine("logreg", parts2, min_bucket=4, max_bucket=16)
+    np.testing.assert_array_equal(eng.predict(x)["mean"],
+                                  eng2.predict(x)["mean"])
+    assert eng.stats()["ensemble_tag"] == "step_20"
+
+
+def test_hot_reloader_corrupt_newest_keeps_serving(tmp_path, rng):
+    """A half-written newest step dir must not break the live server: the
+    poll skips it (restore fallback would land on the already-served step)
+    and retries next time."""
+    import os as _os
+
+    from dist_svgd_tpu.serving import CheckpointHotReloader
+
+    parts1 = rng.normal(size=(16, 5)).astype(np.float32)
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root, every=1, backend="npz")
+    mgr.save(1, {"particles": parts1})
+    eng = PredictiveEngine.from_checkpoint(root, "logreg", min_bucket=4,
+                                           max_bucket=16)
+    hr = CheckpointHotReloader(eng, root)
+    bad = _os.path.join(root, "step_2")
+    _os.makedirs(bad)
+    with open(_os.path.join(bad, "junk"), "w") as fh:
+        fh.write("partial write")
+    with pytest.warns(UserWarning, match="skipping unloadable"):
+        assert hr.poll_once() is None
+    assert hr.loaded_step == 1
+    assert eng.stats()["reloads"] == 0
+
+
+def test_hot_reloader_missing_key_raises(tmp_path, rng):
+    from dist_svgd_tpu.serving import CheckpointHotReloader
+
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root, every=1, backend="npz")
+    mgr.save(1, {"particles": rng.normal(size=(8, 5)).astype(np.float32)})
+    eng = PredictiveEngine.from_checkpoint(root, "logreg", min_bucket=4,
+                                           max_bucket=16)
+    hr = CheckpointHotReloader(eng, root)
+    mgr.save(2, {"other": np.zeros((8, 5), np.float32)})
+    with pytest.raises(KeyError, match="particles"):
+        hr.poll_once()
+
+
+def test_hot_reloader_baseline_is_engine_loaded_step(tmp_path, rng):
+    """A save landing between the engine's cold start and the reloader's
+    construction must NOT be marked already-served: the baseline is the
+    step the engine actually loaded (engine.checkpoint_step), not the
+    root's latest at construction time."""
+    from dist_svgd_tpu.serving import CheckpointHotReloader
+
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root, every=1, backend="npz")
+    parts1 = rng.normal(size=(16, 5)).astype(np.float32)
+    mgr.save(10, {"particles": parts1})
+    eng = PredictiveEngine.from_checkpoint(root, "logreg", min_bucket=4,
+                                           max_bucket=16)
+    assert eng.checkpoint_step == 10
+    # the race: training writes step 20 before the reloader attaches
+    parts2 = rng.normal(size=(16, 5)).astype(np.float32)
+    mgr.save(20, {"particles": parts2})
+    hr = CheckpointHotReloader(eng, root)
+    assert hr.loaded_step == 10
+    assert hr.poll_once() == 20  # the raced save is served, not skipped

@@ -1,0 +1,202 @@
+"""Fault-recovery drill: measure the resilience subsystem end to end and
+emit ONE BENCH-style ``fault_recovery`` JSON row.
+
+The drill runs a small supervised DistSampler workload (GMM posterior — CPU
+and TPU both fine; every fault is injected via ``resilience/faults.py``, so
+no real signals or sleeps) through four phases:
+
+1. **baseline** — a supervised, checkpointed run to completion (after an
+   untimed warm-up of the same scan programs), giving the honest per-step
+   wall and the directly-measured **checkpoint overhead** (checkpoint wall
+   over segment wall at the default cadence — the acceptance gate is < 5%);
+2. **kill** — the same run with an injected hard kill (``HardKillAt``,
+   SIGKILL-shaped: no checkpoint, no cleanup) mid-way between checkpoints;
+3. **recover** — a fresh ``RunSupervisor.run(resume=True)`` driven to the
+   kill step: its wall IS the recovery cost (restore-from-latest + replay
+   of the steps lost since the last periodic checkpoint);
+4. **verify** — the recovered run continues to completion and the final
+   particle state must be **bitwise identical** to the baseline's (the
+   absolute segment grid makes resume exact — supervisor docstring), and
+   one retry (transient raise) and one NaN-rollback scenario must both
+   recover within budget.
+
+Usage::
+
+    python tools/fault_drill.py                # defaults: n=2048, S=4, 48 steps
+    python tools/fault_drill.py --n 1024 --steps 96 --checkpoint-every 16
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_sampler(n, num_shards, seed=0):
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    parts = init_particles_per_shard(seed, n, 2, num_shards)
+    return dt.DistSampler(
+        num_shards, lambda th, _: gmm_logp(th), None, parts,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False,
+    )
+
+
+def run_drill(n=2048, num_shards=4, num_steps=48, step_size=0.05,
+              checkpoint_every=16, segment_steps=4, kill_step=None,
+              root=None, seed=0):
+    """Run the four drill phases; returns the ``fault_recovery`` row."""
+    import jax
+    import numpy as np
+
+    from dist_svgd_tpu.resilience import (
+        FaultPlan,
+        GuardConfig,
+        HardKillAt,
+        InjectNaNAt,
+        RaiseAt,
+        RunSupervisor,
+        SimulatedHardKill,
+    )
+
+    if root is None:
+        import tempfile
+
+        root = tempfile.mkdtemp(prefix="fault_drill_")
+    if kill_step is None:
+        # strictly between two checkpoints: the interesting case (steps
+        # actually lost; a kill ON a cadence multiple loses zero)
+        kill_step = 2 * checkpoint_every + segment_steps
+    if kill_step >= num_steps:
+        raise ValueError(
+            f"kill_step ({kill_step}) must land before num_steps "
+            f"({num_steps}) or the hard kill never fires — raise --steps "
+            "or pass an explicit --kill-step"
+        )
+
+    def supervise(sampler, steps, **kw):
+        kw.setdefault("segment_steps", segment_steps)
+        kw.setdefault("sleep", lambda s: None)  # injected faults only
+        return RunSupervisor(sampler, steps, step_size, **kw)
+
+    # -------- phase 1: baseline (warm-up untimed, then timed) ----------- #
+    ds = build_sampler(n, num_shards, seed)
+    state0 = ds.state_dict()
+    supervise(ds, num_steps, manager=None).run()  # compile warm-up
+    ds.load_state_dict(state0)
+    base_dir = os.path.join(root, "baseline")
+    sup = supervise(ds, num_steps, checkpoint_dir=base_dir,
+                    checkpoint_every=checkpoint_every)
+    base = sup.run()
+    final_baseline = np.asarray(sup.particles)
+    step_wall_ms = base["segment_wall_s"] / max(base["steps_run"], 1) * 1e3
+    overhead_pct = base["checkpoint_overhead_frac"] * 100
+
+    # -------- phase 2: hard kill mid-run ------------------------------- #
+    ds2 = build_sampler(n, num_shards, seed)
+    kill_dir = os.path.join(root, "killed")
+    sup2 = supervise(ds2, num_steps, checkpoint_dir=kill_dir,
+                     checkpoint_every=checkpoint_every,
+                     faults=FaultPlan(HardKillAt(kill_step)))
+    killed_at = None
+    try:
+        sup2.run()
+    except SimulatedHardKill:
+        killed_at = sup2.t  # the boundary the kill landed on
+    assert killed_at is not None, "hard kill did not fire"
+
+    # -------- phase 3: recover (restore + replay to the kill step) ------ #
+    ds3 = build_sampler(n, num_shards, seed)
+    t0 = time.perf_counter()
+    sup3 = supervise(ds3, killed_at, checkpoint_dir=kill_dir,
+                     checkpoint_every=checkpoint_every)
+    rec = sup3.run(resume=True)
+    recovery_wall_s = time.perf_counter() - t0
+    steps_lost = killed_at - (rec["resumed_from"] or 0)
+    assert rec["steps_run"] == steps_lost, (rec, killed_at)
+
+    # -------- phase 4: verify bitwise + the other recovery paths -------- #
+    sup4 = supervise(ds3, num_steps, checkpoint_dir=kill_dir,
+                     checkpoint_every=checkpoint_every)
+    sup4.run(resume=True)
+    bitwise = bool(np.array_equal(final_baseline, np.asarray(sup4.particles)))
+
+    # transient raise → backoff → rollback → replay: the replayed trajectory
+    # is the baseline's exactly (same ε, same grid), so final state pins it
+    ds5 = build_sampler(n, num_shards, seed)
+    retry = supervise(ds5, num_steps, checkpoint_dir=os.path.join(root, "r"),
+                      checkpoint_every=checkpoint_every,
+                      faults=FaultPlan(RaiseAt(kill_step))).run()
+    retry_ok = (retry["restarts"] == 1 and retry["status"] == "completed"
+                and bool(np.array_equal(final_baseline,
+                                        np.asarray(ds5.particles))))
+
+    ds6 = build_sampler(n, num_shards, seed)
+    nan_rb = supervise(ds6, num_steps,
+                       checkpoint_dir=os.path.join(root, "g"),
+                       checkpoint_every=checkpoint_every,
+                       guard=GuardConfig(),
+                       faults=FaultPlan(InjectNaNAt(kill_step))).run()
+    nan_ok = (nan_rb["status"] == "completed" and nan_rb["restarts"] == 1
+              and nan_rb["step_size"] < step_size
+              and bool(np.isfinite(np.asarray(ds6.particles)).all()))
+
+    return {
+        "metric": "fault_recovery",
+        "platform": jax.devices()[0].platform,
+        "sampler": "distsampler",
+        "n": n,
+        "num_shards": num_shards,
+        "num_steps": num_steps,
+        "checkpoint_every": checkpoint_every,
+        "segment_steps": segment_steps,
+        "step_wall_ms": round(step_wall_ms, 3),
+        "checkpoint_overhead_pct": round(overhead_pct, 2),
+        "checkpoints": base["checkpoints"],
+        "kill_step": killed_at,
+        "last_checkpoint_step": rec["resumed_from"],
+        "steps_lost": steps_lost,
+        "recovery_wall_s": round(recovery_wall_s, 4),
+        "recovery_vs_step_wall": round(
+            recovery_wall_s / max(base["segment_wall_s"] / num_steps, 1e-9), 1
+        ),
+        "resumed_bitwise_identical": bitwise,
+        "retry_backoff_recovered": bool(retry_ok),
+        "nan_rollback_recovered": bool(nan_ok),
+        "overhead_under_5pct": bool(overhead_pct < 5.0),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=2048)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=48)
+    ap.add_argument("--stepsize", type=float, default=0.05)
+    ap.add_argument("--checkpoint-every", type=int, default=16)
+    ap.add_argument("--segment-steps", type=int, default=4)
+    ap.add_argument("--kill-step", type=int, default=None)
+    ap.add_argument("--root", default=None,
+                    help="checkpoint scratch root (default: a temp dir)")
+    args = ap.parse_args()
+
+    row = run_drill(
+        n=args.n, num_shards=args.shards, num_steps=args.steps,
+        step_size=args.stepsize, checkpoint_every=args.checkpoint_every,
+        segment_steps=args.segment_steps, kill_step=args.kill_step,
+        root=args.root,
+    )
+    print(json.dumps(row), flush=True)
+    ok = (row["resumed_bitwise_identical"] and row["retry_backoff_recovered"]
+          and row["nan_rollback_recovered"])
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

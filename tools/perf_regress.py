@@ -20,7 +20,15 @@ A/B timing protocol those notes derived:
 - **noise-aware threshold** — the shared pool swings ±40% *between*
   sessions; min-of-interleaved-chains removes most of the within-session
   spread, so the default gate fails a row only when it lands >35% below its
-  incumbent (``--tol``), and warns from half that.
+  incumbent (``--tol``), and warns from half that;
+- **windowed incumbents (round 8)** — each ``--record`` appends to a
+  per-row *history window* (``_history`` in the incumbents file, newest
+  ``--window`` runs) and the gate compares against the window's **median**,
+  widening the band to ``mad_scale × MAD/median`` when the window itself is
+  noisier than ``--tol`` says.  One lucky fast session can no longer ratchet
+  the bar to a level the pool only hits 10% of the time, and a genuinely
+  noisy row (relative MAD above the tol) self-documents its spread instead
+  of flapping.  Legacy single-value incumbents seed a 1-point window.
 
 Usage (on the TPU host)::
 
@@ -71,6 +79,87 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
 SERVE_BENCH_KW = dict(model="logreg", n_particles=10_000, n_features=54,
                       clients=16, requests=1500, rows=(1, 4, 16),
                       max_batch=256, max_wait_ms=2.0)
+
+#: Band widening factor: a row's effective shortfall tolerance is
+#: ``max(tol, MAD_SCALE · MAD/median)`` over its incumbent window.  3×MAD ≈
+#: 2σ for a normal spread — wide enough that in-band pool noise doesn't
+#: FAIL, tight enough that a real 2× regression fails at any recorded
+#: spread (the band is capped at 0.9 like the per-row tol).
+MAD_SCALE = 3.0
+
+
+# --------------------------------------------------------------------- #
+# noise-aware judging (pure helpers — unit-tested on CPU in
+# tests/test_perf_regress.py; everything below main() needs the TPU)
+
+
+def _median(vals):
+    import statistics
+
+    return statistics.median(vals)
+
+
+def _mad(vals, med=None):
+    """Median absolute deviation — the robust spread estimate (a single
+    outlier session moves it far less than a stddev)."""
+    med = _median(vals) if med is None else med
+    return _median([abs(v - med) for v in vals])
+
+
+def incumbent_history(incumbents: dict, key: str):
+    """The row's incumbent window: ``_history[key]`` when recorded, else a
+    1-point window seeded from the legacy scalar entry (so pre-window
+    incumbent files keep gating unchanged)."""
+    hist = incumbents.get("_history", {}).get(key)
+    if hist:
+        return list(hist)
+    legacy = incumbents.get(key)
+    return [legacy] if isinstance(legacy, (int, float)) else []
+
+
+def judge_row(value, history, tol, higher_better, mad_scale=MAD_SCALE):
+    """Noise-aware verdict of ``value`` against a window of prior rows.
+
+    The incumbent is the window **median**; the shortfall band is ``tol``
+    widened to ``mad_scale × MAD/median`` when the window's own relative
+    spread exceeds it (both capped at 0.9).  Returns ``(status, info)`` with
+    ``status`` in ``PASS``/``WARN``/``FAIL``/``NO_INCUMBENT`` and ``info``
+    carrying the judged numbers for the printed row."""
+    if not history:
+        return "NO_INCUMBENT", {"incumbent": None}
+    med = _median(history)
+    if med <= 0:
+        return "NO_INCUMBENT", {"incumbent": med}
+    band = min(max(tol, mad_scale * _mad(history, med) / med), 0.9)
+    # regression ratio, oriented so >1 means better than incumbent
+    ratio = value / med if higher_better else med / value
+    info = {
+        "incumbent": med,
+        "window": len(history),
+        "window_rel_mad": round(_mad(history, med) / med, 4),
+        "band": round(band, 3),
+        "vs_incumbent": round(ratio, 3),
+    }
+    if ratio < 1 - band:
+        return "FAIL", info
+    if ratio < 1 - band / 2:
+        return "WARN", info
+    return "PASS", info
+
+
+def record_result(incumbents: dict, key: str, value, window: int) -> None:
+    """Append ``value`` to the row's history window (seeding it from a
+    legacy scalar incumbent first) and refresh the scalar entry to the
+    window median — old readers of the file keep working."""
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    hist = incumbents.setdefault("_history", {}).setdefault(key, [])
+    legacy = incumbents.get(key)
+    if not hist and isinstance(legacy, (int, float)):
+        hist.append(legacy)
+    hist.append(value)
+    del hist[:-window]
+    incumbents[key] = _median(hist)
 
 
 def _build_benches():
@@ -171,8 +260,11 @@ def main():
     ap.add_argument("--target-s", type=float, default=1.0,
                     help="device work per fenced sample (chain sizing)")
     ap.add_argument("--record", action="store_true",
-                    help="overwrite the incumbents file with this run "
+                    help="append this run to the incumbent history windows "
                          "(refused when any row FAILs — see --force)")
+    ap.add_argument("--window", type=int, default=8,
+                    help="incumbent history window per row (median + MAD "
+                         "band judged over the newest N recorded runs)")
     ap.add_argument("--force", action="store_true",
                     help="allow --record even when rows FAIL (deliberately "
                          "lowering the bar, e.g. after a hardware change)")
@@ -217,23 +309,15 @@ def main():
     results = {}
     for key, (_, to_value, unit, higher) in benches.items():
         value = to_value(best[key])
-        inc = incumbents.get(key)
         row = {"bench": key, "value": round(value, 2), "unit": unit,
-               "incumbent": inc, "reps": reps[key]}
-        if inc:
-            # regression ratio, oriented so >1 means better than incumbent
-            ratio = value / inc if higher else inc / value
-            row["vs_incumbent"] = round(ratio, 3)
-            tol = min(args.tol * TOL_FACTOR.get(key, 1.0), 0.9)
-            if ratio < 1 - tol:
-                row["status"] = "FAIL"
-                failures += 1
-            elif ratio < 1 - tol / 2:
-                row["status"] = "WARN"
-            else:
-                row["status"] = "PASS"
-        else:
-            row["status"] = "NO_INCUMBENT"
+               "reps": reps[key]}
+        tol = min(args.tol * TOL_FACTOR.get(key, 1.0), 0.9)
+        status, info = judge_row(value, incumbent_history(incumbents, key),
+                                 tol, higher)
+        row.update(info)
+        row["status"] = status
+        if status == "FAIL":
+            failures += 1
         results[key] = value
         print(json.dumps(row), flush=True)
 
@@ -279,28 +363,24 @@ def main():
         srow = serve_bench.run_bench(**SERVE_BENCH_KW)
         if serve_best is None or srow["value"] > serve_best["value"]:
             serve_best = srow
-    inc = incumbents.get(serve_key)
     row = {"bench": serve_key, "value": serve_best["value"],
-           "unit": "requests/sec", "incumbent": inc,
+           "unit": "requests/sec",
            "p50_ms": serve_best["p50_ms"], "p99_ms": serve_best["p99_ms"],
            "batch_occupancy_mean": serve_best["batch_occupancy_mean"],
            "recompiles": serve_best["recompiles"]}
     if serve_best["recompiles"]:
         row["status"] = "FAIL"
         failures += 1
-    elif inc:
-        ratio = serve_best["value"] / inc
-        row["vs_incumbent"] = round(ratio, 3)
-        tol = min(args.tol * TOL_FACTOR.get(serve_key, 1.0), 0.9)
-        if ratio < 1 - tol:
-            row["status"] = "FAIL"
-            failures += 1
-        elif ratio < 1 - tol / 2:
-            row["status"] = "WARN"
-        else:
-            row["status"] = "PASS"
     else:
-        row["status"] = "NO_INCUMBENT"
+        tol = min(args.tol * TOL_FACTOR.get(serve_key, 1.0), 0.9)
+        status, info = judge_row(
+            serve_best["value"], incumbent_history(incumbents, serve_key),
+            tol, True,
+        )
+        row.update(info)
+        row["status"] = status
+        if status == "FAIL":
+            failures += 1
     results[serve_key] = serve_best["value"]
     print(json.dumps(row), flush=True)
 
@@ -319,9 +399,18 @@ def main():
         }))
         sys.exit(1)
     if args.record:
-        incumbents.update(results)
+        # append to each row's history window; the scalar entry becomes the
+        # window median (legacy readers of the file keep working).  The
+        # roofline fraction keeps its fixed-threshold scalar (it is already
+        # a same-session ratio — pool noise cancels in it by construction).
+        for key, value in results.items():
+            if key == "north_star_roofline_fraction":
+                incumbents[key] = value
+            else:
+                record_result(incumbents, key, value, args.window)
         incumbents["recorded"] = (
-            f"perf_regress --record (rounds={args.rounds}) on {platform}"
+            f"perf_regress --record (rounds={args.rounds}, "
+            f"window={args.window}) on {platform}"
         )
         with open(INCUMBENTS_PATH, "w") as fh:
             json.dump(incumbents, fh, indent=2)
