@@ -309,13 +309,21 @@ class PredictiveEngine:
             )
         bucket = bucket_for(b, self.min_bucket)
         fn, dtype = self._kernel_for(bucket)
-        xb = jnp.asarray(x, dtype=dtype)
         if bucket != b:
-            xb = jnp.concatenate(
-                [xb, jnp.zeros((bucket - b, x.shape[1]), xb.dtype)], axis=0
-            )
-        out = fn(xb)
-        return {k: np.asarray(v[:b]) for k, v in out.items()}
+            # pad on HOST: a device-side jnp.concatenate compiles one XLA
+            # program per distinct (b, bucket) pair — steady-state traffic
+            # with mixed request sizes recompiles forever while the bucket
+            # cache reports all hits (caught by jaxlint's retrace_sentry,
+            # docs/notes.md round 9).  Host padding keeps the device seeing
+            # only bucket shapes.
+            xp = np.zeros((bucket, x.shape[1]), dtype=x.dtype)
+            xp[:b] = x
+            x = xp
+        out = fn(jnp.asarray(x, dtype=dtype))
+        # slice AFTER the host fetch: a device-array v[:b] is a compiled
+        # slice program per (bucket, b) shape pair — same silent-retrace
+        # class as the pad above
+        return {k: np.asarray(v)[:b] for k, v in out.items()}
 
     def warmup(self, batch_sizes: Optional[List[int]] = None) -> List[int]:
         """Pre-trace kernels so first requests don't pay XLA compiles.

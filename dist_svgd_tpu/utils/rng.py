@@ -1,10 +1,20 @@
-"""RNG helpers.
+"""RNG helpers — the ONE place this repo turns seeds into PRNG keys.
 
 The reference seeds torch's global RNG per rank (``torch.manual_seed(rank)``,
 experiments/logreg.py:24) so each rank draws an entirely different initial
 particle array yet only uses its own block (SURVEY.md §7.3.5).  JAX's explicit
 keys make the equivalent well-defined globally: one root key, ``fold_in`` per
 shard, each shard's block drawn from its own independent stream.
+
+Construction discipline (enforced by ``tools/jaxlint`` rule **JL002**):
+``jax.random.PRNGKey`` is called nowhere outside this module.  Call sites
+use :func:`as_key` (seed → key), :func:`minibatch_key` (the minibatch
+stream's root, a fixed fold so it never collides with the particle-init
+stream), or the ``init_particles*`` helpers.  Centralising construction is
+what makes key-reuse statically checkable: every key in the codebase is
+either derived here or split/folded from one that was, so two draws from
+the same name without an intervening ``split``/``fold_in`` are provably
+correlated — exactly what JL002 flags.
 """
 
 from __future__ import annotations

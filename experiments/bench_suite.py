@@ -249,12 +249,13 @@ def bench_bnn(iters, n_particles=500, dataset="boston", batch_size=100,
     import dist_svgd_tpu as dt
     from dist_svgd_tpu.models import bnn
     from dist_svgd_tpu.utils.datasets import load_uci_regression
+    from dist_svgd_tpu.utils.rng import as_key
 
     split = load_uci_regression(dataset, 0)
     n_features = split.x_train.shape[1]
     likelihood, prior = bnn.make_bnn_split(n_features)
     d = bnn.num_params(n_features)
-    init = bnn.init_particles(jax.random.PRNGKey(0), n_particles, n_features)
+    init = bnn.init_particles(as_key(0), n_particles, n_features)
     sampler = dt.Sampler(
         d, likelihood, data=(split.x_train, split.y_train),
         batch_size=min(batch_size, split.x_train.shape[0]), log_prior=prior,
@@ -279,7 +280,7 @@ def bench_bnn(iters, n_particles=500, dataset="boston", batch_size=100,
             batch_size=min(batch_size, split.x_train.shape[0]),
             log_prior=prior, kernel="median_step",
         )
-        parts = bnn.init_particles(jax.random.PRNGKey(1), n_particles, n_features)
+        parts = bnn.init_particles(as_key(1), n_particles, n_features)
         eval_every, cap, steps, rmse = 50, 2000, 0, float("inf")
         reached = None
         while steps < cap:

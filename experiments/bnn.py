@@ -87,6 +87,7 @@ def run(
     import dist_svgd_tpu as dt
     from dist_svgd_tpu.models import bnn
     from dist_svgd_tpu.utils.datasets import load_uci_regression
+    from dist_svgd_tpu.utils.rng import as_key
 
     # pure-argument validation before any data load (as covertype.py)
     if exchange_every > 1:
@@ -112,7 +113,7 @@ def run(
     d = bnn.num_params(n_features, n_hidden)
 
     n_used = (nparticles // nproc) * nproc  # reference drop policy
-    particles = bnn.init_particles(jax.random.PRNGKey(seed), n_used, n_features, n_hidden)
+    particles = bnn.init_particles(as_key(seed), n_used, n_features, n_hidden)
     likelihood, prior = bnn.make_bnn_split(n_features, n_hidden)
     batch = min(batch_size, x_tr.shape[0] // nproc) if batch_size else None
 

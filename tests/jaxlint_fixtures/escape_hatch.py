@@ -1,0 +1,10 @@
+"""Escape-hatch fixture: a disable comment suppresses exactly its rule."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def pinned(x):
+    a = np.asarray(x)  # jaxlint: disable=JL003
+    b = np.asarray(x)  # jaxlint: disable=JL005
+    return x + a.shape[0] + b.shape[0]

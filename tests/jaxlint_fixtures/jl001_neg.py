@@ -1,0 +1,20 @@
+"""JL001 negative: hoisted jit, stable literals, array-boxed scalars."""
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda p, eps: p * eps)
+compiled_once = jax.jit(lambda x: x + 1)
+
+
+def drive(p):
+    p = step(p, 0.1)
+    p = step(p, 0.1)  # same literal: one trace
+    p = step(p, jnp.asarray(0.2))  # device array: no per-value retrace
+    return p
+
+
+def sweep(fns, x):
+    outs = []
+    for _ in range(3):
+        outs.append(compiled_once(x))  # jit lives outside the loop
+    return outs

@@ -1,0 +1,21 @@
+"""JL001 positive: jit-in-loop and literal divergence across call sites.
+
+Fixture corpus — parsed by the analyzer, never imported or executed.
+"""
+import jax
+
+step = jax.jit(lambda p, eps: p * eps)
+
+
+def drive(p):
+    p = step(p, 0.1)
+    p = step(p, 0.2)  # EXPECT JL001: second distinct scalar at arg 1
+    return p
+
+
+def sweep(fns, x):
+    outs = []
+    for fn in fns:
+        compiled = jax.jit(fn)  # EXPECT JL001: jit wrapped per iteration
+        outs.append(compiled(x))
+    return outs

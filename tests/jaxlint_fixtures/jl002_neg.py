@@ -1,0 +1,41 @@
+"""JL002 negative: split/fold_in discipline (the blessed patterns)."""
+import jax
+
+from dist_svgd_tpu.utils.rng import as_key, draw_minibatch
+
+
+def fresh_draws(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (3,))
+    b = jax.random.normal(k2, (3,))
+    return a + b
+
+
+def folded_loop(key):
+    outs = []
+    for i in range(4):
+        outs.append(jax.random.normal(jax.random.fold_in(key, i), (2,)))
+    return outs
+
+
+def rebound_loop(key):
+    for _ in range(4):
+        key, sub = jax.random.split(key)
+        _ = jax.random.normal(sub, (2,))
+    return key
+
+
+def blessed(seed, data):
+    key = as_key(seed)
+    batch, scale = draw_minibatch(key, data, 100, 10)
+    return batch, scale
+
+
+def key(name):
+    # a generic local helper that happens to be called `key`: NOT a PRNG
+    # constructor (it was not imported from jax.random)
+    return name.lower()
+
+
+def cache_lookup(cache, name):
+    return cache[key(name)]

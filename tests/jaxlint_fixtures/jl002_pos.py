@@ -1,0 +1,28 @@
+"""JL002 positive: key reuse (linear + loop) and ad-hoc construction."""
+import jax
+from jax.random import PRNGKey
+
+
+def double_draw(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.normal(key, (3,))  # EXPECT JL002: key consumed twice
+    return a + b
+
+
+def loop_draw(key):
+    outs = []
+    for _ in range(4):
+        outs.append(jax.random.normal(key, (2,)))  # EXPECT JL002: same stream per iter
+    return outs
+
+
+def adhoc_key():
+    return jax.random.PRNGKey(0)  # EXPECT JL002: construct via utils.rng
+
+
+def adhoc_typed_key():
+    return jax.random.key(0)  # EXPECT JL002: new-style constructor too
+
+
+def adhoc_from_import():
+    return PRNGKey(0)  # EXPECT JL002: bare from-imported constructor

@@ -1,0 +1,16 @@
+"""JL003 negative: host fetches on the driver side; static-arg casts."""
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("gain",))
+def scaled(p, gain: float):
+    return p * float(gain)  # static arg: sanctioned trace-time cast
+
+
+def driver(p):
+    out = scaled(p, 2.0)
+    host = np.asarray(out)  # outside any trace: a deliberate fetch
+    return float(host.mean())

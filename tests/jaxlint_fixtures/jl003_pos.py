@@ -1,0 +1,20 @@
+"""JL003 positive: host syncs inside jitted / scanned code."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_step(p):
+    s = float(p.mean())  # EXPECT JL003: concretizes a tracer
+    host = np.asarray(p)  # EXPECT JL003: host pull per step
+    m = p.sum().item()  # EXPECT JL003: device->host sync
+    return p * s + host.shape[0] + m
+
+
+def scan_drive(p):
+    def body(c, _):
+        snapshot = np.asarray(c)  # EXPECT JL003: scan body is traced
+        return c + snapshot.mean(), None
+
+    out, _ = jax.lax.scan(body, p, None, length=3)
+    return out

@@ -1,0 +1,22 @@
+"""JL004 negative: consistent locking; __init__ exempt; unguarded-only ok."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # construction precedes sharing: exempt
+        self._label = "idle"
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def reset(self):
+        with self._lock:
+            self._n = 0
+
+    def rename(self, label):
+        # only ever assigned without the lock -> a single-threaded-by-
+        # contract attribute, not the rule's business
+        self._label = label

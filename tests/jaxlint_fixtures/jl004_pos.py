@@ -1,0 +1,15 @@
+"""JL004 positive: attribute assigned both under and outside the lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def reset(self):
+        self._n = 0  # EXPECT JL004: bare write to lock-guarded state

@@ -1,0 +1,20 @@
+"""JL005 negative: carry-threaded accumulation; jax.debug effects."""
+import jax
+
+
+@jax.jit
+def accumulate(c0, xs):
+    def body(carry, x):
+        carry = carry + x  # local rebind: fine
+        jax.debug.print("carry {c}", c=carry)  # sanctioned effect path
+        return carry, None
+
+    out, _ = jax.lax.scan(body, c0, xs)
+    return out
+
+
+class Model:
+    def drive(self, p):
+        out = accumulate(p, p)
+        self.cache = out  # host side: a real value, not a tracer
+        return out

@@ -1,0 +1,21 @@
+"""JL005 positive: tracer leaks and dead side effects under jit/scan."""
+import jax
+
+log = []
+
+
+@jax.jit
+def leaky(x):
+    log.append(x)  # EXPECT JL005: closure mutation at trace time
+    print("tracing", x)  # EXPECT JL005: trace-time print
+    return x * 2
+
+
+class Model:
+    def trace_me(self, p):
+        @jax.jit
+        def step(x):
+            self.cache = x  # EXPECT JL005: tracer stored on self
+            return x * 2
+
+        return step(p)

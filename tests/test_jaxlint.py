@@ -1,0 +1,255 @@
+"""jaxlint: fixture corpus, escape hatch, the tier-1 zero-finding gate, and
+the runtime retrace sentry.
+
+Three layers:
+
+1. **Fixture corpus** (``tests/jaxlint_fixtures/``): at least one positive
+   and one negative snippet per rule, pinned file-by-file — a rule change
+   that stops catching its positive (or starts flagging its negative)
+   fails here, not in production review.
+2. **The gate**: the analyzer runs over ``dist_svgd_tpu/``, ``tools/`` and
+   ``experiments/`` exactly as ``python -m tools.jaxlint`` does and must
+   report ZERO non-allowlisted findings — the baseline every future PR
+   inherits.  The allowlist itself is policy-checked (no package-tree
+   entries).
+3. **Sentry**: XLA-compile counting is exercised on CPU — first call
+   compiles, steady state counts zero, a new shape counts again — plus
+   the serving engine's steady-state zero-compile contract (the round-9
+   pad/slice retrace fix stays fixed).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.jaxlint import allowlist as allowlist_mod  # noqa: E402
+from tools.jaxlint import cli, lint_paths, lint_source, load_rules  # noqa: E402
+from tools.jaxlint.sentry import assert_no_recompiles, retrace_sentry  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "jaxlint_fixtures")
+GATED_TREES = [os.path.join(REPO_ROOT, p)
+               for p in ("dist_svgd_tpu", "tools", "experiments")]
+
+ALL_RULES = ("JL001", "JL002", "JL003", "JL004", "JL005")
+
+
+def lint_fixture(name):
+    path = os.path.join(FIXTURES, name)
+    with open(path) as fh:
+        return lint_source(path, fh.read())
+
+
+def rules_in(findings):
+    return {f.rule for f in findings}
+
+
+# --------------------------------------------------------------------- #
+# 1. fixture corpus: ≥ 1 positive + 1 negative per rule
+
+#: fixture file -> (rules that MUST fire, rules that MUST NOT fire)
+EXPECTATIONS = {
+    "jl001_pos.py": ({"JL001"}, set()),
+    "jl001_neg.py": (set(), {"JL001"}),
+    "jl002_pos.py": ({"JL002"}, set()),
+    "jl002_neg.py": (set(), {"JL002"}),
+    "jl003_pos.py": ({"JL003"}, set()),
+    "jl003_neg.py": (set(), {"JL003"}),
+    "jl004_pos.py": ({"JL004"}, set()),
+    "jl004_neg.py": (set(), {"JL004"}),
+    "jl005_pos.py": ({"JL005"}, set()),
+    "jl005_neg.py": (set(), set(ALL_RULES)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(EXPECTATIONS))
+def test_fixture(name):
+    must, must_not = EXPECTATIONS[name]
+    found = rules_in(lint_fixture(name))
+    missing = must - found
+    assert not missing, (
+        f"{name}: rules {sorted(missing)} did not fire; findings: "
+        f"{[f.format() for f in lint_fixture(name)]}"
+    )
+    spurious = found & must_not
+    assert not spurious, (
+        f"{name}: rules {sorted(spurious)} fired on a negative fixture: "
+        f"{[f.format() for f in lint_fixture(name) if f.rule in spurious]}"
+    )
+
+
+def test_every_rule_has_positive_and_negative_fixture():
+    """The corpus shape itself is pinned: adding rule JL006 without
+    fixtures fails here."""
+    registered = {r.RULE_ID for r in load_rules()}
+    assert registered == set(ALL_RULES)
+    for rule in registered:
+        stem = rule.lower()
+        for suffix in ("_pos.py", "_neg.py"):
+            assert os.path.exists(os.path.join(FIXTURES, stem + suffix)), (
+                f"missing fixture {stem + suffix}"
+            )
+
+
+def test_positive_findings_carry_location_and_message():
+    findings = lint_fixture("jl003_pos.py")
+    assert findings, "jl003_pos.py must produce findings"
+    for f in findings:
+        assert f.path.endswith("jl003_pos.py")
+        assert f.line > 0
+        assert f.rule in ALL_RULES
+        assert f.message
+        # file:line: RULE msg — the clickable format
+        assert f.format().startswith(f"{f.path}:{f.line}: {f.rule} ")
+
+
+# --------------------------------------------------------------------- #
+# escape hatch
+
+def test_escape_hatch_suppresses_exactly_its_named_rule():
+    findings = lint_fixture("escape_hatch.py")
+    jl003_lines = [f.line for f in findings if f.rule == "JL003"]
+    # line with `disable=JL003` is suppressed; line with `disable=JL005`
+    # still reports its JL003 finding (the hatch names ONE rule)
+    with open(os.path.join(FIXTURES, "escape_hatch.py")) as fh:
+        lines = fh.read().splitlines()
+    suppressed_line = next(i for i, l in enumerate(lines, 1)
+                           if "disable=JL003" in l)
+    kept_line = next(i for i, l in enumerate(lines, 1)
+                     if "disable=JL005" in l)
+    assert suppressed_line not in jl003_lines
+    assert kept_line in jl003_lines
+
+
+def test_escape_hatch_multiple_rules_one_comment():
+    src = (
+        "import jax\nimport numpy as np\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = np.asarray(x); log.append(x)  # jaxlint: disable=JL003,JL005\n"
+        "    return x\n"
+    )
+    assert lint_source("inline.py", src) == []
+
+
+# --------------------------------------------------------------------- #
+# 2. the tier-1 gate: zero non-allowlisted findings over the repo
+
+def test_allowlist_policy_is_clean():
+    assert allowlist_mod.validate() == []
+
+
+def test_repo_has_zero_nonallowlisted_findings():
+    findings = [
+        f for f in lint_paths(GATED_TREES)
+        if not allowlist_mod.is_allowlisted(f.path, f.rule, f.line)
+    ]
+    assert not findings, (
+        "jaxlint found new violations (fix them, or add a per-line "
+        "`# jaxlint: disable=RULE` with justification — allowlist entries "
+        "only for tools//experiments/):\n"
+        + "\n".join(f.format() for f in findings)
+    )
+
+
+def test_cli_json_over_repo_exits_zero(capsys):
+    rc = cli.main(["--json"] + GATED_TREES)
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["findings"] == []
+    assert {r["rule"] for r in out["rules"]} == set(ALL_RULES)
+
+
+def test_cli_reports_fixture_findings(capsys):
+    rc = cli.main(["--json", os.path.join(FIXTURES, "jl002_pos.py")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in out["findings"]} == {"JL002"}
+    assert all(f["path"].endswith("jl002_pos.py") for f in out["findings"])
+
+
+def test_cli_list_rules(capsys):
+    rc = cli.main(["--list-rules"])
+    text = capsys.readouterr().out
+    assert rc == 0
+    for rule in ALL_RULES:
+        assert rule in text
+
+
+# --------------------------------------------------------------------- #
+# 3. runtime retrace sentry
+
+def _fresh_jitted():
+    import jax
+
+    return jax.jit(lambda x: x * 2 + 1)
+
+
+def test_sentry_counts_first_compile_and_steady_state_zero():
+    import jax.numpy as jnp
+
+    f = _fresh_jitted()
+    with retrace_sentry("cold") as cold:
+        f(jnp.ones(3)).block_until_ready()
+    if not cold.supported:
+        pytest.skip("jax.monitoring events unavailable on this jax")
+    assert cold.compiles >= 1
+    with retrace_sentry("steady") as steady:
+        for _ in range(3):
+            f(jnp.ones(3)).block_until_ready()
+    assert steady.compiles == 0
+    assert steady.traces == 0
+
+
+def test_sentry_catches_shape_retrace():
+    import jax.numpy as jnp
+
+    f = _fresh_jitted()
+    f(jnp.ones(3)).block_until_ready()
+    with retrace_sentry("retrace") as sentry:
+        f(jnp.ones(4)).block_until_ready()  # new shape: must re-trace
+    if not sentry.supported:
+        pytest.skip("jax.monitoring events unavailable on this jax")
+    assert sentry.compiles >= 1
+
+
+def test_assert_no_recompiles_helper():
+    import jax.numpy as jnp
+
+    f = _fresh_jitted()
+    f(jnp.ones(3)).block_until_ready()  # warm
+    out = assert_no_recompiles(f, jnp.ones(3), label="steady")
+    assert out.shape == (3,)
+    with retrace_sentry("probe") as probe:
+        pass
+    if not probe.supported:
+        pytest.skip("jax.monitoring events unavailable on this jax")
+    with pytest.raises(AssertionError, match="compiled"):
+        assert_no_recompiles(f, jnp.ones(5), label="cold-shape")
+
+
+def test_serving_engine_steady_state_compiles_zero():
+    """The round-9 retrace fix, pinned: after warmup, mixed request sizes
+    must not compile ANYTHING (bucket kernels, pads, or slices)."""
+    import numpy as np
+
+    from dist_svgd_tpu.serving import PredictiveEngine
+
+    rng = np.random.default_rng(0)
+    eng = PredictiveEngine(
+        "logreg", rng.normal(size=(64, 8)).astype(np.float32),
+        min_bucket=4, max_bucket=16,
+    )
+    eng.warmup()
+    with retrace_sentry("serve steady state") as sentry:
+        for b in (1, 3, 4, 7, 16, 2, 5, 11):
+            out = eng.predict(rng.normal(size=(b, 7)).astype(np.float32))
+            assert out["mean"].shape == (b,)
+    if not sentry.supported:
+        pytest.skip("jax.monitoring events unavailable on this jax")
+    assert sentry.compiles == 0, sentry.report()
+    assert eng.stats()["bucket_misses"] == 3  # warmup's 4..16, nothing since

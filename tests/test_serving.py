@@ -568,6 +568,9 @@ def test_serve_bench_row_schema():
     assert row["metric"] == "serve_throughput"
     assert row["value"] > 0
     assert row["recompiles"] == 0  # warmup precedes the timed window
+    # the retrace sentry's independent raw-XLA-compile count over the same
+    # window (None only when jax.monitoring is unavailable)
+    assert row["sentry_compiles"] in (0, None)
     assert row["open_loop"]["completed"] == 20
     json.dumps(row)  # one BENCH-style JSON line, serialisable as-is
 

@@ -1,0 +1,6 @@
+"""Measurement & validation tools (see tools/README.md).
+
+This package marker exists so `python -m tools.jaxlint` resolves from the
+repo root; the individual scripts keep their path-insertion prologues and
+still run as plain `python tools/<script>.py`.
+"""

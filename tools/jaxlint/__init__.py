@@ -1,0 +1,33 @@
+"""jaxlint: repo-native JAX/TPU discipline analyzer + runtime retrace sentry.
+
+Static rules (AST-based, fixture-tested, tier-1-enforced with a
+zero-finding baseline for package code — see tools/README.md for the full
+table and tests/test_jaxlint.py for the gate):
+
+- **JL001 retrace-hazard** — jit-in-loop; Python scalars varying across a
+  jitted callable's call sites (``rules_retrace``).
+- **JL002 key-reuse** — a PRNG key consumed twice without split/fold_in;
+  ad-hoc ``PRNGKey`` construction outside ``utils/rng.py`` (``rules_rng``).
+- **JL003 host-sync-in-hot-path** — float()/.item()/np.asarray/... inside
+  traced code (``rules_hostsync``).
+- **JL004 lock-discipline** — ``self._x`` assigned both inside and outside
+  ``with self._lock`` (``rules_lock``).
+- **JL005 tracer-leak** — Python side effects under jit/scan
+  (``rules_tracer``).
+
+Escape hatch: ``# jaxlint: disable=JL00N`` on the offending line.
+Runtime half: :func:`retrace_sentry` counts XLA compiles inside a region
+(zero-compile steady-state contract — wired into serve_bench/perf_regress).
+"""
+
+from tools.jaxlint.core import Finding, lint_paths, lint_source, load_rules
+from tools.jaxlint.sentry import assert_no_recompiles, retrace_sentry
+
+__all__ = [
+    "Finding",
+    "lint_paths",
+    "lint_source",
+    "load_rules",
+    "retrace_sentry",
+    "assert_no_recompiles",
+]

@@ -1,0 +1,53 @@
+"""jaxlint allowlist: accepted findings OUTSIDE the package tree.
+
+Each entry: ``(path_suffix, rule, line_or_None, reason)``.  A finding is
+allowlisted when its path ends with ``path_suffix``, its rule matches, and
+(when a line is given) its line matches exactly.
+
+Policy (ISSUE 4): allowlist entries are permitted **only** for ``tools/``
+and ``experiments/`` — package code (``dist_svgd_tpu/``) must be clean or
+carry a reviewed per-line ``# jaxlint: disable=`` comment at the site,
+where the justification lives next to the code it excuses.  The CLI
+*enforces* this: an entry whose suffix points into ``dist_svgd_tpu/``
+is itself an error.
+
+Prefer per-line disables over entries here: an entry silently survives the
+code moving lines, a disable comment moves with it.  Line-pinned entries
+exist for generated or vendored files one cannot annotate.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+# (path_suffix, rule, line_or_None, reason)
+ALLOWLIST: List[Tuple[str, str, Optional[int], str]] = [
+    # (empty at ship time: every finding in tools/ and experiments/ was
+    # fixed instead — see docs/notes.md round 9.  Keep the mechanism.)
+]
+
+
+def is_allowlisted(path: str, rule: str, line: int,
+                   allowlist: Iterable[Tuple[str, str, Optional[int], str]] = None) -> bool:
+    entries = ALLOWLIST if allowlist is None else allowlist
+    norm = path.replace("\\", "/")
+    for suffix, arule, aline, _reason in entries:
+        if arule == rule and norm.endswith(suffix):
+            if aline is None or aline == line:
+                return True
+    return False
+
+
+def validate(allowlist=None) -> List[str]:
+    """Policy errors in the allowlist itself (package-tree entries)."""
+    entries = ALLOWLIST if allowlist is None else allowlist
+    errors = []
+    for suffix, rule, _line, reason in entries:
+        if "dist_svgd_tpu/" in suffix.replace("\\", "/"):
+            errors.append(
+                f"allowlist entry ({suffix!r}, {rule}) targets package code: "
+                "fix it or use a per-line disable comment instead"
+            )
+        if not reason.strip():
+            errors.append(f"allowlist entry ({suffix!r}, {rule}) has no reason")
+    return errors

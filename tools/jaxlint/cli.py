@@ -1,0 +1,92 @@
+"""jaxlint CLI: ``python -m tools.jaxlint [paths...] [--json]``.
+
+Default paths are the three enforced trees (``dist_svgd_tpu``, ``tools``,
+``experiments``) resolved against the repo root, so the bare invocation
+from anywhere inside the repo reproduces exactly what the tier-1 gate
+(``tests/test_jaxlint.py``) enforces.  Exit code 0 = no non-allowlisted
+findings; 1 = findings; 2 = the allowlist itself violates policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from tools.jaxlint import allowlist as allowlist_mod
+from tools.jaxlint.core import Finding, lint_paths, load_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_PATHS = ("dist_svgd_tpu", "tools", "experiments")
+
+
+def rule_table() -> List[dict]:
+    return [{"rule": r.RULE_ID, "summary": r.SUMMARY} for r in load_rules()]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)} "
+                         "under the repo root)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (one JSON document)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    ap.add_argument("--no-allowlist", action="store_true",
+                    help="report allowlisted findings too (audit mode)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        if args.as_json:
+            print(json.dumps({"rules": rule_table()}, indent=2))
+        else:
+            for row in rule_table():
+                print(f"{row['rule']}  {row['summary']}")
+        return 0
+
+    errors = allowlist_mod.validate()
+    if errors:
+        for e in errors:
+            print(f"jaxlint: allowlist policy error: {e}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"jaxlint: no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths)
+    kept: List[Finding] = []
+    waived: List[Finding] = []
+    for f in findings:
+        if not args.no_allowlist and allowlist_mod.is_allowlisted(
+                f.path, f.rule, f.line):
+            waived.append(f)
+        else:
+            kept.append(f)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in kept],
+            "allowlisted": [f.as_dict() for f in waived],
+            "rules": rule_table(),
+            "paths": paths,
+        }, indent=2))
+    else:
+        for f in kept:
+            print(f.format())
+        summary = (f"jaxlint: {len(kept)} finding(s)"
+                   + (f", {len(waived)} allowlisted" if waived else ""))
+        print(summary, file=sys.stderr if kept else sys.stdout)
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

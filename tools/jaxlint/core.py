@@ -1,0 +1,271 @@
+"""jaxlint core: parsing, scope analysis, the escape hatch, and the runner.
+
+Design notes (shared by every rule module):
+
+- **AST, not regex.**  Each rule gets a :class:`Module` — parsed tree with
+  parent links, source lines, and the per-line disable set — and returns
+  :class:`Finding` objects.  A rule never raises on weird-but-valid Python;
+  anything it cannot resolve statically it stays silent about (precision
+  over recall: this gate runs in tier-1 with a zero-finding baseline, so a
+  speculative finding is a build breakage).
+- **Escape hatch.**  ``# jaxlint: disable=JL003`` (comma-separated for
+  several rules) on the finding's line suppresses exactly the named
+  rule(s) there — the reviewable, greppable way to bless an intentional
+  violation.  There is no file-level or wildcard disable by design.
+- **Traced scopes.**  JL003/JL005 only fire *inside code that JAX traces*:
+  functions decorated with / passed to ``jit``/``vmap``/``pmap``/``grad``/
+  ``shard_map``/``lax.scan``-family wrappers, plus everything lexically
+  nested in one.  Host-side driver code (chunk fetches, checkpoint I/O)
+  legitimately syncs and is out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+#: call / decorator names (last dotted component) that stage their function
+#: argument through a JAX trace — the roots of "traced scope".
+TRACING_WRAPPERS = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "shard_map", "scan", "while_loop", "fori_loop", "cond", "switch",
+    "associative_scan", "custom_jvp", "custom_vjp", "named_call",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_component(node: ast.AST) -> Optional[str]:
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _is_partial_of_tracer(call: ast.Call) -> bool:
+    """``partial(jax.jit, ...)`` / ``functools.partial(jit, ...)``."""
+    if last_component(call.func) != "partial" or not call.args:
+        return False
+    first = call.args[0]
+    return last_component(first) in TRACING_WRAPPERS
+
+
+class Module:
+    """One parsed source file plus the derived facts rules share."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.disabled: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = DISABLE_RE.search(line)
+            if m:
+                self.disabled[i] = {
+                    r.strip().upper() for r in m.group(1).split(",") if r.strip()
+                }
+        # parent links (ast has none natively)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._jaxlint_parent = node  # type: ignore[attr-defined]
+        self._traced: Optional[Set[ast.AST]] = None
+
+    # -------------------------------------------------------------- #
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_jaxlint_parent", None)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return anc
+        return None
+
+    def finding(self, node: ast.AST, rule: str, message: str) -> Optional[Finding]:
+        """Build a finding unless the escape hatch suppresses it."""
+        line = getattr(node, "lineno", 1)
+        if rule.upper() in self.disabled.get(line, set()):
+            return None
+        return Finding(self.path, line, rule, message)
+
+    # -------------------------------------------------------------- #
+    # traced-scope analysis (JL003 / JL005)
+
+    def traced_functions(self) -> Set[ast.AST]:
+        """FunctionDef/Lambda nodes whose bodies JAX traces (directly or by
+        lexical nesting inside a traced one)."""
+        if self._traced is not None:
+            return self._traced
+        roots: Set[ast.AST] = set()
+        # name -> every FunctionDef with that name (for fn-passed-by-name)
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        def mark_callable_arg(arg: ast.AST) -> None:
+            if isinstance(arg, ast.Lambda):
+                roots.add(arg)
+            elif isinstance(arg, ast.Name):
+                roots.update(defs_by_name.get(arg.id, ()))
+            # nested Call args (e.g. jax.jit(jax.vmap(f))) are visited on
+            # their own walk pass below
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if last_component(dec) in TRACING_WRAPPERS:
+                        roots.add(node)
+                    elif isinstance(dec, ast.Call) and (
+                        last_component(dec.func) in TRACING_WRAPPERS
+                        or _is_partial_of_tracer(dec)
+                    ):
+                        roots.add(node)
+            elif isinstance(node, ast.Call):
+                if last_component(node.func) in TRACING_WRAPPERS:
+                    for arg in node.args:
+                        mark_callable_arg(arg)
+                elif _is_partial_of_tracer(node):
+                    for arg in node.args[1:]:
+                        mark_callable_arg(arg)
+
+        # propagate to lexically nested functions
+        traced: Set[ast.AST] = set()
+        for fn in roots:
+            traced.add(fn)
+            for inner in ast.walk(fn):
+                if inner is not fn and isinstance(
+                    inner, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    traced.add(inner)
+        self._traced = traced
+        return traced
+
+    def in_traced_scope(self, node: ast.AST) -> bool:
+        fn = self.enclosing_function(node)
+        return fn is not None and fn in self.traced_functions()
+
+
+def jit_static_params(fn: ast.AST) -> Set[str]:
+    """Parameter names a jit decorator marks static (``static_argnames`` /
+    ``static_argnums``) — trace-time Python values, exempt from the
+    host-sync rule by construction."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    names: Set[str] = set()
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        if not (last_component(dec.func) in TRACING_WRAPPERS
+                or _is_partial_of_tracer(dec)):
+            continue
+        for kw in dec.keywords:
+            values = []
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                values = [e.value for e in kw.value.elts
+                          if isinstance(e, ast.Constant)]
+            elif isinstance(kw.value, ast.Constant):
+                values = [kw.value.value]
+            if kw.arg == "static_argnames":
+                names.update(v for v in values if isinstance(v, str))
+            elif kw.arg == "static_argnums":
+                for v in values:
+                    if isinstance(v, int) and 0 <= v < len(params):
+                        names.add(params[v])
+    return names
+
+
+# ------------------------------------------------------------------ #
+# runner
+
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in SKIP_DIRS and not d.startswith("."))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return out
+
+
+def load_rules():
+    """The rule registry, in rule-ID order."""
+    from tools.jaxlint import (
+        rules_hostsync,
+        rules_lock,
+        rules_retrace,
+        rules_rng,
+        rules_tracer,
+    )
+
+    return [rules_retrace, rules_rng, rules_hostsync, rules_lock, rules_tracer]
+
+
+def lint_source(path: str, source: str, rules=None) -> List[Finding]:
+    """Lint one in-memory source blob (the fixture-test entry point)."""
+    rules = rules if rules is not None else load_rules()
+    try:
+        module = Module(path, source)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "JL000",
+                        f"syntax error: {e.msg}")]
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(f for f in rule.check(module) if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_paths(paths: Iterable[str], rules=None) -> List[Finding]:
+    rules = rules if rules is not None else load_rules()
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            findings.extend(lint_source(path, fh.read(), rules))
+    return findings

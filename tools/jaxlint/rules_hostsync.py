@@ -1,0 +1,113 @@
+"""JL003 host-sync-in-hot-path: device→host syncs inside traced code.
+
+The hot path of this codebase is its traced scopes — the jitted step/scan
+programs ``Sampler.run`` / ``DistSampler.run_steps`` dispatch and the
+jitted serve kernels behind ``PredictiveEngine`` (everything JAX traces:
+``jit``/``vmap``/``grad``-wrapped functions, ``lax.scan``-family bodies,
+and code lexically nested in them).  Inside a trace, a host conversion is
+never what the author wanted:
+
+- ``float()`` / ``int()`` / ``bool()`` on a traced value raises a
+  ``ConcretizationTypeError`` at trace time — or, when it happens to hit a
+  trace-time constant, silently bakes the value into the program so the
+  callable re-traces per value;
+- ``.item()`` / ``np.asarray`` / ``np.array`` / ``jax.device_get`` /
+  ``.block_until_ready()`` force a device fence; reached through a jitted
+  caller they are a per-step host round trip hiding inside a step function.
+
+Driver-side host fetches (checkpoint saves, chunked-history ``np.asarray``
+overlap copies) are *deliberate* syncs outside any trace and are not
+flagged.  For the rare intentional trace-time constant, use
+``# jaxlint: disable=JL003`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.jaxlint.core import (
+    Finding,
+    Module,
+    dotted_name,
+    jit_static_params,
+    last_component,
+)
+
+RULE_ID = "JL003"
+SUMMARY = "host sync (float/item/np.asarray/...) inside traced code"
+
+_CASTS = {"float", "int", "bool", "complex"}
+_NP_FUNCS = {"asarray", "array", "copyto", "frombuffer"}
+_SYNC_ATTRS = {"item", "block_until_ready", "tolist", "__array__"}
+_NP_MODULES = {"np", "numpy", "onp"}
+
+
+def _is_literalish(node: ast.AST) -> bool:
+    """Constant-folding-safe expressions float()/int() may legally wrap at
+    trace time (pure Python literals and simple arithmetic on them)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.BinOp):
+        return _is_literalish(node.left) and _is_literalish(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literalish(node.operand)
+    return False
+
+
+def _is_static_jit_arg(module: Module, node: ast.Call) -> bool:
+    """``float(x)`` where ``x`` is a ``static_argnames`` parameter of an
+    enclosing jitted function: a sanctioned trace-time cast (the Pallas
+    wrappers' ``float(bandwidth)`` idiom), not a host sync."""
+    arg = node.args[0]
+    if not isinstance(arg, ast.Name):
+        return False
+    fn = module.enclosing_function(node)
+    while fn is not None:
+        if arg.id in jit_static_params(fn):
+            return True
+        fn = module.enclosing_function(fn)
+    return False
+
+
+def check(module: Module) -> List[Optional[Finding]]:
+    findings: List[Optional[Finding]] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call) or not module.in_traced_scope(node):
+            continue
+        func = node.func
+        # float(x) / int(x) / bool(x) on non-literal args
+        if (isinstance(func, ast.Name) and func.id in _CASTS
+                and node.args and not _is_literalish(node.args[0])
+                and not _is_static_jit_arg(module, node)):
+            findings.append(module.finding(
+                node, RULE_ID,
+                f"{func.id}() on a value inside traced code: concretizes the "
+                "tracer (error or silent per-value retrace) — keep it a "
+                "device value or hoist the cast to the host driver",
+            ))
+            continue
+        if isinstance(func, ast.Attribute):
+            leaf = func.attr
+            base = dotted_name(func.value)
+            if leaf in _SYNC_ATTRS:
+                findings.append(module.finding(
+                    node, RULE_ID,
+                    f".{leaf}() inside traced code forces a device→host "
+                    "sync in the hot path — return the device value and "
+                    "fetch it once, outside the trace",
+                ))
+            elif base in _NP_MODULES and leaf in _NP_FUNCS:
+                findings.append(module.finding(
+                    node, RULE_ID,
+                    f"{base}.{leaf}() inside traced code pulls the value to "
+                    "host per step — use jnp (stays on device) or move the "
+                    "fetch out of the traced function",
+                ))
+            elif base and base.split(".")[0] == "jax" and leaf == "device_get":
+                findings.append(module.finding(
+                    node, RULE_ID,
+                    "jax.device_get inside traced code is a per-step host "
+                    "round trip — fetch outside the trace",
+                ))
+    return findings

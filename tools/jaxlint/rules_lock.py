@@ -1,0 +1,110 @@
+"""JL004 lock-discipline: attributes mutated both with and without the lock.
+
+Scope: the threaded modules (``serving/``, ``utils/metrics.py``,
+``distsampler.py`` and anything else handed to the analyzer) — any class
+that owns a lock (``self._lock = threading.Lock()`` / ``RLock`` /
+``Condition`` / ``Semaphore`` in any method) gets its instance-attribute
+stores partitioned into lock-guarded and bare.  An attribute assigned
+*both* inside a ``with self._lock:`` block somewhere *and* outside one
+elsewhere is flagged at each unguarded site: half-guarded state is the
+worst of both worlds — the guarded sites document an invariant the bare
+sites silently break (torn multi-field updates, lost increments).
+
+``__init__`` is exempt (construction precedes sharing), as are attributes
+only ever written without the lock (possibly single-threaded by design —
+that contract is the class author's to state, not this rule's to guess).
+Suppress a deliberate bare write (e.g. a stop flag that tolerates racing)
+with ``# jaxlint: disable=JL004``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.jaxlint.core import Finding, Module, last_component
+
+RULE_ID = "JL004"
+SUMMARY = "attribute assigned both inside and outside `with self._lock`"
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attribute names bound to a threading lock anywhere in the class."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if last_component(node.value.func) in _LOCK_TYPES:
+                for tgt in node.targets:
+                    attr = _self_attr_target(tgt)
+                    if attr:
+                        out.add(attr)
+    return out
+
+
+def _under_lock(module: Module, node: ast.AST, lock_attrs: Set[str],
+                stop: ast.AST) -> bool:
+    for anc in module.ancestors(node):
+        if anc is stop:
+            break
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                expr = item.context_expr
+                # `with self._lock:` or `with self._lock.acquire_timeout(..)`
+                attr = _self_attr_target(expr)
+                if attr is None and isinstance(expr, ast.Call):
+                    base = expr.func
+                    if isinstance(base, ast.Attribute):
+                        attr = _self_attr_target(base.value)
+                if attr in lock_attrs:
+                    return True
+    return False
+
+
+def check(module: Module) -> List[Optional[Finding]]:
+    findings: List[Optional[Finding]] = []
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        lock_attrs = _lock_attrs(cls)
+        if not lock_attrs:
+            continue
+        guarded: Set[str] = set()
+        bare: Dict[str, List[ast.AST]] = {}
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            init = method.name == "__init__"
+            for node in ast.walk(method):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for tgt in targets:
+                    attr = _self_attr_target(tgt)
+                    if attr is None or attr in lock_attrs:
+                        continue
+                    if _under_lock(module, node, lock_attrs, method):
+                        guarded.add(attr)
+                    elif not init:
+                        bare.setdefault(attr, []).append(node)
+        for attr in sorted(guarded & set(bare)):
+            for node in bare[attr]:
+                findings.append(module.finding(
+                    node, RULE_ID,
+                    f"'self.{attr}' is assigned under the lock elsewhere in "
+                    f"{cls.name} but bare here: a concurrent reader/writer "
+                    "can observe a torn update — take the lock (or disable "
+                    "with a one-line justification if single-threaded by "
+                    "contract)",
+                ))
+    return findings

@@ -1,0 +1,123 @@
+"""JL001 retrace-hazard: code shapes that re-trace a jitted program per call.
+
+Two statically-decidable hazards are flagged:
+
+1. **``jax.jit`` applied inside a loop body** — every iteration wraps a
+   fresh callable, so nothing ever hits jit's internal cache and each call
+   pays a full trace+compile.  (A jit call behind an explicit memo dict —
+   the repo's ``_chunk_fn``/``_run_fn`` pattern — lives outside the loop
+   and is not flagged.)
+
+2. **Python numeric literals that vary across call sites of one jitted
+   callable.**  A traced (non-static) Python scalar argument is baked into
+   the jaxpr as a weak-typed constant: every *distinct* value is a fresh
+   trace.  The rule collects call sites of names known to be jit-wrapped in
+   the same module (``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated
+   defs, ``name = jax.jit(...)`` and ``self.attr = jax.jit(...)``
+   bindings) and flags any positional slot fed ≥ 2 distinct numeric
+   literals.  Hoist the scalar into ``jnp.asarray(...)`` (traced once per
+   dtype/shape) or mark the arg static.
+
+Suppress an intentional per-value specialisation with
+``# jaxlint: disable=JL001`` on the call line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.jaxlint.core import Finding, Module, _is_partial_of_tracer, last_component
+
+RULE_ID = "JL001"
+SUMMARY = "retrace hazard (jit-in-loop; Python scalar varying across call sites)"
+
+_JIT_NAMES = {"jit"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    if last_component(node.func) in _JIT_NAMES:
+        return True
+    return _is_partial_of_tracer(node) and last_component(node.args[0]) in _JIT_NAMES
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if last_component(dec) in _JIT_NAMES:
+        return True
+    return isinstance(dec, ast.Call) and _is_jit_call(dec)
+
+
+def _numeric_literal(node: ast.AST):
+    """The float/int value of a numeric literal expression, else None."""
+    if isinstance(node, ast.Constant) and type(node.value) in (int, float):
+        return node.value
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and type(node.operand.value) in (int, float)):
+        return -node.operand.value
+    return None
+
+
+def check(module: Module) -> List[Optional[Finding]]:
+    findings: List[Optional[Finding]] = []
+
+    # ---- hazard 1: jax.jit(...) lexically inside a for/while body ----
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+            continue
+        fn = module.enclosing_function(node)
+        for anc in module.ancestors(node):
+            if anc is fn:
+                break
+            if isinstance(anc, (ast.For, ast.While)):
+                findings.append(module.finding(
+                    node, RULE_ID,
+                    "jax.jit called inside a loop body: each iteration wraps "
+                    "a fresh callable and re-traces — hoist the jit (or a "
+                    "keyed cache of it) out of the loop",
+                ))
+                break
+
+    # ---- hazard 2: literal divergence across call sites ----
+    jitted: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                jitted.add(node.name)
+        elif isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Call) and _is_jit_call(node.value):
+                for tgt in node.targets:
+                    name = last_component(tgt)
+                    if name:
+                        jitted.add(name)
+
+    # name -> arg position -> {literal value: first flagging node}
+    seen: Dict[str, Dict[int, Dict[object, ast.AST]]] = {}
+    calls_in_order = [n for n in ast.walk(module.tree) if isinstance(n, ast.Call)]
+    calls_in_order.sort(key=lambda n: (n.lineno, n.col_offset))
+    for node in calls_in_order:
+        name = last_component(node.func)
+        if name not in jitted:
+            continue
+        for pos, arg in enumerate(node.args):
+            value = _numeric_literal(arg)
+            if value is None:
+                continue
+            slot = seen.setdefault(name, {}).setdefault(pos, {})
+            if value not in slot:
+                slot[value] = arg
+                if len(slot) == 2:
+                    findings.append(module.finding(
+                        arg, RULE_ID,
+                        f"jitted callable '{name}' receives a second distinct "
+                        f"Python scalar ({value!r}) at positional arg {pos}: "
+                        "each distinct value re-traces — pass it as a device "
+                        "array (jnp.asarray) or mark the arg static",
+                    ))
+                elif len(slot) > 2:
+                    findings.append(module.finding(
+                        arg, RULE_ID,
+                        f"jitted callable '{name}' re-traces again at "
+                        f"positional arg {pos} (literal {value!r})",
+                    ))
+    return findings

@@ -1,0 +1,177 @@
+"""JL002 key-reuse: PRNG keys consumed twice, and ad-hoc key construction.
+
+Reused keys are the silent correctness killer in an SVGD codebase: the
+stochastic minibatch streams Algorithm 1's score estimate relies on
+(Liu & Wang 2016) are only unbiased if every draw consumes a *fresh* key —
+a reused key correlates draws that the estimator treats as independent,
+and nothing crashes.  Two checks:
+
+1. **Double consumption.**  Within one function, a key bound to a name and
+   passed bare to two ``jax.random`` sampling ops (or ``draw_minibatch``)
+   without an intervening rebind (``split``/``fold_in``/fresh assignment)
+   is flagged at the second use.  A bare-name key consumed *inside a loop*
+   whose body never rebinds it is flagged immediately — the classic
+   per-iteration reuse.
+
+2. **Ad-hoc construction.**  ``jax.random.PRNGKey(...)`` / ``jax.random.
+   key(...)`` anywhere outside ``utils/rng.py`` is flagged: the blessed
+   pattern is ``dist_svgd_tpu.utils.rng.as_key(seed)`` (plus the stream
+   helpers there), so seed→key policy lives in exactly one module.
+
+Derivations (``split``/``fold_in``) are not consumption: passing
+``jax.random.fold_in(key, i)`` to a sampler is the *correct* pattern and
+never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.jaxlint.core import Finding, Module, dotted_name, last_component
+
+RULE_ID = "JL002"
+SUMMARY = "PRNG key reused / constructed outside utils/rng.py"
+
+#: jax.random ops that CONSUME the key passed as their first argument.
+CONSUMERS = {
+    "normal", "uniform", "choice", "bernoulli", "categorical", "permutation",
+    "randint", "truncated_normal", "gumbel", "exponential", "beta", "gamma",
+    "dirichlet", "laplace", "logistic", "poisson", "rademacher", "cauchy",
+    "multivariate_normal", "orthogonal", "ball", "bits", "t", "shuffle",
+    "draw_minibatch",
+}
+
+#: key constructors (old- and new-style) whose use outside utils/rng.py is
+#: ad-hoc construction.
+KEY_CONSTRUCTORS = {"PRNGKey", "key"}
+
+
+def _is_random_consumer(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf == "draw_minibatch":
+        return True
+    return leaf in CONSUMERS and ("random" in name.split(".") or name == leaf)
+
+
+def _functions(module: Module):
+    yield module.tree  # module scope counts as one "function"
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            yield node
+
+
+def _own_nodes(module: Module, fn) -> List[ast.AST]:
+    """Nodes of ``fn`` excluding nested function bodies (each scope is
+    analysed on its own), in source order."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    out.sort(key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)))
+    return out
+
+
+def check(module: Module) -> List[Optional[Finding]]:
+    findings: List[Optional[Finding]] = []
+
+    # ---- check 2: ad-hoc construction outside utils/rng.py ----
+    path = module.path.replace("\\", "/")
+    if not path.endswith("utils/rng.py"):
+        # names imported FROM jax.random (`from jax.random import PRNGKey`
+        # / `... import key as mk`): bare calls to these are construction
+        # too, not just the dotted jax.random.PRNGKey form
+        from_imported: set = set()
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.ImportFrom)
+                    and node.module == "jax.random"):
+                for alias in node.names:
+                    if alias.name in KEY_CONSTRUCTORS:
+                        from_imported.add(alias.asname or alias.name)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            leaf = name.rsplit(".", 1)[-1]
+            dotted_hit = (leaf in KEY_CONSTRUCTORS
+                          and "random" in name.split("."))
+            bare_hit = name in from_imported
+            if dotted_hit or bare_hit:
+                findings.append(module.finding(
+                    node, RULE_ID,
+                    f"ad-hoc jax.random key construction ({name}): build "
+                    "keys through dist_svgd_tpu.utils.rng (as_key / the "
+                    "stream helpers) so seed policy lives in one module",
+                ))
+
+    # ---- check 1: double consumption within a scope ----
+    for fn in _functions(module):
+        # (name, node, loop_node_or_None) consumption events + rebind lines
+        consumptions: List[Tuple[str, ast.Call, Optional[ast.AST]]] = []
+        rebinds: Dict[str, List[int]] = {}
+        nodes = _own_nodes(module, fn)
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    for name_node in ast.walk(tgt):
+                        if isinstance(name_node, ast.Name):
+                            rebinds.setdefault(name_node.id, []).append(node.lineno)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if isinstance(node.target, ast.Name):
+                    rebinds.setdefault(node.target.id, []).append(node.lineno)
+            elif isinstance(node, ast.For):
+                for name_node in ast.walk(node.target):
+                    if isinstance(name_node, ast.Name):
+                        rebinds.setdefault(name_node.id, []).append(node.lineno)
+            elif isinstance(node, ast.Call) and _is_random_consumer(node):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    loop = None
+                    for anc in module.ancestors(node):
+                        if anc is fn:
+                            break
+                        if isinstance(anc, (ast.For, ast.While)):
+                            loop = anc
+                            break
+                    consumptions.append((node.args[0].id, node, loop))
+
+        # keys = names that are consumed at least once AND ever look like a
+        # key (consumed by a jax.random op first arg is evidence enough)
+        last_use_line: Dict[str, int] = {}
+        for name, node, loop in consumptions:
+            line = node.lineno
+            if loop is not None:
+                rebound_in_loop = any(
+                    loop.lineno <= rl <= (loop.end_lineno or rl)
+                    for rl in rebinds.get(name, ())
+                )
+                if not rebound_in_loop:
+                    findings.append(module.finding(
+                        node, RULE_ID,
+                        f"key '{name}' consumed inside a loop without a "
+                        "per-iteration split/fold_in: every iteration draws "
+                        "the SAME stream",
+                    ))
+                    continue
+            prev = last_use_line.get(name)
+            if prev is not None:
+                rebound_between = any(
+                    prev < rl <= line for rl in rebinds.get(name, ())
+                )
+                if not rebound_between:
+                    findings.append(module.finding(
+                        node, RULE_ID,
+                        f"key '{name}' consumed again (first use line {prev}) "
+                        "without an intervening split/fold_in: the two draws "
+                        "are perfectly correlated",
+                    ))
+            last_use_line[name] = line
+    return findings

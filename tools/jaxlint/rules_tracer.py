@@ -1,0 +1,121 @@
+"""JL005 tracer-leak: Python side effects inside traced code.
+
+Inside a ``jit``/``scan``-traced function, Python-level mutation runs ONCE
+at trace time with abstract tracers — not per step at runtime.  The two
+failure shapes:
+
+- **leaks**: storing a value on ``self`` or a module global from inside
+  the trace captures a tracer that outlives its trace (the classic
+  ``UnexpectedTracerError``, or worse: a stale concrete value silently
+  reused by later calls);
+- **dead side effects**: appending to a closure list, writing a
+  ``global``/``nonlocal``, calling ``print`` — all execute at trace time
+  only, so the steady-state program does nothing and the author's
+  accounting is fiction.
+
+Flagged inside traced scopes: assignments to ``self.*`` / class attributes,
+``global``/``nonlocal`` declarations, ``print(...)`` calls, and
+``.append``/``.extend``/``.add``/``.update`` calls on names not bound in
+the traced function itself (closure mutation).  ``jax.debug.print`` /
+``jax.debug.callback`` are the sanctioned effect path and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.jaxlint.core import Finding, Module, dotted_name
+
+RULE_ID = "JL005"
+SUMMARY = "tracer leak / Python side effect under jit or scan"
+
+_MUTATORS = {"append", "extend", "add", "update", "insert", "setdefault"}
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    """Names bound inside ``fn`` (params, assignments, loop targets,
+    comprehension targets) — excluding nested function bodies."""
+    names: Set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            names.add(a.arg)
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return names
+
+
+def check(module: Module) -> List[Optional[Finding]]:
+    findings: List[Optional[Finding]] = []
+    traced = module.traced_functions()
+    for fn in traced:
+        locals_here = None  # computed lazily per traced fn
+        for node in ast.walk(fn):
+            # analyse each traced fn's own statements once: nested traced
+            # fns are iterated separately, so skip nodes whose nearest
+            # enclosing function is not `fn`
+            if node is fn or module.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+                findings.append(module.finding(
+                    node, RULE_ID,
+                    f"'{kind}' write inside traced code runs once at trace "
+                    "time, not per step — thread the value through the "
+                    "carry/return instead",
+                ))
+                continue
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id in ("self", "cls")):
+                    findings.append(module.finding(
+                        node, RULE_ID,
+                        f"assignment to {tgt.value.id}.{tgt.attr} inside "
+                        "traced code stores a tracer on the instance (leak) "
+                        "— return the value from the traced function and "
+                        "assign on the host side",
+                    ))
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name == "print":
+                    findings.append(module.finding(
+                        node, RULE_ID,
+                        "print() under jit fires once at trace time with "
+                        "tracers — use jax.debug.print for runtime values",
+                    ))
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _MUTATORS
+                      and isinstance(node.func.value, ast.Name)):
+                    if locals_here is None:
+                        locals_here = _local_names(fn)
+                    base = node.func.value.id
+                    if base not in locals_here and base not in ("self", "cls"):
+                        findings.append(module.finding(
+                            node, RULE_ID,
+                            f"'{base}.{node.func.attr}(...)' mutates a "
+                            "closure object inside traced code: the mutation "
+                            "happens at trace time only (and may capture a "
+                            "tracer) — accumulate through the scan carry or "
+                            "return value",
+                        ))
+    return findings

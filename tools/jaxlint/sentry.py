@@ -1,0 +1,120 @@
+"""Runtime retrace sentry: count XLA compilations inside a code region.
+
+The static rules (JL001) catch the retrace shapes that are decidable from
+source; this is the runtime backstop for the rest — a context manager that
+listens to jax's monitoring events and counts how many times the region
+actually traced and compiled:
+
+    with retrace_sentry() as sentry:
+        serve_steady_state_traffic()
+    assert sentry.compiles == 0, sentry.report()
+
+Steady-state regions (the serve-bench timed window, perf-regress
+measurement rounds) carry a **zero-compile contract**: everything was
+pre-traced during warmup, so any in-window compile is a retrace bug —
+a shape that escaped the padding buckets, a Python scalar baked into a
+jaxpr, an eager jnp op on a novel shape.  ``tools/serve_bench.py`` and
+``tools/perf_regress.py`` wire this in and FAIL on a nonzero count.
+
+Implementation: ``jax.monitoring`` duration events (present in jax
+0.4.x and 0.5.x) — ``.../backend_compile_duration`` fires once per XLA
+compilation, ``.../jaxpr_trace_duration`` once per trace.  Listeners are
+global in jax, so the sentry keeps its own nesting-safe registration and
+counts only between ``__enter__`` and ``__exit__``; counting is
+thread-safe (serve-path compiles happen on worker threads).  On a jax
+without these events the sentry degrades to counting nothing and says so
+(``supported = False``) rather than breaking the bench.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+_COMPILE_EVENT_SUBSTR = "backend_compile"
+_TRACE_EVENT_SUBSTR = "jaxpr_trace"
+
+
+class RetraceSentry:
+    """Counter state for one ``retrace_sentry()`` region."""
+
+    def __init__(self, label: str = ""):
+        self.label = label
+        self.compiles = 0
+        self.traces = 0
+        self.supported = True
+        self._lock = threading.Lock()
+        self._active = False
+
+    def _on_event(self, name: str, *args, **kwargs) -> None:
+        if not self._active:
+            return
+        with self._lock:
+            if _COMPILE_EVENT_SUBSTR in name:
+                self.compiles += 1
+            elif _TRACE_EVENT_SUBSTR in name:
+                self.traces += 1
+
+    def report(self) -> dict:
+        return {
+            "label": self.label,
+            "compiles": self.compiles,
+            "traces": self.traces,
+            "supported": self.supported,
+        }
+
+
+class retrace_sentry:
+    """Context manager counting XLA compiles/traces inside the region.
+
+    Nestable and re-entrant-safe; listener registration failures degrade
+    to ``supported=False`` instead of raising (a bench must never die to
+    its own instrumentation).
+    """
+
+    def __init__(self, label: str = ""):
+        self._state = RetraceSentry(label)
+        self._registered = False
+
+    def __enter__(self) -> RetraceSentry:
+        try:
+            from jax._src import monitoring
+
+            monitoring.register_event_duration_secs_listener(
+                self._state._on_event
+            )
+            self._registered = True
+        except Exception:
+            self._state.supported = False
+        self._state._active = True
+        return self._state
+
+    def __exit__(self, *exc) -> None:
+        self._state._active = False
+        if self._registered:
+            try:
+                from jax._src import monitoring
+
+                monitoring._unregister_event_duration_listener_by_callback(
+                    self._state._on_event
+                )
+            except Exception:
+                # leaking one inert listener (guarded by _active=False)
+                # beats crashing the caller's exit path
+                pass
+            self._registered = False
+
+
+def assert_no_recompiles(fn, *args, label: str = "", **kwargs):
+    """Run ``fn`` under a sentry; raise if it compiled anything.
+
+    The one-liner for tests: first call ``fn`` once OUTSIDE this helper to
+    warm its caches, then assert steady state with it."""
+    with retrace_sentry(label) as sentry:
+        out = fn(*args, **kwargs)
+    if sentry.compiles:
+        raise AssertionError(
+            f"steady-state region {label or fn!r} compiled "
+            f"{sentry.compiles} XLA program(s); expected 0 — {sentry.report()}"
+        )
+    return out

@@ -271,7 +271,9 @@ def main():
         )
         return out
 
-    parts = jax.random.normal(jax.random.PRNGKey(0), (n, d), dtype=jnp.float32)
+    from dist_svgd_tpu.utils.rng import init_particles
+
+    parts = init_particles(0, n, d, dtype=jnp.float32)
     out = run_once(parts)
     np.asarray(out)[0, 0]  # compile + fence, untimed
     best = float("inf")

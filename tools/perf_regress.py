@@ -30,6 +30,14 @@ A/B timing protocol those notes derived:
   noisy row (relative MAD above the tol) self-documents its spread instead
   of flapping.  Legacy single-value incumbents seed a 1-point window.
 
+- **retrace sentry (round 9)** — the timed rounds and the serving window
+  both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
+  warm-up pass, ANY XLA compilation inside a measurement window is a
+  retrace bug (a shape that escaped the caches, a Python scalar baked into
+  a jaxpr) and an unconditional FAIL regardless of throughput — the
+  ``steady_state_recompiles`` row, plus ``sentry_compiles`` on the
+  ``serve_throughput`` row.
+
 Usage (on the TPU host)::
 
     python tools/perf_regress.py            # compare vs tools/perf_incumbents.json
@@ -294,19 +302,37 @@ def main():
         marginal = max(est - _TUNNEL_RT_S, 2e-3)
         reps[key] = max(2, min(512, round(args.target_s / marginal)))
 
-    # interleaved rounds: one fenced chained sample of EVERY bench per round
+    # interleaved rounds: one fenced chained sample of EVERY bench per round.
+    # The rounds run under the retrace sentry (tools/jaxlint): everything was
+    # compiled during the warm-up/sizing pass above, so ANY in-round compile
+    # is a retrace bug contaminating the timing — an unconditional FAIL, the
+    # same steady-state contract the serving row carries.
+    from tools.jaxlint.sentry import retrace_sentry
+
     best = {key: float("inf") for key in benches}
-    for _ in range(args.rounds):
-        for key, (run, _, _, _) in benches.items():
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(reps[key]):
-                out = run()
-            _fence(out)
-            best[key] = min(best[key], (time.perf_counter() - t0) / reps[key])
+    with retrace_sentry("perf_regress measurement rounds") as rounds_sentry:
+        for _ in range(args.rounds):
+            for key, (run, _, _, _) in benches.items():
+                t0 = time.perf_counter()
+                out = None
+                for _ in range(reps[key]):
+                    out = run()
+                _fence(out)
+                best[key] = min(best[key],
+                                (time.perf_counter() - t0) / reps[key])
 
     failures = 0
     results = {}
+    row = {"bench": "steady_state_recompiles",
+           "value": rounds_sentry.compiles,
+           "unit": "XLA compiles in the timed rounds",
+           "supported": rounds_sentry.supported}
+    if rounds_sentry.supported and rounds_sentry.compiles:
+        row["status"] = "FAIL"
+        failures += 1
+    else:
+        row["status"] = "PASS" if rounds_sentry.supported else "NO_SENTRY"
+    print(json.dumps(row), flush=True)
     for key, (_, to_value, unit, higher) in benches.items():
         value = to_value(best[key])
         row = {"bench": key, "value": round(value, 2), "unit": unit,
@@ -359,16 +385,32 @@ def main():
 
     serve_key = "serve_throughput"
     serve_best = None
+    # compile counters are summed over EVERY round, not read off the
+    # best-throughput one: an intermittent retrace in a discarded round is
+    # still a broken steady-state contract (the unconditional-FAIL rule)
+    serve_recompiles = 0
+    serve_sentry_compiles = 0
+    sentry_supported = True
     for _ in range(args.rounds):
         srow = serve_bench.run_bench(**SERVE_BENCH_KW)
+        serve_recompiles += srow["recompiles"]
+        sc = srow.get("sentry_compiles")
+        if sc is None:
+            sentry_supported = False
+        else:
+            serve_sentry_compiles += sc
         if serve_best is None or srow["value"] > serve_best["value"]:
             serve_best = srow
     row = {"bench": serve_key, "value": serve_best["value"],
            "unit": "requests/sec",
            "p50_ms": serve_best["p50_ms"], "p99_ms": serve_best["p99_ms"],
            "batch_occupancy_mean": serve_best["batch_occupancy_mean"],
-           "recompiles": serve_best["recompiles"]}
-    if serve_best["recompiles"]:
+           "recompiles": serve_recompiles,
+           "sentry_compiles": (serve_sentry_compiles if sentry_supported
+                               else None)}
+    if serve_recompiles or serve_sentry_compiles:
+        # bucket-cache misses OR any raw XLA compile the sentry saw in any
+        # round's timed window: either way the steady-state contract broke
         row["status"] = "FAIL"
         failures += 1
     else:

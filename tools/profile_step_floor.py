@@ -34,7 +34,7 @@ from jax import lax
 from dist_svgd_tpu.models.logreg import logreg_logp
 from dist_svgd_tpu.ops.kernels import RBF
 from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
-from dist_svgd_tpu.utils.rng import init_particles
+from dist_svgd_tpu.utils.rng import as_key, init_particles
 from dist_svgd_tpu.utils.datasets import load_benchmark
 
 
@@ -77,7 +77,7 @@ def main():
     batched_score = jax.vmap(
         jax.grad(logreg_logp, argnums=0), in_axes=(0, None)
     )
-    key = jax.random.PRNGKey(0)
+    key = as_key(0)
 
     bodies = {
         # pure scan floor: one elementwise op per iteration

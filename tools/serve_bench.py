@@ -15,7 +15,12 @@ distinction):
 
 The timed window excludes engine warm-up (every padding bucket pre-traced),
 so ``recompiles`` reports steady-state bucket-cache misses — the engine's
-contract is that this is 0.
+contract is that this is 0.  The window additionally runs under
+``tools/jaxlint``'s ``retrace_sentry``: ``sentry_compiles`` counts EVERY
+XLA compilation inside it, not just bucket-cache misses — the counter that
+caught the per-request-shape pad/slice compiles the bucket counter was
+blind to (docs/notes.md round 9).  Both must be 0;
+``perf_regress.py``'s ``serve_throughput`` row FAILs on either.
 
 In-process by default (engine + batcher, no network noise — the number
 ``perf_regress.py``'s ``serve_throughput`` incumbent gates); ``--url`` points
@@ -27,7 +32,7 @@ Output: one JSON row, e.g.::
      "rows_per_sec": 8641.5, "p50_ms": 3.1, "p99_ms": 9.8,
      "queue_wait_p50_ms": 1.2, "device_p50_ms": 1.7,
      "batch_occupancy_mean": 7.0, "requests_per_batch_mean": 5.2,
-     "recompiles": 0, "bucket_hit_rate": 1.0, "shed": 0,
+     "recompiles": 0, "sentry_compiles": 0, "bucket_hit_rate": 1.0, "shed": 0,
      "open_loop": {"rate_rps": 500, "achieved_rps": 499.1, "p50_ms": 2.9,
                    "p99_ms": 11.0, "shed": 0}, ...}
 """
@@ -233,6 +238,8 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
                    p99_ms=round(closed["p99_ms"], 3), shed=closed["shed"])
         return row
 
+    from tools.jaxlint.sentry import retrace_sentry
+
     engine.warmup()  # steady-state measurement: no compiles in the window
     misses_before = engine.stats()["bucket_misses"]
     batcher = MicroBatcher(
@@ -240,10 +247,12 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
         max_queue_rows=max_queue_rows,
     )
     try:
-        closed = closed_loop(batcher.submit, pool, clients, requests)
-        open_row = None
-        if open_rate > 0:
-            open_row = open_loop(batcher.submit, pool, open_rate, open_requests)
+        with retrace_sentry("serve_bench timed window") as sentry:
+            closed = closed_loop(batcher.submit, pool, clients, requests)
+            open_row = None
+            if open_rate > 0:
+                open_row = open_loop(batcher.submit, pool, open_rate,
+                                     open_requests)
     finally:
         batcher.close(drain=True)
     bstats = batcher.stats()
@@ -264,6 +273,9 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
         batch_occupancy_mean=round(bstats["batch_occupancy_mean"], 2),
         requests_per_batch_mean=round(bstats["requests_per_batch_mean"], 2),
         recompiles=estats["bucket_misses"] - misses_before,
+        # independent runtime counter: EVERY XLA compile in the window
+        # (bucket misses only see kernel-cache traffic)
+        sentry_compiles=sentry.compiles if sentry.supported else None,
         bucket_hit_rate=round(estats["bucket_hits"] / lookups, 4)
         if lookups else 1.0,
         # closed_loop's own count, NOT plus the batcher's _n_shed — the

@@ -63,7 +63,8 @@ K = 50
 y = jnp.asarray(rng.normal(size=(k, d)), dtype=jnp.float32)
 s = jnp.asarray(rng.normal(size=(m, d)), dtype=jnp.float32)
 for name, fn in [("xla", xla_phi), ("pallas", phi_pallas)]:
-    chained = jax.jit(
+    # an A/B check compiles once per backend variant by design (2 iterations)
+    chained = jax.jit(  # jaxlint: disable=JL001
         lambda p, fn=fn: jax.lax.scan(
             lambda c, _: (c + 1e-6 * fn(c, c, s), None), p, None, length=K
         )[0]
