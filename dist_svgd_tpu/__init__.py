@@ -20,6 +20,12 @@ implementation `Sandy4321/dist-svgd` (see SURVEY.md):
                      resume, retry/backoff, numerical guards, and a
                      deterministic fault-injection harness (import
                      `dist_svgd_tpu.resilience` explicitly)
+- `telemetry`      — unified observability: thread-safe metrics registry
+                     (counters/gauges/histograms, Prometheus exposition)
+                     + span tracer (nestable thread-aware spans, Chrome
+                     trace / JSONL export, zero-cost while disabled);
+                     train, resilience, and serving are instrumented with
+                     it (import `dist_svgd_tpu.telemetry` explicitly)
 - `utils`          — datasets, history recording, RNG helpers
 
 Where the reference evaluates k(x, y) and its autograd one particle-pair at a

@@ -50,6 +50,7 @@ from dist_svgd_tpu.parallel.exchange import (
     make_shard_step_sinkhorn_w2,
 )
 from dist_svgd_tpu.parallel.mesh import AXIS, bind_shard_fn, make_mesh
+from dist_svgd_tpu.telemetry import trace as _trace
 from dist_svgd_tpu.utils.rng import minibatch_key
 
 
@@ -973,7 +974,10 @@ class DistSampler:
                         num_steps, step_size, h, rc, time_dispatches, None,
                         "record_chunks",
                     )
-            out = self._run_steps_scan(num_steps, step_size, record, h)
+            with _trace.span("train.step_chunk",
+                             {"steps": num_steps, "execution": "monolithic"}
+                             if _trace.enabled() else None):
+                out = self._run_steps_scan(num_steps, step_size, record, h)
             self.last_run_stats = self._stats(
                 "monolithic", num_steps, 1, None)
             return out
@@ -996,7 +1000,10 @@ class DistSampler:
                         num_steps, step_size, h, rc, time_dispatches,
                         dispatch_budget, "record_chunks",
                     )
-            out = self._run_steps_scan(num_steps, step_size, record, h)
+            with _trace.span("train.step_chunk",
+                             {"steps": num_steps, "execution": "monolithic"}
+                             if _trace.enabled() else None):
+                out = self._run_steps_scan(num_steps, step_size, record, h)
             self.last_run_stats = self._stats(
                 "monolithic", num_steps, 1, None,
                 dispatch_budget_s=dispatch_budget)
@@ -1091,22 +1098,33 @@ class DistSampler:
         return {"execution": "intra_step", "hops_per_dispatch": hpd,
                 "max_passes_per_dispatch": max_passes}
 
-    def _dispatch_runner(self, time_dispatches: bool):
+    def _dispatch_runner(self, time_dispatches: bool,
+                         span_name: str = "train.dispatch"):
         """Dispatch-counting (and optionally fencing/timing) wrapper used by
-        every chunked execution path."""
+        every chunked execution path.  While the span tracer is enabled every
+        dispatch records a ``train.dispatch`` span tagged with the dispatched
+        program (scan chunk, ring-hop chunk, Sinkhorn dual advance, ...) —
+        unfenced unless ``time_dispatches`` already fences, so chained
+        dispatches keep pipelining and the span honestly shows *dispatch*
+        latency in that mode (the tag says which)."""
         import time as _time
 
         rec = {"count": 0, "max_wall": None}
 
         def run(fn, *args):
-            t0 = _time.perf_counter() if time_dispatches else None
-            out = fn(*args)
-            rec["count"] += 1
-            if time_dispatches:
-                jax.block_until_ready(out)
-                wall = _time.perf_counter() - t0
-                rec["max_wall"] = (wall if rec["max_wall"] is None
-                                   else max(rec["max_wall"], wall))
+            tags = None
+            if _trace.enabled():
+                tags = {"fn": getattr(fn, "__name__", type(fn).__name__),
+                        "fenced": bool(time_dispatches)}
+            with _trace.span(span_name, tags):
+                t0 = _time.perf_counter() if time_dispatches else None
+                out = fn(*args)
+                rec["count"] += 1
+                if time_dispatches:
+                    jax.block_until_ready(out)
+                    wall = _time.perf_counter() - t0
+                    rec["max_wall"] = (wall if rec["max_wall"] is None
+                                       else max(rec["max_wall"], wall))
             return out
 
         return run, rec
@@ -1150,7 +1168,7 @@ class DistSampler:
         the logreg driver's round-5 overlap pattern, now built in).  The
         returned history is a host ``np.ndarray``: keeping it on device
         would defeat the budget the chunking enforces."""
-        run, rec = self._dispatch_runner(time_dispatches)
+        run, rec = self._dispatch_runner(time_dispatches, "train.step_chunk")
         hists = []
         pending = None
         for k in _chunk_sizes(num_steps, steps_per_dispatch):
@@ -1184,7 +1202,7 @@ class DistSampler:
                 min(steps_per_dispatch, self._record_chunk()),
                 time_dispatches, budget, "scan_chunks",
             )
-        run, rec = self._dispatch_runner(time_dispatches)
+        run, rec = self._dispatch_runner(time_dispatches, "train.step_chunk")
         for k in _chunk_sizes(num_steps, steps_per_dispatch):
             run(self._run_steps_scan, k, step_size, record, h)
         self.last_run_stats = self._stats(

@@ -45,6 +45,8 @@ import numpy as np
 
 from dist_svgd_tpu.resilience.faults import FaultPlan, TransientDispatchError
 from dist_svgd_tpu.resilience.guards import GuardConfig, GuardViolation, check_state
+from dist_svgd_tpu.telemetry import metrics as _metrics
+from dist_svgd_tpu.telemetry import trace as _trace
 from dist_svgd_tpu.utils.checkpoint import CheckpointManager
 
 
@@ -240,6 +242,14 @@ class RunSupervisor:
         slow_segment_warn_s: log a ``slow_segment`` warning record when a
             segment's wall exceeds this (the watchdog surface the
             ``SlowSegmentAt`` fault exercises).
+        registry: ``telemetry.MetricsRegistry`` for the supervisor's
+            restart/guard/checkpoint counters and the segment/checkpoint
+            duration histograms (default: the process-wide registry).
+            While the span tracer is enabled each segment and checkpoint
+            additionally records a ``train.segment`` / ``train.checkpoint``
+            span, with retries, guard trips, rollbacks, and preemptions as
+            instant events — the training half of the serving path's
+            request-span story.
     """
 
     def __init__(
@@ -260,6 +270,7 @@ class RunSupervisor:
         clock: Callable[[], float] = time.perf_counter,
         sleep: Callable[[float], None] = time.sleep,
         slow_segment_warn_s: Optional[float] = None,
+        registry: Optional[_metrics.MetricsRegistry] = None,
         n: Optional[int] = None,
         seed=0,
         initial_particles=None,
@@ -316,6 +327,22 @@ class RunSupervisor:
         self._max_seg_wall_s = 0.0
         self._n_checkpoints = 0
         self._n_segments = 0
+        reg = registry if registry is not None else _metrics.default_registry()
+        self.registry = reg
+        self._m_restarts = reg.counter(
+            "svgd_train_restarts_total",
+            "restart budget spent, by kind (transient retry / guard trip)")
+        self._m_guard_trips = reg.counter(
+            "svgd_train_guard_trips_total",
+            "numerical guard violations (NaN/Inf, explosion, divergence)")
+        self._m_checkpoints = reg.counter(
+            "svgd_train_checkpoints_total", "checkpoints written, by tag")
+        self._m_ckpt_seconds = reg.histogram(
+            "svgd_train_checkpoint_seconds", "wall per checkpoint save")
+        self._m_seg_seconds = reg.histogram(
+            "svgd_train_segment_seconds", "wall per training segment")
+        self._m_steps = reg.counter(
+            "svgd_train_steps_total", "SVGD steps completed under supervision")
         #: Report of the most recent :meth:`run` call.
         self.report: Optional[dict] = None
 
@@ -394,11 +421,14 @@ class RunSupervisor:
         if self._manager is None:
             return None
         t0 = self._clock()
-        state = self._state_with_meta()
-        path = self._manager.save(self._harness.t, state)
+        with _trace.span("train.checkpoint", {"tag": tag, "t": self._harness.t}):
+            state = self._state_with_meta()
+            path = self._manager.save(self._harness.t, state)
         wall = self._clock() - t0
         self._ckpt_wall_s += wall
         self._n_checkpoints += 1
+        self._m_checkpoints.inc(tag=tag)
+        self._m_ckpt_seconds.observe(wall)
         self._last_good = (self._harness.t, state)
         self._log(event="checkpoint", tag=tag, t=self._harness.t,
                   wall_s=round(wall, 4), path=path)
@@ -410,6 +440,7 @@ class RunSupervisor:
         t_bad = self._harness.t
         t_good, state = self._last_good
         self._harness.load_state_dict(state)
+        _trace.instant("train.rollback", {"from_t": t_bad, "to_t": t_good})
         self._log(event="rollback", from_t=t_bad, to_t=t_good)
 
     def _spend_restart(self, err: BaseException) -> None:
@@ -427,7 +458,11 @@ class RunSupervisor:
 
     def _handle_transient(self, err: Exception) -> None:
         self._spend_restart(err)
+        self._m_restarts.inc(kind="transient")
         delay = self._retry.delay_s(self._consecutive_failures)
+        _trace.instant("train.retry", {"t": self._harness.t,
+                                       "error": type(err).__name__,
+                                       "attempt": self._consecutive_failures})
         self._log(event="retry", t=self._harness.t,
                   error=f"{type(err).__name__}: {err}",
                   attempt=self._consecutive_failures,
@@ -437,9 +472,13 @@ class RunSupervisor:
 
     def _handle_guard(self, err: GuardViolation) -> None:
         self._spend_restart(err)
+        self._m_restarts.inc(kind="guard")
+        self._m_guard_trips.inc()
         old_eps = self.step_size
         backoff = self._guard.backoff_factor if self._guard else 0.5
         self.step_size = old_eps * backoff
+        _trace.instant("train.guard_violation",
+                       {"t": self._harness.t, "reason": err.reason})
         self._log(event="guard_violation", t=self._harness.t,
                   reason=err.reason, **err.report,
                   step_size=old_eps, new_step_size=self.step_size)
@@ -523,11 +562,15 @@ class RunSupervisor:
                     self._faults.fire_due(self)
                 if self._stop_requested:
                     continue  # loop top checkpoints and reports preempted
-                self._harness.run_segment(k, self.step_size)
-                # fence inside the try: async dispatch failures must surface
-                # here (as retryable JaxRuntimeError), not at a random later
-                # host sync — and the segment wall must be honest
-                jax.block_until_ready(self._harness.particles)
+                with _trace.span("train.segment",
+                                 {"t0": t0, "steps": k,
+                                  "kind": self._harness.kind}):
+                    self._harness.run_segment(k, self.step_size)
+                    # fence inside the try (and the span): async dispatch
+                    # failures must surface here (as retryable
+                    # JaxRuntimeError), not at a random later host sync —
+                    # and the segment wall must be honest
+                    jax.block_until_ready(self._harness.particles)
             except self._retry.retryable as e:
                 self._handle_transient(e)
                 continue
@@ -535,6 +578,11 @@ class RunSupervisor:
             self._seg_wall_s += seg_wall
             self._max_seg_wall_s = max(self._max_seg_wall_s, seg_wall)
             self._n_segments += 1
+            # the histogram mirrors _n_segments (a guard-tripped segment
+            # still burned this wall); the steps counter must NOT mirror it
+            # — rolled-back steps are not progress, so it increments only
+            # after the guard admits the segment (below)
+            self._m_seg_seconds.observe(seg_wall)
             if self._slow_warn is not None and seg_wall > self._slow_warn:
                 self._log(event="slow_segment", t=self._harness.t,
                           wall_s=round(seg_wall, 4),
@@ -547,6 +595,7 @@ class RunSupervisor:
                     self._handle_guard(e)
                     continue
             self._consecutive_failures = 0
+            self._m_steps.inc(k)
             self._log(event="segment", t=self._harness.t, steps=k,
                       wall_s=round(seg_wall, 4), step_size=self.step_size)
             if self._manager is not None and (
@@ -558,6 +607,8 @@ class RunSupervisor:
             # signal-triggered checkpoint: the whole point of catching the
             # preemption notice is saving right now, not at the cadence
             self._checkpoint(tag="preempt")
+            _trace.instant("train.preempt", {"t": self._harness.t,
+                                             "reason": self._stop_reason})
             self._log(event="preempted", t=self._harness.t,
                       reason=self._stop_reason)
 

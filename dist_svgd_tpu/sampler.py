@@ -24,6 +24,7 @@ from jax import lax
 
 from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.svgd import svgd_step_sequential
+from dist_svgd_tpu.telemetry import trace as _trace
 from dist_svgd_tpu.utils.history import history_to_dataframe
 from dist_svgd_tpu.utils.rng import as_key, draw_minibatch, init_particles, minibatch_key
 
@@ -352,8 +353,11 @@ class Sampler:
             )
         if steps_per_dispatch >= num_iter:
             run = self._run_fn(num_iter, record)
-            final, hist = run(particles, eps, bkey,
-                              jnp.asarray(step_offset, jnp.int32))
+            with _trace.span("train.step_chunk",
+                             {"steps": num_iter, "execution": "monolithic"}
+                             if _trace.enabled() else None):
+                final, hist = run(particles, eps, bkey,
+                                  jnp.asarray(step_offset, jnp.int32))
             self.last_run_stats = {
                 "execution": "monolithic", "num_steps": num_iter,
                 "num_dispatches": 1,
@@ -375,8 +379,13 @@ class Sampler:
         sizes = _chunk_sizes(num_iter, steps_per_dispatch)
         for csize in sizes:  # ≤ 2 distinct sizes → ≤ 2 compiled programs
             run = self._run_fn(csize, record)
-            final, hist = run(final, eps, bkey,
-                              jnp.asarray(step_offset + done, jnp.int32))
+            # unfenced span: chained chunk dispatches keep pipelining, so
+            # the span shows dispatch latency (the trailing host concat
+            # carries the execution wall)
+            with _trace.span("train.step_chunk", {"steps": csize}
+                             if _trace.enabled() else None):
+                final, hist = run(final, eps, bkey,
+                                  jnp.asarray(step_offset + done, jnp.int32))
             if record:
                 if pending is not None:
                     hists.append(np.asarray(pending))

@@ -21,10 +21,21 @@ Time is injectable (``clock`` + ``wait``) so tests drive ``max_wait_ms``
 expiry deterministically instead of real-sleeping (tier-1 has no
 multi-hundred-ms waits); production uses ``time.monotonic`` and plain
 condition waits.
+
+Telemetry (round 10): every batcher writes process-wide counters, the
+queue-depth gauge, and latency histograms into the shared
+``telemetry.MetricsRegistry`` (``registry=`` for an isolated one — benches
+and tests), and, while the span tracer is enabled, emits one **request lane
+tree** per completed request — ``serve.request`` with ``serve.queue_wait`` /
+``serve.coalesce`` / ``serve.dispatch`` children, tagged with rows and batch
+occupancy — the "where did this slow request spend its time" view.
+:meth:`stats` keeps its per-instance bounded-window semantics (the registry
+aggregates across instances and over the process lifetime).
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -32,6 +43,16 @@ from concurrent.futures import CancelledError, Future
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+from dist_svgd_tpu.telemetry import metrics as _metrics
+from dist_svgd_tpu.telemetry import trace as _trace
+
+#: Batch-occupancy buckets (rows per dispatched batch): powers of two up to
+#: the queue bound's usual order of magnitude.
+_BATCH_ROW_BUCKETS = tuple(float(1 << i) for i in range(14))
+
+#: Per-process batcher ids for the instance-labelled gauge series.
+_INSTANCE_IDS = itertools.count()
 
 
 class Overloaded(RuntimeError):
@@ -43,15 +64,25 @@ def _default_wait(cond: threading.Condition, timeout: Optional[float]) -> bool:
 
 
 class _Request:
-    """One client submit(): a future plus chunk-reassembly state."""
+    """One client submit(): a future plus chunk-reassembly state.
 
-    __slots__ = ("future", "n_chunks", "parts", "enqueued")
+    ``trace_enq`` is the tracer-clock enqueue timestamp and ``trace_src``
+    the tracer it was read from (both None while tracing is disabled) — the
+    batcher clock is injectable and test-faked, so the span timeline keeps
+    its own honest clock, and a disable()/enable() cycle mid-flight resets
+    the epoch, so a timestamp is only meaningful against the same tracer."""
 
-    def __init__(self, n_chunks: int, enqueued: float):
+    __slots__ = ("future", "n_chunks", "parts", "enqueued", "trace_enq",
+                 "trace_src")
+
+    def __init__(self, n_chunks: int, enqueued: float,
+                 trace_enq: Optional[float] = None, trace_src=None):
         self.future: Future = Future()
         self.n_chunks = n_chunks
         self.parts: List[Optional[Dict[str, np.ndarray]]] = [None] * n_chunks
         self.enqueued = enqueued
+        self.trace_enq = trace_enq
+        self.trace_src = trace_src
 
 
 class _Chunk:
@@ -89,6 +120,9 @@ class MicroBatcher:
             ``cond.wait`` (held lock, returns after notify or timeout).
         logger: optional ``JsonlLogger``; one record per dispatched batch
             (rows, request count, queue-wait vs device-time split).
+        registry: ``telemetry.MetricsRegistry`` to write counters / the
+            queue-depth gauge / latency histograms into (default: the
+            process-wide :func:`~dist_svgd_tpu.telemetry.default_registry`).
         autostart: start the worker thread immediately.  Tests that need a
             deterministic pre-filled queue pass False, submit, then
             :meth:`start`.
@@ -104,6 +138,7 @@ class MicroBatcher:
         clock: Callable[[], float] = time.monotonic,
         wait: Callable[[threading.Condition, Optional[float]], bool] = _default_wait,
         logger=None,
+        registry: Optional[_metrics.MetricsRegistry] = None,
         autostart: bool = True,
     ):
         if max_batch < 1:
@@ -137,6 +172,42 @@ class MicroBatcher:
         self._device_ms: deque = deque(maxlen=4096)  # per batch
         self._latency_ms: deque = deque(maxlen=8192)  # per request, end to end
 
+        # process-wide telemetry (shared registry; get-or-create, so several
+        # batchers aggregate into the same counter/histogram series — the
+        # Prometheus convention.  The queue-depth GAUGE is last-write-wins
+        # and so carries a per-instance label: two batchers on one registry
+        # must not overwrite each other's depth)
+        reg = registry if registry is not None else _metrics.default_registry()
+        self.registry = reg
+        #: This batcher's ``batcher=`` label value on per-instance series
+        #: (the queue-depth gauge).
+        self.metrics_instance = f"b{next(_INSTANCE_IDS)}"
+        self._m_requests = reg.counter(
+            "svgd_serve_requests_total", "requests fully resolved")
+        self._m_rows = reg.counter(
+            "svgd_serve_rows_total", "rows dispatched in resolved requests")
+        self._m_batches = reg.counter(
+            "svgd_serve_batches_total", "coalesced batches dispatched")
+        self._m_shed = reg.counter(
+            "svgd_serve_shed_total",
+            "requests shed with Overloaded (bounded queue full)")
+        self._m_errors = reg.counter(
+            "svgd_serve_dispatch_errors_total", "batch dispatch exceptions")
+        self._m_queue_depth = reg.gauge(
+            "svgd_serve_queue_depth_rows", "rows queued, not yet dispatched")
+        self._m_latency = reg.histogram(
+            "svgd_serve_request_latency_seconds",
+            "request end-to-end latency (enqueue to resolve)")
+        self._m_queue_wait = reg.histogram(
+            "svgd_serve_queue_wait_seconds",
+            "oldest-request coalescing wait per batch")
+        self._m_device = reg.histogram(
+            "svgd_serve_device_time_seconds",
+            "dispatch wall (device + fetch) per batch")
+        self._m_batch_rows = reg.histogram(
+            "svgd_serve_batch_rows", "rows per dispatched batch",
+            buckets=_BATCH_ROW_BUCKETS)
+
         self._thread: Optional[threading.Thread] = None
         if autostart:
             self.start()
@@ -156,22 +227,28 @@ class MicroBatcher:
         if x.ndim != 2 or x.shape[0] == 0:
             raise ValueError(f"expected a non-empty (rows, features) array, got {x.shape}")
         rows = x.shape[0]
+        tracer = _trace.get_tracer()
         with self._cond:
             if not self._open:
                 raise RuntimeError("batcher is closed")
             if self._queued_rows + rows > self.max_queue_rows:
                 self._n_shed += 1
+                self._m_shed.inc()
                 raise Overloaded(
                     f"queue full ({self._queued_rows} rows queued, request "
                     f"of {rows} would exceed max_queue_rows="
                     f"{self.max_queue_rows}); retry with backoff"
                 )
             n_chunks = -(-rows // self.max_batch)
-            req = _Request(n_chunks, self._clock())
+            req = _Request(n_chunks, self._clock(),
+                           tracer.now() if tracer is not None else None,
+                           tracer)
             for i in range(n_chunks):
                 chunk = x[i * self.max_batch : (i + 1) * self.max_batch]
                 self._queue.append(_Chunk(chunk, req, i))
             self._queued_rows += rows
+            self._m_queue_depth.set(self._queued_rows,
+                                    batcher=self.metrics_instance)
             self._cond.notify_all()
             return req.future
 
@@ -209,21 +286,29 @@ class MicroBatcher:
                     batch.append(chunk)
                     rows += chunk.x.shape[0]
                 self._queued_rows -= rows
+                self._m_queue_depth.set(self._queued_rows,
+                                        batcher=self.metrics_instance)
                 return batch
 
     def _run_batch(self, batch: List[_Chunk]) -> None:
         rows = sum(c.x.shape[0] for c in batch)
+        tracer = _trace.get_tracer()
         t0 = self._clock()
+        t_pop = tracer.now() if tracer is not None else 0.0
         queue_wait_ms = (t0 - min(c.req.enqueued for c in batch)) * 1e3
+        x = np.concatenate([c.x for c in batch], axis=0)
+        t_disp0 = tracer.now() if tracer is not None else 0.0
         try:
-            out = self._dispatch(np.concatenate([c.x for c in batch], axis=0))
+            out = self._dispatch(x)
         except Exception as e:
             with self._cond:
                 self._n_errors += 1
+            self._m_errors.inc()
             for c in batch:
                 if not c.req.future.done():
                     c.req.future.set_exception(e)
             return
+        t_disp1 = tracer.now() if tracer is not None else 0.0
         device_ms = (self._clock() - t0) * 1e3
         done_requests = []
         offset = 0
@@ -240,10 +325,46 @@ class MicroBatcher:
             self._requests_per_batch.append(len(batch))
             self._queue_wait_ms.append(queue_wait_ms)
             self._device_ms.append(device_ms)
+            latencies = []
             for req in done_requests:
                 self._n_requests += 1
-                self._n_rows += sum(p[next(iter(p))].shape[0] for p in req.parts)
-                self._latency_ms.append((now - req.enqueued) * 1e3)
+                n_rows = sum(p[next(iter(p))].shape[0] for p in req.parts)
+                self._n_rows += n_rows
+                lat_ms = (now - req.enqueued) * 1e3
+                self._latency_ms.append(lat_ms)
+                latencies.append((req, n_rows, lat_ms))
+        self._m_batches.inc()
+        self._m_batch_rows.observe(rows)
+        self._m_queue_wait.observe(queue_wait_ms / 1e3)
+        self._m_device.observe(device_ms / 1e3)
+        for req, n_rows, lat_ms in latencies:
+            self._m_requests.inc()
+            self._m_rows.inc(n_rows)
+            self._m_latency.observe(lat_ms / 1e3)
+        if tracer is not None:
+            # one lane tree per completed request: the cross-thread
+            # enqueue→reply lifetime with the queue-wait / coalesce /
+            # dispatch split of its final batch (a split oversize request
+            # reports the batch that completed it; n_chunks tags that)
+            t_reply = tracer.now()
+            for req, n_rows, _lat in latencies:
+                # only trust an enqueue stamp from THIS tracer: a request
+                # submitted under an earlier (since-disabled) tracer carries
+                # another epoch's timestamp
+                enq = (req.trace_enq
+                       if req.trace_src is tracer and req.trace_enq is not None
+                       else t_pop)
+                tracer.lane_tree(
+                    "serve.request", enq, t_reply,
+                    {"rows": n_rows, "n_chunks": req.n_chunks,
+                     "batch_rows": rows, "batch_requests": len(batch)},
+                    children=[
+                        ("serve.queue_wait", enq, t_pop, None),
+                        ("serve.coalesce", t_pop, t_disp0,
+                         {"requests": len(batch), "rows": rows}),
+                        ("serve.dispatch", t_disp0, t_disp1, {"rows": rows}),
+                    ],
+                )
         if self._logger is not None:
             self._logger.log(
                 event="batch",
@@ -252,7 +373,7 @@ class MicroBatcher:
                 queue_wait_ms=round(queue_wait_ms, 3),
                 device_ms=round(device_ms, 3),
             )
-        for req in done_requests:
+        for req, _rows, _lat in latencies:
             keys = req.parts[0].keys()
             result = {
                 k: np.concatenate([p[k] for p in req.parts], axis=0) for k in keys

@@ -38,6 +38,8 @@ import numpy as np
 
 from dist_svgd_tpu.models import bnn as bnn_model
 from dist_svgd_tpu.models.logreg import posterior_predictive_prob
+from dist_svgd_tpu.telemetry import metrics as _metrics
+from dist_svgd_tpu.telemetry import trace as _trace
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
@@ -82,6 +84,9 @@ class PredictiveEngine:
             bucket).  Requests larger than the rounded ``max_bucket`` are
             rejected — the batcher splits oversize requests *before* the
             engine sees them.
+        registry: ``telemetry.MetricsRegistry`` for the compile-cache
+            hit/miss/reload counters (default: the process-wide registry).
+            :meth:`stats` keeps per-instance counts alongside.
     """
 
     def __init__(
@@ -96,6 +101,7 @@ class PredictiveEngine:
         kde_bandwidth: float = 1.0,
         min_bucket: int = 8,
         max_bucket: int = 4096,
+        registry: Optional[_metrics.MetricsRegistry] = None,
     ):
         if model not in MODELS:
             raise ValueError(f"unknown model {model!r}; expected one of {MODELS}")
@@ -151,6 +157,15 @@ class PredictiveEngine:
         self._hits = 0
         self._misses = 0
         self._reloads = 0
+        reg = registry if registry is not None else _metrics.default_registry()
+        self.registry = reg
+        self._m_hits = reg.counter(
+            "svgd_engine_bucket_hits_total", "padding-bucket kernel-cache hits")
+        self._m_misses = reg.counter(
+            "svgd_engine_bucket_misses_total",
+            "padding-bucket kernel-cache misses (one XLA trace each)")
+        self._m_reloads = reg.counter(
+            "svgd_engine_reloads_total", "hot ensemble swaps")
         self._ensemble_tag: Optional[str] = None
         #: Manager-root step this ensemble was cold-started from (set by
         #: :meth:`from_checkpoint`; ``None`` for direct/array construction).
@@ -280,10 +295,15 @@ class PredictiveEngine:
             fn = self._kernels.get(bucket)
             if fn is None:
                 self._misses += 1
+                miss = True
                 fn = self._kernels[bucket] = self._build_kernel(self._particles)
             else:
                 self._hits += 1
-            return fn, self._particles.dtype
+                miss = False
+            dtype = self._particles.dtype
+        # registry write outside the engine lock (its own lock suffices)
+        (self._m_misses if miss else self._m_hits).inc()
+        return fn, dtype
 
     # ------------------------------------------------------------------ #
     # serving
@@ -308,22 +328,30 @@ class PredictiveEngine:
                 "split it upstream (MicroBatcher max_batch does this)"
             )
         bucket = bucket_for(b, self.min_bucket)
-        fn, dtype = self._kernel_for(bucket)
-        if bucket != b:
-            # pad on HOST: a device-side jnp.concatenate compiles one XLA
-            # program per distinct (b, bucket) pair — steady-state traffic
-            # with mixed request sizes recompiles forever while the bucket
-            # cache reports all hits (caught by jaxlint's retrace_sentry,
-            # docs/notes.md round 9).  Host padding keeps the device seeing
-            # only bucket shapes.
-            xp = np.zeros((bucket, x.shape[1]), dtype=x.dtype)
-            xp[:b] = x
-            x = xp
-        out = fn(jnp.asarray(x, dtype=dtype))
-        # slice AFTER the host fetch: a device-array v[:b] is a compiled
-        # slice program per (bucket, b) shape pair — same silent-retrace
-        # class as the pad above
-        return {k: np.asarray(v)[:b] for k, v in out.items()}
+        traced = _trace.enabled()
+        with _trace.span("engine.predict",
+                         {"rows": b, "bucket": bucket, "model": self.model}
+                         if traced else None):
+            fn, dtype = self._kernel_for(bucket)
+            if bucket != b:
+                # pad on HOST: a device-side jnp.concatenate compiles one XLA
+                # program per distinct (b, bucket) pair — steady-state traffic
+                # with mixed request sizes recompiles forever while the bucket
+                # cache reports all hits (caught by jaxlint's retrace_sentry,
+                # docs/notes.md round 9).  Host padding keeps the device
+                # seeing only bucket shapes.
+                with _trace.span("engine.pad"):
+                    xp = np.zeros((bucket, x.shape[1]), dtype=x.dtype)
+                    xp[:b] = x
+                    x = xp
+            with _trace.span("engine.dispatch",
+                             {"bucket": bucket} if traced else None):
+                out = fn(jnp.asarray(x, dtype=dtype))
+                # slice AFTER the host fetch: a device-array v[:b] is a
+                # compiled slice program per (bucket, b) shape pair — same
+                # silent-retrace class as the pad above.  The fetch doubles
+                # as the span's device fence.
+                return {k: np.asarray(v)[:b] for k, v in out.items()}
 
     def warmup(self, batch_sizes: Optional[List[int]] = None) -> List[int]:
         """Pre-trace kernels so first requests don't pay XLA compiles.
@@ -397,6 +425,8 @@ class PredictiveEngine:
                     self._ensemble_tag = tag
                     break
                 buckets = missing
+        self._m_reloads.inc()
+        _trace.instant("engine.reload", {"tag": tag})
         return {"n_particles": int(particles.shape[0]),
                 "warmed_buckets": sorted(new_kernels), "tag": tag}
 

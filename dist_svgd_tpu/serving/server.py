@@ -1,13 +1,16 @@
 """Thin stdlib HTTP front end over the engine + batcher.
 
-JSON in/out, three routes:
+JSON in/out, four routes:
 
-- ``POST /predict``  — ``{"inputs": [[...], ...]}`` → the engine's output
-  dict as lists, plus this request's latency split;
-- ``GET  /healthz``  — liveness + ensemble identity;
-- ``GET  /metrics``  — the batcher's occupancy/latency aggregates (queue
-  wait vs device time, p50/p99), the engine's bucket-cache counters, and
-  the server's request/error counts.
+- ``POST /predict``      — ``{"inputs": [[...], ...]}`` → the engine's
+  output dict as lists, plus this request's latency split;
+- ``GET  /healthz``      — liveness + ensemble identity;
+- ``GET  /metrics``      — **Prometheus text exposition** of the shared
+  telemetry registry (request/row/batch/shed counters, queue-depth gauge,
+  latency histograms, engine bucket-cache counters — scrape it);
+- ``GET  /metrics.json`` — the legacy JSON aggregate (the batcher's
+  bounded-window percentiles, the engine's ``stats()``, the server's
+  request/error counts) for humans and tests.
 
 No framework dependency by design: the container bakes only the jax_graft
 toolchain, and the request path is one ``json.loads`` + a batcher future —
@@ -32,6 +35,8 @@ import numpy as np
 
 from dist_svgd_tpu.serving.batcher import MicroBatcher, Overloaded
 from dist_svgd_tpu.serving.engine import PredictiveEngine
+from dist_svgd_tpu.telemetry import metrics as _metrics
+from dist_svgd_tpu.telemetry import trace as _trace
 
 
 class PredictionServer:
@@ -54,20 +59,28 @@ class PredictionServer:
         request_timeout_s: float = 30.0,
         logger=None,
         batcher: Optional[MicroBatcher] = None,
+        registry: Optional[_metrics.MetricsRegistry] = None,
     ):
         self.engine = engine
+        self.registry = (registry if registry is not None
+                         else _metrics.default_registry())
         self.batcher = batcher or MicroBatcher(
             engine.predict,
             max_batch=max_batch,
             max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows,
             logger=None,  # batch records would interleave with request records
+            registry=self.registry,
         )
         self._logger = logger
         self._request_timeout_s = request_timeout_s
         self._lock = threading.Lock()
         self._requests = 0
         self._errors = 0
+        self._m_http = self.registry.counter(
+            "svgd_http_requests_total", "HTTP requests by route and status")
+        self._m_http_latency = self.registry.histogram(
+            "svgd_http_request_seconds", "handler wall per /predict request")
         self._started = time.time()
 
         server = self  # close over for the handler class
@@ -89,10 +102,25 @@ class PredictionServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, code: int, text: str,
+                            content_type: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/healthz":
                     self._reply(200, server.health())
                 elif self.path == "/metrics":
+                    # Prometheus text format 0.0.4 — what scrapers expect
+                    self._reply_text(
+                        200, server.registry.exposition(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/metrics.json":
                     self._reply(200, server.metrics())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
@@ -102,11 +130,13 @@ class PredictionServer:
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 t0 = time.perf_counter()
-                code, payload, rows = server._predict(self._read_body())
-                payload.setdefault(
-                    "latency_ms", round((time.perf_counter() - t0) * 1e3, 3)
-                )
+                with _trace.span("http.predict"):
+                    code, payload, rows = server._predict(self._read_body())
+                wall = time.perf_counter() - t0
+                payload.setdefault("latency_ms", round(wall * 1e3, 3))
                 self._reply(code, payload)
+                server._m_http.inc(route="/predict", status=code)
+                server._m_http_latency.observe(wall)
                 if server._logger is not None:
                     server._logger.log(
                         route="/predict",

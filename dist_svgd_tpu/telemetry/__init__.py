@@ -1,0 +1,59 @@
+"""Unified telemetry: span tracing + metrics registry.
+
+One layer, two complementary views of the same running system:
+
+- :mod:`~dist_svgd_tpu.telemetry.metrics` — thread-safe **registry** of
+  counters / gauges / histograms (fixed log-spaced latency buckets) with
+  Prometheus text exposition; the serving ``/metrics`` route serves it.
+- :mod:`~dist_svgd_tpu.telemetry.trace` — **span tracer**: nestable
+  thread-aware spans with optional device fencing, request lane trees,
+  XLA-compile instant events; zero-cost no-op while disabled; exports
+  Chrome trace-event JSON (Perfetto) and JSONL.  Summarise a trace with
+  ``tools/trace_report.py``.
+
+Quickstart (see README "Observability")::
+
+    from dist_svgd_tpu import telemetry
+
+    tracer = telemetry.enable()             # spans now record
+    ...serve / train...
+    telemetry.disable().export_chrome("trace.json")
+
+    print(telemetry.default_registry().exposition())   # Prometheus text
+"""
+
+from dist_svgd_tpu.telemetry.metrics import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from dist_svgd_tpu.telemetry.trace import (
+    SpanHandle,
+    Tracer,
+    disable,
+    enable,
+    enabled,
+    get_tracer,
+    instant,
+    span,
+)
+
+__all__ = [
+    "LATENCY_BUCKETS_S",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "SpanHandle",
+    "Tracer",
+    "disable",
+    "enable",
+    "enabled",
+    "get_tracer",
+    "instant",
+    "span",
+]

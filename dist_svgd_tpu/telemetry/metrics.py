@@ -1,0 +1,359 @@
+"""Thread-safe metrics registry: counters, gauges, histograms.
+
+The repo grew four half-connected observability substitutes (``JsonlLogger``,
+``StepTimer``, ``retrace_sentry``, the serving ``/metrics`` ad-hoc dicts) and
+none of them aggregates: every component keeps private counters behind its own
+lock with its own naming.  This registry is the one shared sink —
+
+- **Counter** — monotonically increasing totals (requests, sheds, restarts);
+- **Gauge** — last-write-wins instantaneous values (queue depth, particles);
+- **Histogram** — fixed **log-spaced** latency buckets (powers of two from
+  0.1 ms to ~26 s — :data:`LATENCY_BUCKETS_S`), cumulative-bucket semantics,
+  with quantile estimates by linear interpolation inside the crossing bucket
+  (the standard Prometheus ``histogram_quantile`` estimate: exact bucket
+  counts, approximate within-bucket position);
+
+all label-aware (``counter.inc(route="/predict", status=200)``), all guarded
+by ONE registry lock (the write path is a dict upsert — at serving rates the
+lock is uncontended; the exposition path snapshots under the lock and formats
+outside it, the same discipline as ``MicroBatcher.stats``).
+
+Exposition is Prometheus text format 0.0.4 (:meth:`MetricsRegistry.
+exposition`) — the serving server's ``/metrics`` serves it directly — plus a
+JSON-friendly :meth:`~MetricsRegistry.snapshot` for BENCH-style rows.
+
+A process-wide default registry (:func:`default_registry`) is what
+instrumented components write to when not handed an explicit one; tests and
+benches that need isolation construct their own ``MetricsRegistry()`` and
+pass it down.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+__all__ = [
+    "LATENCY_BUCKETS_S",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+]
+
+#: Fixed log-spaced latency buckets (seconds): powers of two from 0.1 ms up
+#: to ~26 s, 19 buckets.  One shared lattice for every latency histogram so
+#: cross-metric quantiles are comparable and exposition size is bounded.
+LATENCY_BUCKETS_S: Tuple[float, ...] = tuple(
+    1e-4 * 2.0 ** i for i in range(19)
+)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: dict) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_labels(key: _LabelKey, extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+    items = key + extra
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    """Shared name/help/lock plumbing.  Subclasses store per-label-set state
+    in ``_series`` and render themselves into exposition lines."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._series: Dict[_LabelKey, object] = {}
+
+    def _header(self) -> list:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape(self.help)}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        return lines
+
+
+class Counter(_Metric):
+    """Monotonic total.  ``inc(amount=1, **labels)``."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({amount})")
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0))
+
+    def _render(self) -> list:
+        with self._lock:
+            series = dict(self._series)
+        lines = self._header()
+        for key in sorted(series):
+            lines.append(
+                f"{self.name}{_format_labels(key)} {_format_value(series[key])}"
+            )
+        if not series:
+            lines.append(f"{self.name} 0")
+        return lines
+
+
+class Gauge(_Metric):
+    """Instantaneous value.  ``set(v, **labels)`` / ``inc`` / ``dec``."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+    def _render(self) -> list:
+        with self._lock:
+            series = dict(self._series)
+        lines = self._header()
+        for key in sorted(series):
+            lines.append(
+                f"{self.name}{_format_labels(key)} {_format_value(series[key])}"
+            )
+        if not series:
+            lines.append(f"{self.name} 0")
+        return lines
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets  # per-bucket (non-cumulative) counts
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram.  ``observe(value, **labels)``; quantiles by
+    interpolation inside the crossing bucket (:meth:`quantile`)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock,
+                 buckets: Optional[Iterable[float]] = None):
+        super().__init__(name, help, lock)
+        bounds = tuple(buckets) if buckets is not None else LATENCY_BUCKETS_S
+        if not bounds or any(b <= a for a, b in zip(bounds, bounds[1:])):
+            raise ValueError(
+                f"histogram {name} needs strictly increasing buckets, "
+                f"got {bounds}"
+            )
+        self.buckets = bounds  # upper bounds; +Inf is implicit
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = _HistSeries(len(self.buckets) + 1)
+            i = 0
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    break
+            else:
+                i = len(self.buckets)  # overflow (+Inf) bucket
+            series.counts[i] += 1
+            series.sum += value
+            series.count += 1
+
+    def _snapshot(self, labels: dict) -> Optional[_HistSeries]:
+        with self._lock:
+            series = self._series.get(_label_key(labels))
+            if series is None:
+                return None
+            out = _HistSeries(len(series.counts))
+            out.counts = list(series.counts)
+            out.sum = series.sum
+            out.count = series.count
+            return out
+
+    def quantile(self, q: float, **labels) -> float:
+        """Estimated ``q``-quantile (seconds for latency histograms): find
+        the bucket where the cumulative count crosses ``q·total``, linearly
+        interpolate inside it.  0.0 with no observations; the last finite
+        bound when the crossing lands in the overflow bucket."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        series = self._snapshot(labels)
+        if series is None or series.count == 0:
+            return 0.0
+        rank = q * series.count
+        cum = 0
+        for i, c in enumerate(series.counts):
+            prev_cum = cum
+            cum += c
+            if cum >= rank and c > 0:
+                if i >= len(self.buckets):  # overflow bucket: no upper bound
+                    return self.buckets[-1]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                frac = (rank - prev_cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return self.buckets[-1]
+
+    def summary(self, scale: float = 1.0, **labels) -> dict:
+        """``{count, sum, p50, p95, p99}`` (values × ``scale`` — pass 1e3
+        for milliseconds) for one label set — the BENCH-row form."""
+        series = self._snapshot(labels)
+        count = series.count if series else 0
+        return {
+            "count": count,
+            "sum": round((series.sum if series else 0.0) * scale, 4),
+            "p50": round(self.quantile(0.50, **labels) * scale, 4),
+            "p95": round(self.quantile(0.95, **labels) * scale, 4),
+            "p99": round(self.quantile(0.99, **labels) * scale, 4),
+        }
+
+    def _render(self) -> list:
+        with self._lock:
+            series = {k: (list(s.counts), s.sum, s.count)
+                      for k, s in self._series.items()}
+        lines = self._header()
+        for key in sorted(series):
+            counts, total, count = series[key]
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_format_labels(key, (('le', _format_value(bound)),))}"
+                    f" {cum}"
+                )
+            lines.append(
+                f"{self.name}_bucket{_format_labels(key, (('le', '+Inf'),))}"
+                f" {count}"
+            )
+            lines.append(
+                f"{self.name}_sum{_format_labels(key)} {_format_value(total)}"
+            )
+            lines.append(f"{self.name}_count{_format_labels(key)} {count}")
+        if not series:
+            lines.append(f"{self.name}_count 0")
+        return lines
+
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named metrics with one shared lock.
+
+    Re-requesting a name returns the existing metric (instrumented classes
+    can be constructed many times per process — a second ``MicroBatcher``
+    aggregates into the same counters, the Prometheus convention); asking
+    for the same name as a different metric kind raises.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs) -> _Metric:
+        if not _NAME_OK.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = self._metrics[name] = cls(name, help, self._lock,
+                                                   **kwargs)
+            elif type(metric) is not cls:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(metric).__name__}, requested {cls.__name__}"
+                )
+            return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def exposition(self) -> str:
+        """Prometheus text format 0.0.4; one block per metric, names sorted
+        (deterministic output — the golden test relies on it)."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines = []
+        for metric in metrics:
+            lines.extend(metric._render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        """JSON-friendly dump: counters/gauges as scalars (labelled series
+        keyed ``name{k="v"}``), histograms as their ms-scaled summaries."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out = {}
+        for name, metric in sorted(metrics.items()):
+            if isinstance(metric, Histogram):
+                with metric._lock:
+                    keys = list(metric._series)
+                for key in keys:
+                    label = name + _format_labels(key)
+                    out[label] = metric.summary(scale=1e3, **dict(key))
+            else:
+                with metric._lock:
+                    series = dict(metric._series)
+                for key, value in series.items():
+                    out[name + _format_labels(key)] = value
+        return out
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry instrumented components default to."""
+    return _DEFAULT

@@ -1,0 +1,418 @@
+"""Span tracer: nestable, thread-aware timing spans with device fencing.
+
+Where the metrics registry answers "how many / how fast on aggregate", the
+tracer answers **"where did this slow request / slow segment spend its
+time?"** — the Dapper-style causal view (Sigelman et al. 2010) the serving
+and resilience paths need to debug convergence-vs-throughput tradeoffs:
+
+- **Thread spans** (:func:`span`) — a context manager pushing onto a
+  per-thread stack, so nesting is implicit and free; the span may *fence* a
+  device value before stamping its end time (``sp.fence(out)`` →
+  ``jax.block_until_ready`` — the honest-wall discipline inherited from
+  ``utils/metrics.py:StepTimer``; an unfenced span around an async dispatch
+  measures dispatch latency, which is sometimes exactly what you want).
+- **Lane trees** (:meth:`Tracer.lane_tree`) — post-hoc span trees with
+  explicit timestamps for work whose lifetime crosses threads (a serving
+  request is enqueued by a handler thread and resolved by the batch worker).
+  Each tree lands on a synthetic "request lane" track chosen so spans on one
+  lane never overlap — Perfetto renders concurrent requests side by side.
+- **Instant events** (:func:`instant`) — point markers; while the tracer is
+  enabled it listens to ``jax.monitoring`` and records every XLA compilation
+  as an ``xla_compile`` instant *inside whatever span was active on the
+  compiling thread* (the runtime cousin of ``tools/jaxlint``'s
+  ``retrace_sentry`` — same event stream, but placed in causal context).
+
+**Zero-cost when disabled**: module-level :func:`span`/:func:`instant` check
+one global and return a shared no-op singleton — no allocation, no lock, no
+clock read (pinned by ``tests/test_telemetry.py`` with ``tracemalloc``).
+Enable with :func:`enable`, stop and export with :func:`disable`.
+
+Exporters: Chrome trace-event JSON (:meth:`Tracer.export_chrome` — load the
+file in Perfetto / ``chrome://tracing``; ``tools/trace_report.py``
+summarises it) and JSON-lines through the existing ``JsonlLogger`` (pass
+``jsonl=`` — one record per completed span, interleaving with the metric
+records the component already writes).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Tracer",
+    "SpanHandle",
+    "enable",
+    "disable",
+    "get_tracer",
+    "enabled",
+    "span",
+    "instant",
+]
+
+
+class _NoopSpan:
+    """Disabled-path singleton: every operation is a no-op returning fast.
+
+    ``__exit__`` takes the three positional exception args explicitly —
+    a ``*args`` signature would allocate a tuple per call, and this object
+    sits in hot loops of every instrumented component.
+    """
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def tag(self, **tags):
+        return self
+
+    def fence(self, value):
+        return value
+
+
+_NOOP = _NoopSpan()
+
+
+class SpanHandle:
+    """One live span (enabled path).  Created by :meth:`Tracer.span`."""
+
+    __slots__ = ("_tracer", "name", "tags", "_t0", "_fence")
+
+    def __init__(self, tracer: "Tracer", name: str, tags: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.tags = tags
+        self._t0 = 0.0
+        self._fence = None
+
+    def tag(self, **tags) -> "SpanHandle":
+        if self.tags is None:
+            self.tags = tags
+        else:
+            self.tags.update(tags)
+        return self
+
+    def fence(self, value):
+        """Register ``value`` for ``jax.block_until_ready`` at span exit —
+        the end timestamp then covers device execution, not just dispatch.
+        Returns ``value`` for inline use: ``out = sp.fence(fn(x))``."""
+        self._fence = value
+        return value
+
+    def __enter__(self) -> "SpanHandle":
+        tr = self._tracer
+        tr._stack().append(self)
+        self._t0 = tr.now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        tr = self._tracer
+        try:
+            if self._fence is not None:
+                import jax
+
+                jax.block_until_ready(self._fence)
+                self._fence = None
+        finally:
+            # record + pop even when the fence raises (a failed async
+            # dispatch surfaces at the fence): the span must not leak on
+            # the thread stack, and the trace should show the span that
+            # died
+            t1 = tr.now()
+            stack = tr._stack()
+            if stack and stack[-1] is self:
+                stack.pop()
+            if exc_type is not None:
+                self.tag(error=exc_type.__name__)
+            tr._complete(self.name, self._t0, t1, self.tags,
+                         threading.get_ident())
+        return False
+
+
+class Tracer:
+    """Collects span/instant events; thread-safe; bounded.
+
+    Args:
+        clock: monotonic seconds source (``time.perf_counter``); injectable
+            for deterministic tests.
+        max_events: hard cap on retained events — beyond it new events are
+            **dropped and counted** (``dropped_events``), never silently
+            grown: a day-long traced run must not OOM the host.
+        jsonl: optional ``utils/metrics.py:JsonlLogger`` (anything with a
+            ``log(**record)`` method) — one line per completed span/instant.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter,
+                 max_events: int = 1_000_000, jsonl=None):
+        if max_events < 1:
+            raise ValueError(f"max_events must be >= 1, got {max_events}")
+        self._clock = clock
+        self._t0 = clock()
+        self._max_events = int(max_events)
+        self._jsonl = jsonl
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._dropped = 0
+        self._lanes: List[float] = []  # per-lane last span end time
+        self._thread_names: Dict[int, str] = {}
+        self._tls = threading.local()
+        self._listener_registered = False
+
+    # ------------------------------------------------------------------ #
+    # recording
+
+    def now(self) -> float:
+        """Seconds since the tracer started (every event timestamp)."""
+        return self._clock() - self._t0
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def active_span(self) -> Optional[SpanHandle]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def span(self, name: str, tags: Optional[dict] = None) -> SpanHandle:
+        return SpanHandle(self, name, dict(tags) if tags else None)
+
+    def instant(self, name: str, tags: Optional[dict] = None) -> None:
+        parent = self.active_span()
+        if parent is not None:
+            tags = dict(tags) if tags else {}
+            tags["in_span"] = parent.name
+        self._append({
+            "ph": "i", "name": name, "ts": self.now(),
+            "tid": threading.get_ident(), "args": tags or None,
+        })
+
+    def complete(self, name: str, t0: float, t1: float,
+                 tags: Optional[dict] = None, tid=None) -> None:
+        """Record an already-timed span (timestamps from :meth:`now`) —
+        for callers that measured the interval themselves (``StepTimer``)."""
+        self._complete(name, t0, t1, tags,
+                       tid if tid is not None else threading.get_ident())
+
+    def _complete(self, name: str, t0: float, t1: float,
+                  tags: Optional[dict], tid) -> None:
+        self._append({
+            "ph": "X", "name": name, "ts": t0, "dur": max(t1 - t0, 0.0),
+            "tid": tid, "args": tags or None,
+        })
+
+    def _append(self, event: dict) -> None:
+        tid = event["tid"]
+        with self._lock:
+            if isinstance(tid, int) and tid not in self._thread_names:
+                cur = threading.current_thread()
+                self._thread_names[tid] = (
+                    cur.name if cur.ident == tid else f"thread-{tid}"
+                )
+            if len(self._events) >= self._max_events:
+                self._dropped += 1
+                return
+            self._events.append(event)
+        if self._jsonl is not None:
+            rec = {k: v for k, v in event.items() if v is not None}
+            rec["kind"] = "span" if event["ph"] == "X" else "instant"
+            try:
+                self._jsonl.log(**rec)
+            except ValueError:
+                pass  # logger closed mid-run: keep tracing in memory
+
+    def lane_tree(self, name: str, t0: float, t1: float,
+                  tags: Optional[dict] = None,
+                  children: Sequence[Tuple] = ()) -> None:
+        """Record a parent span plus children with **explicit timestamps**
+        (from :meth:`now`, captured by the caller as the work progressed)
+        on a synthetic lane track.  Lanes are allocated first-fit by
+        start time so spans within one lane never overlap — the Chrome
+        viewer then nests each tree unambiguously even when many trees
+        (concurrent requests) overlap in wall time.
+
+        ``children``: ``(name, t0, t1)`` or ``(name, t0, t1, tags)`` tuples,
+        each clamped inside the parent interval.
+        """
+        if t1 < t0:
+            t0, t1 = t1, t0
+        with self._lock:
+            lane = None
+            for i, last_end in enumerate(self._lanes):
+                if last_end <= t0:
+                    lane = i
+                    break
+            if lane is None:
+                lane = len(self._lanes)
+                self._lanes.append(0.0)
+            self._lanes[lane] = t1
+        tid = f"lane-{lane:03d}"
+        self._complete(name, t0, t1, tags, tid)
+        for child in children:
+            cname, c0, c1 = child[0], child[1], child[2]
+            ctags = child[3] if len(child) > 3 else None
+            self._complete(cname, max(c0, t0), min(c1, t1), ctags, tid)
+
+    @property
+    def dropped_events(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    # ------------------------------------------------------------------ #
+    # jax compile instants (the retrace_sentry event stream, in context)
+
+    def _on_jax_event(self, event_name: str, *args, **kwargs) -> None:
+        if "backend_compile" in event_name:
+            self.instant("xla_compile")
+        elif "jaxpr_trace" in event_name:
+            self.instant("jaxpr_trace")
+
+    def _register_listener(self) -> None:
+        if self._listener_registered:
+            return
+        try:
+            from jax._src import monitoring
+
+            monitoring.register_event_duration_secs_listener(
+                self._on_jax_event
+            )
+            self._listener_registered = True
+        except Exception:
+            pass  # degrade like retrace_sentry: trace without compile marks
+
+    def _unregister_listener(self) -> None:
+        if not self._listener_registered:
+            return
+        try:
+            from jax._src import monitoring
+
+            monitoring._unregister_event_duration_listener_by_callback(
+                self._on_jax_event
+            )
+        except Exception:
+            pass
+        self._listener_registered = False
+
+    # ------------------------------------------------------------------ #
+    # export
+
+    def chrome_events(self) -> List[dict]:
+        """Chrome trace-event dicts (µs timestamps), ts-sorted, with
+        thread/lane name metadata events first."""
+        with self._lock:
+            events = list(self._events)
+            thread_names = dict(self._thread_names)
+        out = []
+        lanes = sorted({e["tid"] for e in events if isinstance(e["tid"], str)})
+        names = dict(thread_names)
+        names.update({lane: f"request {lane}" for lane in lanes})
+        # stable int tids for chrome: lanes first (they read top-down as
+        # request swimlanes), then real threads in first-seen order
+        tid_map = {lane: i + 1 for i, lane in enumerate(lanes)}
+        base = len(lanes) + 1
+        for e in events:
+            if e["tid"] not in tid_map:
+                tid_map[e["tid"]] = base
+                base += 1
+        for raw_tid, tid in sorted(tid_map.items(), key=lambda kv: kv[1]):
+            out.append({
+                "ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
+                "args": {"name": str(names.get(raw_tid, raw_tid))},
+            })
+        for e in sorted(events, key=lambda e: e["ts"]):
+            ev = {
+                "ph": e["ph"], "name": e["name"], "pid": 1,
+                "tid": tid_map[e["tid"]],
+                "ts": round(e["ts"] * 1e6, 3),
+            }
+            if e["ph"] == "X":
+                ev["dur"] = round(e["dur"] * 1e6, 3)
+            else:
+                ev["s"] = "t"
+            if e.get("args"):
+                ev["args"] = e["args"]
+            out.append(ev)
+        return out
+
+    def export_chrome(self, path: str) -> int:
+        """Write Perfetto-loadable Chrome trace JSON; returns event count."""
+        events = self.chrome_events()
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if self.dropped_events:
+            doc["otherData"] = {"dropped_events": self.dropped_events}
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+            fh.write("\n")
+        return len(events)
+
+    def counts(self) -> Dict[str, int]:
+        """Event counts by name (diagnostics and tests)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for e in self._events:
+                out[e["name"]] = out.get(e["name"], 0) + 1
+            return out
+
+
+# --------------------------------------------------------------------- #
+# module-level switchboard: the zero-cost disabled path
+
+_TRACER: Optional[Tracer] = None
+_SWITCH_LOCK = threading.Lock()
+
+
+def enable(clock: Callable[[], float] = time.perf_counter,
+           max_events: int = 1_000_000, jsonl=None) -> Tracer:
+    """Install (and return) the global tracer.  Idempotent while enabled —
+    a second ``enable`` returns the live tracer unchanged, so nested
+    tooling (serve_bench inside perf_regress) composes."""
+    global _TRACER
+    with _SWITCH_LOCK:
+        if _TRACER is None:
+            tracer = Tracer(clock=clock, max_events=max_events, jsonl=jsonl)
+            tracer._register_listener()
+            _TRACER = tracer
+        return _TRACER
+
+
+def disable() -> Optional[Tracer]:
+    """Uninstall and return the global tracer (for export); no-op → None."""
+    global _TRACER
+    with _SWITCH_LOCK:
+        tracer, _TRACER = _TRACER, None
+    if tracer is not None:
+        tracer._unregister_listener()
+    return tracer
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _TRACER
+
+
+def enabled() -> bool:
+    """True while a global tracer is installed.  Hot paths that must build
+    tag dicts or capture timestamps guard on this first."""
+    return _TRACER is not None
+
+
+def span(name: str, tags: Optional[dict] = None):
+    """Context manager timing ``name`` on the current thread's span stack.
+    The shared no-op singleton when tracing is disabled (no allocation)."""
+    tracer = _TRACER
+    if tracer is None:
+        return _NOOP
+    return tracer.span(name, tags)
+
+
+def instant(name: str, tags: Optional[dict] = None) -> None:
+    """Point event inside the current span; no-op when disabled."""
+    tracer = _TRACER
+    if tracer is not None:
+        tracer.instant(name, tags)

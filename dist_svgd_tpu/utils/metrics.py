@@ -12,7 +12,14 @@ TPU-native replacement here:
 - :class:`StepTimer` — wall-clock timing with ``block_until_ready`` fencing
   for honest updates/sec (async dispatch otherwise under-counts);
 - :func:`profiler_trace` — ``jax.profiler.trace`` context for TensorBoard-
-  readable device traces.
+  readable device traces (``tools/profile_step_floor.py --jax-trace DIR``
+  wires it into the floor decomposition).
+
+These are the per-record primitives; the *aggregating* layer — counters,
+gauges, latency histograms with Prometheus exposition, and causal span
+traces — lives in :mod:`dist_svgd_tpu.telemetry` (round 10).  ``JsonlLogger``
+doubles as the tracer's JSONL exporter sink, and ``StepTimer`` can mirror
+its laps as tracer spans (``span_name=``).
 """
 
 from __future__ import annotations
@@ -147,10 +154,18 @@ def particle_stats(particles, prev=None) -> dict:
 
 class StepTimer:
     """Fenced step timing: ``mark(value)`` blocks on ``value`` (device fence)
-    and records the wall time since the previous mark."""
+    and records the wall time since the previous mark.
 
-    def __init__(self):
+    ``span_name`` bridges into the telemetry tracer: while
+    ``telemetry.enable()`` is active, every lap additionally records a
+    completed span of that name (explicit timestamps — the fence already
+    happened, so the span covers the honest device wall).  The tracer's
+    fencing discipline is this class's, inherited; disabled tracing costs
+    one ``None`` check per mark."""
+
+    def __init__(self, span_name: Optional[str] = None):
         self._last = time.perf_counter()
+        self._span_name = span_name
         self.laps: list = []
 
     def mark(self, value=None) -> float:
@@ -160,6 +175,13 @@ class StepTimer:
         lap = now - self._last
         self._last = now
         self.laps.append(lap)
+        if self._span_name is not None:
+            from dist_svgd_tpu.telemetry import trace as _trace
+
+            tracer = _trace.get_tracer()
+            if tracer is not None:
+                end = tracer.now()
+                tracer.complete(self._span_name, max(end - lap, 0.0), end)
         return lap
 
     @property

@@ -456,10 +456,17 @@ def test_server_routes_and_drain(rng):
         one = _post(srv.url, "/predict", {"inputs": x[0].tolist()})["outputs"]
         assert len(one["mean"]) == 1
 
-        metrics = _get(srv.url, "/metrics")
+        metrics = _get(srv.url, "/metrics.json")
         assert metrics["http_requests"] == 2
         assert metrics["batcher"]["requests"] == 2
         assert metrics["engine"]["model"] == "logreg"
+
+        # /metrics is now Prometheus text exposition of the shared registry
+        prom = urllib.request.urlopen(srv.url + "/metrics", timeout=10)
+        assert prom.headers["Content-Type"].startswith("text/plain")
+        text = prom.read().decode()
+        assert "# TYPE svgd_serve_requests_total counter" in text
+        assert "svgd_serve_request_latency_seconds_bucket" in text
     # graceful drain: batcher closed behind the context manager
     with pytest.raises(RuntimeError, match="closed"):
         srv.batcher.submit(x)
@@ -478,7 +485,7 @@ def test_server_error_codes(rng):
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(srv.url + "/nope", timeout=10)
         assert ei.value.code == 404
-        assert _get(srv.url, "/metrics")["http_errors"] == 2
+        assert _get(srv.url, "/metrics.json")["http_errors"] == 2
 
 
 def test_server_concurrent_load_coalesces(rng):
@@ -504,7 +511,7 @@ def test_server_concurrent_load_coalesces(rng):
         for t in threads:
             t.join()
         assert not errs
-        m = _get(srv.url, "/metrics")
+        m = _get(srv.url, "/metrics.json")
         assert m["batcher"]["requests"] == 8
         assert m["batcher"]["batch_occupancy_mean"] > 1
         assert m["batcher"]["requests_per_batch_mean"] > 1
@@ -563,11 +570,17 @@ def test_serve_bench_row_schema():
     )
     for key in ("metric", "value", "unit", "p50_ms", "p99_ms",
                 "queue_wait_p50_ms", "device_p50_ms", "batch_occupancy_mean",
-                "recompiles", "bucket_hit_rate", "shed", "open_loop"):
+                "recompiles", "bucket_hit_rate", "shed", "open_loop",
+                "serve_latency_p99", "latency_hist_ms", "telemetry"):
         assert key in row, key
     assert row["metric"] == "serve_throughput"
     assert row["value"] > 0
     assert row["recompiles"] == 0  # warmup precedes the timed window
+    # registry-histogram percentiles cover every resolved request (closed
+    # loop + open loop) from the run's fresh registry
+    assert row["latency_hist_ms"]["count"] == 60
+    assert row["serve_latency_p99"] == row["latency_hist_ms"]["p99"] > 0
+    assert row["telemetry"]["tracing"] is False
     # the retrace sentry's independent raw-XLA-compile count over the same
     # window (None only when jax.monitoring is unavailable)
     assert row["sentry_compiles"] in (0, None)

@@ -1,0 +1,551 @@
+"""Unified telemetry (dist_svgd_tpu/telemetry/): metrics registry semantics
++ Prometheus exposition golden, span tracer nesting across threads, the
+disabled-mode zero-allocation no-op, Chrome-trace export validity, the
+serving/resilience integration (queue-depth gauge, shed counter, request
+lane trees, supervisor counters), and tools/trace_report summarisation."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from dist_svgd_tpu.telemetry import (
+    LATENCY_BUCKETS_S,
+    MetricsRegistry,
+    Tracer,
+)
+from dist_svgd_tpu.telemetry import trace as trace_mod
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+@pytest.fixture
+def global_tracer():
+    """Enable the global tracer for one test; always disable after (other
+    tests pin the zero-cost disabled path)."""
+    tracer = trace_mod.enable()
+    try:
+        yield tracer
+    finally:
+        trace_mod.disable()
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+# --------------------------------------------------------------------- #
+# metrics registry
+
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "help")
+    c.inc()
+    c.inc(4)
+    c.inc(2, route="/p")
+    assert c.value() == 5
+    assert c.value(route="/p") == 2
+    with pytest.raises(ValueError, match="decrease"):
+        c.inc(-1)
+    g = reg.gauge("t_depth")
+    g.set(7)
+    g.inc(3)
+    g.dec()
+    assert g.value() == 9
+
+
+def test_registry_get_or_create_and_type_conflict():
+    reg = MetricsRegistry()
+    assert reg.counter("x_total") is reg.counter("x_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad name")
+
+
+def test_histogram_quantiles_log_spaced():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds")
+    assert h.buckets == LATENCY_BUCKETS_S
+    assert h.quantile(0.5) == 0.0  # empty
+    for ms in (1, 1, 2, 4, 100):
+        h.observe(ms / 1e3)
+    # p50 crosses in the bucket containing ~1-2 ms; interpolation keeps it
+    # inside the crossing bucket's bounds
+    p50 = h.quantile(0.50)
+    assert 0.8e-3 <= p50 <= 3.3e-3
+    assert h.quantile(1.0) >= 0.05
+    s = h.summary(scale=1e3)
+    assert s["count"] == 5 and s["sum"] == pytest.approx(108.0)
+    assert s["p99"] >= s["p95"] >= s["p50"] > 0
+    # an observation past the last bucket lands in +Inf and the quantile
+    # clamps to the last finite bound
+    h.observe(100.0)
+    assert h.quantile(1.0) == LATENCY_BUCKETS_S[-1]
+    with pytest.raises(ValueError, match="quantile"):
+        h.quantile(1.5)
+
+
+def test_histogram_rejects_bad_buckets():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="increasing"):
+        reg.histogram("t_bad", buckets=(1.0, 1.0, 2.0))
+
+
+def test_prometheus_exposition_golden():
+    """Exact text-format output for a small fixed registry: names sorted,
+    HELP/TYPE headers, label escaping, cumulative histogram buckets with
+    the +Inf terminal, _sum/_count."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "total requests")
+    c.inc(3)
+    c.inc(2, route="/p")
+    reg.gauge("t_depth", "queue depth").set(7)
+    h = reg.histogram("t_lat_seconds", "latency", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.05, 5.0):
+        h.observe(v)
+    expected = (
+        "# HELP t_depth queue depth\n"
+        "# TYPE t_depth gauge\n"
+        "t_depth 7\n"
+        "# HELP t_lat_seconds latency\n"
+        "# TYPE t_lat_seconds histogram\n"
+        't_lat_seconds_bucket{le="0.001"} 1\n'
+        't_lat_seconds_bucket{le="0.01"} 1\n'
+        't_lat_seconds_bucket{le="0.1"} 2\n'
+        't_lat_seconds_bucket{le="+Inf"} 3\n'
+        "t_lat_seconds_sum 5.0505\n"
+        "t_lat_seconds_count 3\n"
+        "# HELP t_requests_total total requests\n"
+        "# TYPE t_requests_total counter\n"
+        "t_requests_total 3\n"
+        't_requests_total{route="/p"} 2\n'
+    )
+    assert reg.exposition() == expected
+
+
+def test_exposition_escapes_label_values():
+    reg = MetricsRegistry()
+    reg.counter("t_esc_total").inc(1, path='a"b\\c\nd')
+    text = reg.exposition()
+    assert 't_esc_total{path="a\\"b\\\\c\\nd"} 1' in text
+
+
+def test_registry_thread_safety_exact_counts():
+    reg = MetricsRegistry()
+    c = reg.counter("t_conc_total")
+    h = reg.histogram("t_conc_seconds")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000
+    assert h.summary()["count"] == 8000
+
+
+# --------------------------------------------------------------------- #
+# span tracer
+
+
+def test_span_nesting_across_threads():
+    """Each thread keeps its own span stack: concurrent nested spans land
+    on their own tids, children contained in their parents, no cross-thread
+    bleed of the 'active span' (instants tag the right parent)."""
+    tracer = Tracer()
+    barrier = threading.Barrier(2, timeout=10)
+
+    def work(name):
+        with tracer.span(f"{name}.outer"):
+            barrier.wait()
+            with tracer.span(f"{name}.inner"):
+                tracer.instant(f"{name}.mark")
+            barrier.wait()
+
+    threads = [threading.Thread(target=work, args=(n,)) for n in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    counts = tracer.counts()
+    for name in ("a.outer", "a.inner", "b.outer", "b.inner",
+                 "a.mark", "b.mark"):
+        assert counts[name] == 1, counts
+    events = tracer.chrome_events()
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    instants = {e["name"]: e for e in events if e["ph"] == "i"}
+    for side in ("a", "b"):
+        outer, inner = spans[f"{side}.outer"], spans[f"{side}.inner"]
+        assert outer["tid"] == inner["tid"]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+        # the instant fired inside this thread's inner span, not the
+        # concurrent sibling's
+        assert instants[f"{side}.mark"]["args"]["in_span"] == f"{side}.inner"
+        assert instants[f"{side}.mark"]["tid"] == inner["tid"]
+    assert spans["a.outer"]["tid"] != spans["b.outer"]["tid"]
+
+
+def test_disabled_span_is_shared_noop_and_zero_alloc():
+    """The whole point of the disabled path: module-level span()/instant()
+    allocate NOTHING (shared singleton, no clock read, no event) so leaving
+    instrumentation in hot loops is free."""
+    import tracemalloc
+
+    assert not trace_mod.enabled()
+    assert trace_mod.span("x") is trace_mod.span("y")  # shared singleton
+    sp = trace_mod.span("x")
+    assert sp.fence(42) == 42  # passthrough
+    assert sp.tag(a=1) is sp
+
+    def loop():
+        for _ in range(200):
+            with trace_mod.span("hot"):
+                pass
+            trace_mod.instant("mark")
+
+    loop()  # warm any lazy caches before measuring
+    tracemalloc.start()
+    try:
+        filters = [tracemalloc.Filter(True, trace_mod.__file__)]
+        before = tracemalloc.take_snapshot().filter_traces(filters)
+        loop()
+        after = tracemalloc.take_snapshot().filter_traces(filters)
+    finally:
+        tracemalloc.stop()
+    grown = sum(max(s.size_diff, 0)
+                for s in after.compare_to(before, "lineno"))
+    assert grown == 0, f"disabled span path allocated {grown} bytes"
+
+
+def test_enable_disable_idempotent_and_global_span():
+    try:
+        t1 = trace_mod.enable()
+        t2 = trace_mod.enable()
+        assert t1 is t2
+        assert trace_mod.enabled()
+        with trace_mod.span("g.outer", {"k": 1}):
+            trace_mod.instant("g.mark")
+        assert t1.counts() == {"g.outer": 1, "g.mark": 1}
+    finally:
+        out = trace_mod.disable()
+    assert out is t1
+    assert trace_mod.disable() is None  # second disable is a no-op
+    assert not trace_mod.enabled()
+
+
+def test_span_records_on_exception_and_tags_error():
+    tracer = Tracer()
+    with pytest.raises(RuntimeError):
+        with tracer.span("boom"):
+            raise RuntimeError("x")
+    events = tracer.chrome_events()
+    ev = [e for e in events if e["ph"] == "X"][0]
+    assert ev["name"] == "boom" and ev["args"]["error"] == "RuntimeError"
+    # the stack popped despite the exception: a new span is top-level again
+    with tracer.span("next"):
+        assert tracer.active_span().name == "next"
+
+
+def test_span_fence_blocks_device_value():
+    import jax.numpy as jnp
+
+    tracer = Tracer()
+    with tracer.span("fenced") as sp:
+        out = sp.fence(jnp.ones((8, 8)) * 2)
+    assert float(out[0, 0]) == 2.0
+    assert tracer.counts() == {"fenced": 1}
+
+
+def test_max_events_drops_and_counts():
+    clock = ManualClock()
+    tracer = Tracer(clock=clock, max_events=3)
+    for i in range(5):
+        clock.advance(1.0)
+        tracer.instant(f"e{i}")
+    assert len(tracer.chrome_events()) - 1 == 3  # +1 thread_name metadata
+    assert tracer.dropped_events == 2
+
+
+def test_lane_tree_allocates_non_overlapping_lanes():
+    """Two overlapping request trees land on different lanes; a later
+    non-overlapping one reuses lane 0; children clamp into the parent."""
+    clock = ManualClock()
+    tracer = Tracer(clock=clock)
+    tracer.lane_tree("r0", 0.0, 1.0, children=[("c0", 0.0, 0.4)])
+    tracer.lane_tree("r1", 0.5, 2.0)   # overlaps r0 → lane 1
+    tracer.lane_tree("r2", 1.5, 3.0)   # lane 0 free again (ended at 1.0)
+    events = [e for e in tracer.chrome_events() if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in events}
+    assert by_name["r0"]["tid"] == by_name["r2"]["tid"]
+    assert by_name["r1"]["tid"] != by_name["r0"]["tid"]
+    assert by_name["c0"]["tid"] == by_name["r0"]["tid"]
+
+
+def test_chrome_export_valid_json_monotonic_ts(tmp_path):
+    clock = ManualClock()
+    tracer = Tracer(clock=clock)
+    for i in range(4):
+        with tracer.span(f"s{i}"):
+            clock.advance(0.01)
+        tracer.instant(f"i{i}")
+    path = str(tmp_path / "trace.json")
+    n = tracer.export_chrome(path)
+    with open(path) as fh:
+        doc = json.load(fh)  # valid JSON
+    events = doc["traceEvents"]
+    assert len(events) == n
+    ts = [e["ts"] for e in events if e["ph"] in ("X", "i")]
+    assert ts == sorted(ts)  # monotonic timeline
+    assert all(e["ts"] >= 0 for e in events if "ts" in e)
+    # metadata names every tid used by real events
+    named = {e["tid"] for e in events if e["ph"] == "M"}
+    used = {e["tid"] for e in events if e["ph"] != "M"}
+    assert used <= named
+
+
+def test_jsonl_exporter_mirrors_events(tmp_path):
+    from dist_svgd_tpu.utils.metrics import JsonlLogger
+
+    path = str(tmp_path / "spans.jsonl")
+    clock = ManualClock()
+    with JsonlLogger(path=path) as logger:
+        tracer = Tracer(clock=clock, jsonl=logger)
+        with tracer.span("a", {"k": 1}):
+            clock.advance(0.5)
+            tracer.instant("m")
+    lines = [json.loads(x) for x in open(path)]
+    kinds = {(r["kind"], r["name"]) for r in lines}
+    assert kinds == {("instant", "m"), ("span", "a")}
+    span_rec = [r for r in lines if r["kind"] == "span"][0]
+    assert span_rec["dur"] == pytest.approx(0.5)
+
+
+# --------------------------------------------------------------------- #
+# serving integration: registry + lane trees
+
+
+def _tiny_engine(rng, registry=None):
+    from dist_svgd_tpu.serving import PredictiveEngine
+
+    parts = rng.normal(size=(16, 5)).astype(np.float32)
+    return PredictiveEngine("logreg", parts, min_bucket=4, max_bucket=16,
+                            registry=registry)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_batcher_shed_increments_registry_counter(rng):
+    """Satellite regression pin: an Overloaded shed increments
+    svgd_serve_shed_total (and the queue-depth gauge tracks the rows)."""
+    from dist_svgd_tpu.serving import MicroBatcher, Overloaded
+
+    reg = MetricsRegistry()
+    eng = _tiny_engine(rng, registry=reg)
+    bat = MicroBatcher(eng.predict, max_batch=4, max_queue_rows=4,
+                       registry=reg, autostart=False)
+    depth = reg.gauge("svgd_serve_queue_depth_rows")
+    bat.submit(np.zeros((4, 4), np.float32))  # fills the bounded queue
+    assert depth.value(batcher=bat.metrics_instance) == 4
+    with pytest.raises(Overloaded):
+        bat.submit(np.zeros((1, 4), np.float32))
+    assert reg.counter("svgd_serve_shed_total").value() == 1
+    bat.start()
+    bat.close(drain=True)
+    assert depth.value(batcher=bat.metrics_instance) == 0
+    assert reg.counter("svgd_serve_requests_total").value() == 1
+    assert reg.histogram(
+        "svgd_serve_request_latency_seconds").summary()["count"] == 1
+    # per-instance gauge label: a second batcher on the same registry
+    # reports its own depth instead of overwriting this one's — while the
+    # counters keep aggregating across instances
+    bat2 = MicroBatcher(eng.predict, max_batch=4, registry=reg,
+                        autostart=False)
+    bat2.submit(np.zeros((2, 4), np.float32))
+    assert depth.value(batcher=bat2.metrics_instance) == 2
+    assert depth.value(batcher=bat.metrics_instance) == 0
+    bat2.start()
+    bat2.close(drain=True)
+    assert reg.counter("svgd_serve_requests_total").value() == 2
+
+
+def test_engine_bucket_counters_in_registry(rng):
+    reg = MetricsRegistry()
+    eng = _tiny_engine(rng, registry=reg)
+    eng.predict(np.zeros((3, 4), np.float32))   # miss (compile bucket 4)
+    eng.predict(np.zeros((4, 4), np.float32))   # hit (same bucket)
+    assert reg.counter("svgd_engine_bucket_misses_total").value() == 1
+    assert reg.counter("svgd_engine_bucket_hits_total").value() == 1
+
+
+def test_request_lane_trees_under_tracing(rng, global_tracer):
+    """Acceptance shape: request spans contain queue-wait / coalesce /
+    dispatch children inside the parent interval, on a lane tid."""
+    from dist_svgd_tpu.serving import MicroBatcher
+
+    reg = MetricsRegistry()
+    eng = _tiny_engine(rng, registry=reg)
+    eng.warmup()
+    bat = MicroBatcher(eng.predict, max_batch=8, max_wait_ms=1.0,
+                       registry=reg)
+    try:
+        for _ in range(3):
+            bat.submit(rng.normal(size=(2, 4)).astype(np.float32)).result(
+                timeout=10)
+    finally:
+        bat.close(drain=True)
+    events = [e for e in global_tracer.chrome_events() if e["ph"] == "X"]
+    reqs = [e for e in events if e["name"] == "serve.request"]
+    assert len(reqs) == 3
+    for req in reqs:
+        t0, t1 = req["ts"], req["ts"] + req["dur"]
+        children = [e for e in events
+                    if e["tid"] == req["tid"] and e is not req
+                    and t0 - 1e-3 <= e["ts"] and
+                    e["ts"] + e["dur"] <= t1 + 1e-3]
+        names = {c["name"] for c in children}
+        assert {"serve.queue_wait", "serve.coalesce",
+                "serve.dispatch"} <= names, names
+        assert req["args"]["rows"] == 2
+    # the engine's live spans rode the worker thread alongside
+    names = {e["name"] for e in events}
+    assert "engine.predict" in names and "engine.dispatch" in names
+
+
+# --------------------------------------------------------------------- #
+# resilience integration
+
+
+def test_supervisor_registry_counters(tmp_path):
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+    from dist_svgd_tpu.resilience import FaultPlan, RaiseAt, RunSupervisor
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    reg = MetricsRegistry()
+    parts = init_particles_per_shard(0, 16, 2, 2)
+    ds = dt.DistSampler(2, lambda th, _: gmm_logp(th), None, parts,
+                        exchange_particles=True, exchange_scores=False,
+                        include_wasserstein=False)
+    sup = RunSupervisor(ds, 8, 0.05,
+                        checkpoint_dir=str(tmp_path / "ck"),
+                        checkpoint_every=4, segment_steps=4,
+                        sleep=lambda s: None, registry=reg,
+                        faults=FaultPlan(RaiseAt(4)))
+    report = sup.run()
+    assert report["status"] == "completed"
+    assert reg.counter("svgd_train_restarts_total").value(
+        kind="transient") == 1
+    assert reg.counter("svgd_train_steps_total").value() == 8
+    # initial + step-4 (pre- and post-retry saves collapse onto the grid)
+    # + final checkpoint all recorded with walls
+    ck = reg.histogram("svgd_train_checkpoint_seconds").summary()
+    assert ck["count"] == report["checkpoints"] >= 2
+    seg = reg.histogram("svgd_train_segment_seconds").summary()
+    assert seg["count"] == report["segments"] == 2
+
+
+def test_supervisor_spans_under_tracing(tmp_path, global_tracer):
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+    from dist_svgd_tpu.resilience import RunSupervisor
+    from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+    parts = init_particles_per_shard(0, 16, 2, 2)
+    ds = dt.DistSampler(2, lambda th, _: gmm_logp(th), None, parts,
+                        exchange_particles=True, exchange_scores=False,
+                        include_wasserstein=False)
+    sup = RunSupervisor(ds, 8, 0.05, checkpoint_dir=str(tmp_path / "ck"),
+                        checkpoint_every=4, segment_steps=4,
+                        sleep=lambda s: None, registry=MetricsRegistry())
+    sup.run()
+    counts = global_tracer.counts()
+    assert counts.get("train.segment") == 2
+    assert counts.get("train.checkpoint", 0) >= 2
+    # segment spans wrap the sampler's per-dispatch step-chunk spans
+    assert counts.get("train.step_chunk", 0) >= 2
+
+
+def test_steptimer_span_bridge(global_tracer):
+    from dist_svgd_tpu.utils.metrics import StepTimer
+
+    timer = StepTimer(span_name="bench.lap")
+    timer.mark()
+    timer.mark()
+    assert global_tracer.counts().get("bench.lap") == 2
+
+
+# --------------------------------------------------------------------- #
+# trace_report
+
+
+def test_trace_report_summarizes_chrome_and_jsonl(tmp_path):
+    import trace_report
+
+    clock = ManualClock()
+    tracer = Tracer(clock=clock)
+    with tracer.span("outer"):
+        clock.advance(0.004)
+        with tracer.span("inner"):
+            clock.advance(0.006)
+            tracer.instant("xla_compile")
+    path = str(tmp_path / "t.json")
+    tracer.export_chrome(path)
+    spans, instants = trace_report.load_events(path)
+    report = trace_report.summarize(spans, instants)
+    assert report["spans"]["outer"]["count"] == 1
+    assert report["spans"]["outer"]["p50_ms"] == pytest.approx(10.0)
+    assert report["spans"]["inner"]["p50_ms"] == pytest.approx(6.0)
+    # self-time: outer minus its child
+    assert report["spans"]["outer"]["self_ms"] == pytest.approx(4.0)
+    assert report["compiles"] == 1
+    assert report["compile_spans"] == {"inner": 1}
+    assert "outer" in trace_report.render(report)
+
+    # JSONL form round-trips through the same summary
+    from dist_svgd_tpu.utils.metrics import JsonlLogger
+
+    jl_path = str(tmp_path / "t.jsonl")
+    clock2 = ManualClock()
+    with JsonlLogger(path=jl_path) as logger:
+        tr2 = Tracer(clock=clock2, jsonl=logger)
+        with tr2.span("a"):
+            clock2.advance(0.002)
+    spans2, instants2 = trace_report.load_events(jl_path)
+    report2 = trace_report.summarize(spans2, instants2)
+    assert report2["spans"]["a"]["p50_ms"] == pytest.approx(2.0)
+    assert report2["compiles"] == 0
+
+
+def test_trace_report_cli_json(tmp_path, capsys):
+    import trace_report
+
+    tracer = Tracer(clock=ManualClock())
+    with tracer.span("x"):
+        pass
+    path = str(tmp_path / "t.json")
+    tracer.export_chrome(path)
+    assert trace_report.main([path, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["n_spans"] == 1 and "x" in out["spans"]

@@ -80,9 +80,16 @@ def run_drill(n=2048, num_shards=4, num_steps=48, step_size=0.05,
             "or pass an explicit --kill-step"
         )
 
+    from dist_svgd_tpu.telemetry import MetricsRegistry
+
+    # one fresh registry for the whole drill: the checkpoint/segment
+    # histograms aggregate every phase (baseline + kill + recover + verify)
+    registry = MetricsRegistry()
+
     def supervise(sampler, steps, **kw):
         kw.setdefault("segment_steps", segment_steps)
         kw.setdefault("sleep", lambda s: None)  # injected faults only
+        kw.setdefault("registry", registry)
         return RunSupervisor(sampler, steps, step_size, **kw)
 
     # -------- phase 1: baseline (warm-up untimed, then timed) ----------- #
@@ -170,6 +177,17 @@ def run_drill(n=2048, num_shards=4, num_steps=48, step_size=0.05,
         "retry_backoff_recovered": bool(retry_ok),
         "nan_rollback_recovered": bool(nan_ok),
         "overhead_under_5pct": bool(overhead_pct < 5.0),
+        # telemetry-registry histogram percentiles over every drill phase
+        # (round 10): the same series a production scrape shows, so the
+        # drill row documents the checkpoint/segment latency distribution,
+        # not just the baseline-phase means above
+        "checkpoint_ms_hist": registry.histogram(
+            "svgd_train_checkpoint_seconds").summary(scale=1e3),
+        "segment_ms_hist": registry.histogram(
+            "svgd_train_segment_seconds").summary(scale=1e3),
+        "restarts_total": registry.counter(
+            "svgd_train_restarts_total").value(kind="transient")
+        + registry.counter("svgd_train_restarts_total").value(kind="guard"),
     }
 
 

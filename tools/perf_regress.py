@@ -30,6 +30,13 @@ A/B timing protocol those notes derived:
   noisy row (relative MAD above the tol) self-documents its spread instead
   of flapping.  Legacy single-value incumbents seed a 1-point window.
 
+- **serving telemetry rows (round 10)** — the serve round additionally
+  gates ``serve_latency_p99`` (the telemetry histogram's tail latency over
+  the timed window, judged lower-is-better with the same median+MAD
+  windows — rps can hold while the tail fattens) and ``telemetry_overhead``
+  (tracer-off/on A/B via ``serve_bench.measure_telemetry_overhead``;
+  FAILs above a fixed 3% ceiling, never recorded as an incumbent).
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -77,9 +84,16 @@ INCUMBENTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: and W2 rows sat at 1.0×).  The wider band still catches a real floor
 #: regression (a 2× slower dispatch path fails at any relay state).
 TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
-              # the serving row measures host thread scheduling + the
+              # the serving rows measure host thread scheduling + the
               # batcher's wait window as much as the chip — wider band
-              "serve_throughput": 2.0}
+              "serve_throughput": 2.0, "serve_latency_p99": 2.0}
+
+#: Hard ceiling on the span tracer's measured serve-bench cost (round 10):
+#: the interleaved tracer-off/on A/B (``serve_bench.
+#: measure_telemetry_overhead``) FAILs above this fraction regardless of
+#: incumbents — "observability that slows the service down" is a regression
+#: by definition, not a noise band question.
+TELEMETRY_OVERHEAD_MAX = 0.03
 
 #: serve_throughput row config (tools/serve_bench.py defaults at a fixed,
 #: recorded load): logreg d=55, 10k-particle ensemble, 16 closed-loop
@@ -424,6 +438,51 @@ def main():
         if status == "FAIL":
             failures += 1
     results[serve_key] = serve_best["value"]
+    print(json.dumps(row), flush=True)
+
+    # tail-latency gate (round 10): the telemetry histogram's p99 over the
+    # best round's timed window, judged lower-is-better with the same
+    # median+MAD window discipline as the throughput rows — a serving
+    # change can hold rps while fattening the tail, and this row is the
+    # one that catches it
+    lat_key = "serve_latency_p99"
+    lat_val = serve_best.get(lat_key)
+    row = {"bench": lat_key, "value": lat_val, "unit": "ms"}
+    if not lat_val:
+        # a missing/zero p99 over a non-empty request window means the
+        # telemetry histogram plumbing broke — FAIL loudly instead of
+        # silently running without the tail-latency gate
+        row["status"] = "FAIL"
+        row["error"] = ("empty serve-latency histogram: serve_bench row "
+                        "carried no telemetry percentiles")
+        failures += 1
+    else:
+        tol = min(args.tol * TOL_FACTOR.get(lat_key, 1.0), 0.9)
+        status, info = judge_row(
+            lat_val, incumbent_history(incumbents, lat_key), tol, False,
+        )
+        row.update(info)
+        row["status"] = status
+        if status == "FAIL":
+            failures += 1
+        results[lat_key] = lat_val
+    print(json.dumps(row), flush=True)
+
+    # telemetry-overhead gate (round 10): tracer-off vs tracer-on A/B on
+    # the serve bench (interleaved rounds, best-of each arm) — a fixed
+    # ceiling, not an incumbent window (and never recorded as one)
+    ov = serve_bench.measure_telemetry_overhead(
+        rounds=args.rounds, **SERVE_BENCH_KW)
+    row = {"bench": "telemetry_overhead", "value": ov["overhead_frac"],
+           "unit": "fraction of serve rps lost with tracing enabled",
+           "rps_disabled": ov["rps_disabled"],
+           "rps_enabled": ov["rps_enabled"],
+           "ceiling": TELEMETRY_OVERHEAD_MAX}
+    if ov["overhead_frac"] > TELEMETRY_OVERHEAD_MAX:
+        row["status"] = "FAIL"
+        failures += 1
+    else:
+        row["status"] = "PASS"
     print(json.dumps(row), flush=True)
 
     print(json.dumps({

@@ -16,6 +16,13 @@ score only → φ only → full step) at the asymptotic dispatch length, so the
 per-iteration floor's composition is measured rather than guessed.
 
 Usage: ``python tools/profile_step_floor.py [--n 100]``.
+
+``--jax-trace DIR`` wraps the measured sections in
+``utils/metrics.py:profiler_trace`` (``jax.profiler.trace``), so a
+TensorBoard/Perfetto-readable **device** trace of the exact dispatches being
+timed is one flag away — the device-side complement to the host-side span
+tracer (``dist_svgd_tpu/telemetry``); load ``DIR`` in TensorBoard's profile
+plugin or ``xprof``.
 """
 
 import argparse
@@ -34,6 +41,7 @@ from jax import lax
 from dist_svgd_tpu.models.logreg import logreg_logp
 from dist_svgd_tpu.ops.kernels import RBF
 from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
+from dist_svgd_tpu.utils.metrics import profiler_trace
 from dist_svgd_tpu.utils.rng import as_key, init_particles
 from dist_svgd_tpu.utils.datasets import load_benchmark
 
@@ -64,6 +72,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=100)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--jax-trace", default=None, metavar="DIR",
+                    help="capture a jax.profiler device trace of the "
+                         "measured sections into DIR (TensorBoard/xprof-"
+                         "readable); off when omitted")
     args = ap.parse_args()
 
     print("devices:", jax.devices(), flush=True)
@@ -96,14 +108,17 @@ def main():
     print(f"\nconfig-1 shape: n={args.n}, d={d}, rows={x.shape[0]}")
     print(f"{'body':26s} " + "".join(f"{k:>10d}it" for k in (100, 1000)))
     asym = {}
-    for name, body in bodies.items():
-        walls = []
-        for iters in (100, 1000):
-            w = timed_scan(body, P0, iters, reps=args.reps)
-            walls.append(w / iters * 1e3)
-        asym[name] = walls[-1]
-        print(f"{name:26s} " + "".join(f"{w:11.4f}" for w in walls)
-              + "   ms/step", flush=True)
+    # device trace of the measured dispatches, one flag away (module
+    # docstring) — a no-op context when --jax-trace is omitted
+    with profiler_trace(args.jax_trace):
+        for name, body in bodies.items():
+            walls = []
+            for iters in (100, 1000):
+                w = timed_scan(body, P0, iters, reps=args.reps)
+                walls.append(w / iters * 1e3)
+            asym[name] = walls[-1]
+            print(f"{name:26s} " + "".join(f"{w:11.4f}" for w in walls)
+                  + "   ms/step", flush=True)
 
     print("\nper-iteration composition at the 1000-iter dispatch:")
     base = asym["empty (axpy only)"]
@@ -130,23 +145,24 @@ def main():
     np.asarray(run100(P0))  # compile
     print("\nchain-length sweep, full 100-step config-1 dispatches:")
     prev_total = None
-    for chain in (1, 8, 32, 128):
-        best = float("inf")
-        for _ in range(3):
-            out = P0
-            t0 = time.perf_counter()
-            for _ in range(chain):
-                out = run100(out)
-            np.asarray(out)[0, 0]
-            best = min(best, time.perf_counter() - t0)
-        line = (f"  chain={chain:4d}: {best*1e3:9.1f} ms total, "
-                f"{best/chain*1e3:8.3f} ms/dispatch, "
-                f"{args.n*100/(best/chain):12.0f} up/s")
-        if prev_total is not None:
-            marg = (best - prev_total[1]) / (chain - prev_total[0])
-            line += f"   marginal {marg*1e3:7.3f} ms/dispatch"
-        print(line, flush=True)
-        prev_total = (chain, best)
+    with profiler_trace(args.jax_trace):
+        for chain in (1, 8, 32, 128):
+            best = float("inf")
+            for _ in range(3):
+                out = P0
+                t0 = time.perf_counter()
+                for _ in range(chain):
+                    out = run100(out)
+                np.asarray(out)[0, 0]
+                best = min(best, time.perf_counter() - t0)
+            line = (f"  chain={chain:4d}: {best*1e3:9.1f} ms total, "
+                    f"{best/chain*1e3:8.3f} ms/dispatch, "
+                    f"{args.n*100/(best/chain):12.0f} up/s")
+            if prev_total is not None:
+                marg = (best - prev_total[1]) / (chain - prev_total[0])
+                line += f"   marginal {marg*1e3:7.3f} ms/dispatch"
+            print(line, flush=True)
+            prev_total = (chain, best)
 
 
 if __name__ == "__main__":

@@ -33,8 +33,22 @@ Output: one JSON row, e.g.::
      "queue_wait_p50_ms": 1.2, "device_p50_ms": 1.7,
      "batch_occupancy_mean": 7.0, "requests_per_batch_mean": 5.2,
      "recompiles": 0, "sentry_compiles": 0, "bucket_hit_rate": 1.0, "shed": 0,
+     "serve_latency_p99": 9.9,
+     "latency_hist_ms": {"count": 2000, "p50": 3.2, "p95": 7.1, "p99": 9.9},
+     "telemetry": {"tracing": false, "queue_depth_last": 0, "shed_total": 0},
      "open_loop": {"rate_rps": 500, "achieved_rps": 499.1, "p50_ms": 2.9,
                    "p99_ms": 11.0, "shed": 0}, ...}
+
+``serve_latency_p99`` / ``latency_hist_ms`` come from the telemetry
+registry's log-spaced latency histogram over the timed window (round 10) —
+the same series a Prometheus scrape of ``/metrics`` shows, bucket-
+interpolated (vs the exact sorted-sample ``p50_ms``/``p99_ms``).
+``--trace PATH`` additionally enables the span tracer for the window and
+exports a Perfetto-loadable Chrome trace (request lane trees: one
+``serve.request`` span per request with queue-wait / coalesce / dispatch
+children; summarise with ``tools/trace_report.py``).  ``--ab-telemetry N``
+emits the ``telemetry_overhead`` row instead (interleaved tracer-off/on
+rounds; ``perf_regress.py`` FAILs it above 3%).
 """
 
 import argparse
@@ -50,7 +64,7 @@ from dist_svgd_tpu.serving.batcher import _percentile  # noqa: E402
 
 
 def build_engine(model="logreg", n_particles=10_000, n_features=54,
-                 checkpoint=None, seed=0, max_bucket=256):
+                 checkpoint=None, seed=0, max_bucket=256, registry=None):
     """Checkpointed ensemble when given, else a seeded synthetic one —
     serving throughput depends on shapes, not on convergence."""
     import numpy as np
@@ -61,7 +75,7 @@ def build_engine(model="logreg", n_particles=10_000, n_features=54,
         source = checkpoint if len(checkpoint) > 1 else checkpoint[0]
         return PredictiveEngine.from_checkpoint(
             source, model, n_features=n_features if model == "bnn" else None,
-            max_bucket=max_bucket,
+            max_bucket=max_bucket, registry=registry,
         )
     rng = np.random.default_rng(seed)
     if model == "logreg":
@@ -75,7 +89,7 @@ def build_engine(model="logreg", n_particles=10_000, n_features=54,
     return PredictiveEngine(
         model, parts.astype(np.float32),
         n_features=n_features if model == "bnn" else None,
-        max_bucket=max_bucket,
+        max_bucket=max_bucket, registry=registry,
     )
 
 
@@ -209,14 +223,28 @@ def _http_submit(url):
 def run_bench(model="logreg", n_particles=10_000, n_features=54,
               clients=16, requests=2000, rows=(1, 4, 16), max_batch=256,
               max_wait_ms=2.0, max_queue_rows=8192, open_rate=0.0,
-              open_requests=500, checkpoint=None, seed=0, url=None):
-    """Measure and return the JSON row (importable — perf_regress uses this)."""
+              open_requests=500, checkpoint=None, seed=0, url=None,
+              engine=None, trace=None):
+    """Measure and return the JSON row (importable — perf_regress uses this).
+
+    ``trace``: a path enables the span tracer for the timed window and
+    exports a Perfetto-loadable Chrome trace there (``True`` traces without
+    exporting — the overhead A/B).  ``engine``: reuse a pre-built engine
+    (its warmup cost then amortises across calls — the A/B runs).
+
+    Telemetry rows: each call uses a **fresh** ``MetricsRegistry``, so the
+    histogram-derived fields (``serve_latency_p99``, ``latency_hist_ms``)
+    aggregate exactly this call's timed window.
+    """
     import jax
 
+    from dist_svgd_tpu import telemetry
     from dist_svgd_tpu.serving import MicroBatcher
 
-    engine = build_engine(model, n_particles, n_features, checkpoint, seed,
-                          max_bucket=max_batch)
+    registry = telemetry.MetricsRegistry()
+    if engine is None:
+        engine = build_engine(model, n_particles, n_features, checkpoint,
+                              seed, max_bucket=max_batch, registry=registry)
     pool = _request_pool(engine.feature_dim, list(rows))
     row = {
         "metric": "serve_throughput",
@@ -244,8 +272,16 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
     misses_before = engine.stats()["bucket_misses"]
     batcher = MicroBatcher(
         engine.predict, max_batch=max_batch, max_wait_ms=max_wait_ms,
-        max_queue_rows=max_queue_rows,
+        max_queue_rows=max_queue_rows, registry=registry,
     )
+    # tracing covers exactly the timed window (warmup compiles stay out of
+    # the trace, like they stay out of the sentry count); idempotent enable
+    # so an outer tracer (perf_regress) is reused, not replaced
+    tracer = None
+    own_tracer = False
+    if trace:
+        own_tracer = telemetry.get_tracer() is None
+        tracer = telemetry.enable()
     try:
         with retrace_sentry("serve_bench timed window") as sentry:
             closed = closed_loop(batcher.submit, pool, clients, requests)
@@ -255,6 +291,8 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
                                      open_requests)
     finally:
         batcher.close(drain=True)
+        if tracer is not None and own_tracer:
+            telemetry.disable()
     bstats = batcher.stats()
     estats = engine.stats()
     lookups = estats["bucket_hits"] + estats["bucket_misses"] - misses_before
@@ -283,10 +321,66 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
         # counts, and its total also includes open-loop sheds
         shed=closed["shed"],
     )
+    # registry-histogram percentiles (telemetry round 10): the request
+    # latency distribution over the whole window from the shared registry's
+    # log-spaced buckets — bucket-interpolated, so they cross-check the
+    # exact closed-loop p50/p99 above, and they are what a Prometheus
+    # scrape of a production server would show
+    lat_hist = registry.histogram("svgd_serve_request_latency_seconds")
+    hist_ms = lat_hist.summary(scale=1e3)
+    row.update(
+        serve_latency_p99=hist_ms["p99"],
+        latency_hist_ms=hist_ms,
+        telemetry={"tracing": bool(trace),
+                   "queue_depth_last": registry.gauge(
+                       "svgd_serve_queue_depth_rows").value(
+                           batcher=batcher.metrics_instance),
+                   "shed_total": registry.counter(
+                       "svgd_serve_shed_total").value()},
+    )
+    if tracer is not None:
+        if isinstance(trace, str):
+            n_events = tracer.export_chrome(trace)
+            row["trace"] = {"path": trace, "events": n_events,
+                            "dropped": tracer.dropped_events}
+        else:
+            row["trace"] = {"events": len(tracer.chrome_events()),
+                            "dropped": tracer.dropped_events}
     if open_row is not None:
         row["open_loop"] = {k: round(v, 3) if isinstance(v, float) else v
                             for k, v in open_row.items()}
     return row
+
+
+def measure_telemetry_overhead(rounds=3, **kw):
+    """A/B the span tracer's cost on the closed-loop bench: interleaved
+    disabled/enabled rounds over ONE warmed engine, best-of each arm (the
+    same noise discipline as perf_regress's interleaved rounds — a host
+    slowdown hits both arms of a round together).  Returns the
+    ``telemetry_overhead`` row; the CI gate FAILs it above 3%.
+    """
+    kw.pop("engine", None)
+    kw.pop("trace", None)
+    engine = build_engine(
+        kw.get("model", "logreg"), kw.get("n_particles", 10_000),
+        kw.get("n_features", 54), kw.get("checkpoint"), kw.get("seed", 0),
+        max_bucket=kw.get("max_batch", 256),
+    )
+    engine.warmup()
+    best = {"off": 0.0, "on": 0.0}
+    for _ in range(rounds):
+        off = run_bench(engine=engine, trace=None, **kw)
+        on = run_bench(engine=engine, trace=True, **kw)
+        best["off"] = max(best["off"], off["value"])
+        best["on"] = max(best["on"], on["value"])
+    overhead = (1.0 - best["on"] / best["off"]) if best["off"] > 0 else 0.0
+    return {
+        "metric": "telemetry_overhead",
+        "rounds": rounds,
+        "rps_disabled": round(best["off"], 1),
+        "rps_enabled": round(best["on"], 1),
+        "overhead_frac": round(overhead, 4),
+    }
 
 
 def main():
@@ -312,17 +406,29 @@ def main():
     ap.add_argument("--url", default=None,
                     help="closed-loop against a live serving.server "
                          "instead of in-process")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="enable the span tracer for the timed window and "
+                         "export a Perfetto-loadable Chrome trace here "
+                         "(summarise with tools/trace_report.py)")
+    ap.add_argument("--ab-telemetry", type=int, default=0, metavar="ROUNDS",
+                    help="instead of one bench row, A/B the tracer's "
+                         "overhead over this many interleaved "
+                         "disabled/enabled rounds")
     args = ap.parse_args()
 
     rows = tuple(int(r) for r in args.rows.split(","))
-    out = run_bench(
+    kw = dict(
         model=args.model, n_particles=args.n_particles,
         n_features=args.n_features, clients=args.clients,
         requests=args.requests, rows=rows, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, max_queue_rows=args.max_queue_rows,
         open_rate=args.open_rate, open_requests=args.open_requests,
-        checkpoint=args.checkpoint, seed=args.seed, url=args.url,
+        checkpoint=args.checkpoint, seed=args.seed,
     )
+    if args.ab_telemetry:
+        out = measure_telemetry_overhead(rounds=args.ab_telemetry, **kw)
+    else:
+        out = run_bench(url=args.url, trace=args.trace, **kw)
     print(json.dumps(out), flush=True)
 
 

@@ -1,0 +1,213 @@
+"""Summarise a telemetry trace file: per-span percentiles, self-time,
+compile events.
+
+Reads either exporter format the tracer writes
+(``dist_svgd_tpu/telemetry/trace.py``):
+
+- **Chrome trace JSON** (``Tracer.export_chrome`` — the Perfetto-loadable
+  ``{"traceEvents": [...]}`` document, µs timestamps), or
+- **JSONL** (one record per completed span/instant through ``JsonlLogger``,
+  second timestamps, ``kind`` field).
+
+and prints, per span name: count, p50/p95/p99/max duration, total wall, and
+total **self-time** (duration minus time covered by child spans on the same
+track — the "where did the time actually go" number a nested trace hides);
+plus the top-N self-time ranking and every ``xla_compile`` instant bucketed
+by the span it fired inside (a compile inside ``serve.dispatch`` in steady
+state is a retrace bug — the runtime cousin of ``tools/jaxlint``'s sentry).
+
+Usage::
+
+    python tools/trace_report.py trace.json           # human table
+    python tools/trace_report.py trace.json --json    # machine row
+    python tools/trace_report.py serve.jsonl --top 5
+"""
+
+import argparse
+import json
+import sys
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def load_events(path):
+    """Normalise either trace format to ``(spans, instants)`` where spans are
+    ``{name, ts_us, dur_us, tid}`` and instants ``{name, ts_us, tid, args}``."""
+    with open(path) as fh:
+        first = fh.readline()
+        fh.seek(0)
+        # both formats start with "{": a Chrome doc is ONE object with
+        # "traceEvents" (export_chrome writes it on one line; other
+        # producers pretty-print, making the first line unparseable alone),
+        # a JSONL file is one flat record per line
+        try:
+            doc0 = json.loads(first)
+            is_chrome = isinstance(doc0, dict) and "traceEvents" in doc0
+        except json.JSONDecodeError:
+            is_chrome = True
+        if is_chrome:
+            doc = json.load(fh)
+            raw = doc.get("traceEvents", [])
+        else:  # JSONL: one span/instant record per line
+            raw = []
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                kind = rec.get("kind")
+                if kind not in ("span", "instant"):
+                    continue
+                ev = {"name": rec["name"], "ph": "X" if kind == "span" else "i",
+                      "ts": rec["ts"] * 1e6, "tid": rec.get("tid", 0),
+                      "args": rec.get("args")}
+                if kind == "span":
+                    ev["dur"] = rec.get("dur", 0.0) * 1e6
+                raw.append(ev)
+    spans, instants = [], []
+    for ev in raw:
+        ph = ev.get("ph")
+        if ph == "X":
+            spans.append({"name": ev["name"], "ts_us": float(ev["ts"]),
+                          "dur_us": float(ev.get("dur", 0.0)),
+                          "tid": ev.get("tid", 0)})
+        elif ph == "i":
+            instants.append({"name": ev["name"], "ts_us": float(ev["ts"]),
+                             "tid": ev.get("tid", 0),
+                             "args": ev.get("args") or {}})
+    return spans, instants
+
+
+def _self_times(spans):
+    """Per-span self-time: duration minus the duration of child spans on the
+    same track (direct children only — grandchildren are already subtracted
+    from their own parent).  Containment nesting per tid, the trace-viewer
+    convention."""
+    self_us = [s["dur_us"] for s in spans]
+    by_tid = {}
+    for i, s in enumerate(spans):
+        by_tid.setdefault(s["tid"], []).append(i)
+    # ts and dur are rounded independently at export (0.001 µs), so an
+    # adjacent sibling can appear to start marginally before the previous
+    # span's computed end — the epsilon keeps it a sibling, not a child
+    # (a genuine child overlaps by far more than 10 ns)
+    eps = 0.01
+    for indices in by_tid.values():
+        # start ascending; ties: longest first so the outer span parents
+        indices.sort(key=lambda i: (spans[i]["ts_us"], -spans[i]["dur_us"]))
+        stack = []  # indices of currently-open spans
+        for i in indices:
+            ts = spans[i]["ts_us"]
+            while stack and (spans[stack[-1]]["ts_us"]
+                             + spans[stack[-1]]["dur_us"]) <= ts + eps:
+                stack.pop()
+            if stack:
+                self_us[stack[-1]] -= spans[i]["dur_us"]
+            stack.append(i)
+    return self_us
+
+
+def _enclosing(spans_by_tid, instant):
+    """Name of the innermost span containing the instant on its track (the
+    exporter also tags instants with ``in_span`` at record time — preferred
+    when present, since thread-stack context beats timestamp containment)."""
+    arg = instant["args"].get("in_span")
+    if arg:
+        return arg
+    best, best_dur = None, None
+    for s in spans_by_tid.get(instant["tid"], ()):
+        if s["ts_us"] <= instant["ts_us"] <= s["ts_us"] + s["dur_us"]:
+            if best_dur is None or s["dur_us"] < best_dur:
+                best, best_dur = s["name"], s["dur_us"]
+    return best or "(no span)"
+
+
+def summarize(spans, instants, top=10):
+    """The report dict (``main`` renders it; tests consume it directly)."""
+    self_us = _self_times(spans)
+    by_name = {}
+    for i, s in enumerate(spans):
+        entry = by_name.setdefault(s["name"], {"durs": [], "self_us": 0.0})
+        entry["durs"].append(s["dur_us"])
+        entry["self_us"] += self_us[i]
+    rows = {}
+    for name, entry in by_name.items():
+        durs = sorted(entry["durs"])
+        rows[name] = {
+            "count": len(durs),
+            "p50_ms": round(_percentile(durs, 0.50) / 1e3, 4),
+            "p95_ms": round(_percentile(durs, 0.95) / 1e3, 4),
+            "p99_ms": round(_percentile(durs, 0.99) / 1e3, 4),
+            "max_ms": round(durs[-1] / 1e3, 4),
+            "total_ms": round(sum(durs) / 1e3, 3),
+            "self_ms": round(entry["self_us"] / 1e3, 3),
+        }
+    top_self = sorted(rows, key=lambda n: -rows[n]["self_ms"])[:top]
+    spans_by_tid = {}
+    for s in spans:
+        spans_by_tid.setdefault(s["tid"], []).append(s)
+    compiles = [i for i in instants if i["name"] == "xla_compile"]
+    compile_spans = {}
+    for inst in compiles:
+        where = _enclosing(spans_by_tid, inst)
+        compile_spans[where] = compile_spans.get(where, 0) + 1
+    return {
+        "spans": rows,
+        "top_self": top_self,
+        "n_spans": len(spans),
+        "n_instants": len(instants),
+        "compiles": len(compiles),
+        "compile_spans": compile_spans,
+    }
+
+
+def render(report):
+    rows = report["spans"]
+    name_w = max([len(n) for n in rows] + [4])
+    out = [f"{'span':{name_w}s} {'count':>7s} {'p50ms':>9s} {'p95ms':>9s} "
+           f"{'p99ms':>9s} {'max ms':>9s} {'total ms':>10s} {'self ms':>10s}"]
+    for name in sorted(rows, key=lambda n: -rows[n]["total_ms"]):
+        r = rows[name]
+        out.append(
+            f"{name:{name_w}s} {r['count']:7d} {r['p50_ms']:9.3f} "
+            f"{r['p95_ms']:9.3f} {r['p99_ms']:9.3f} {r['max_ms']:9.3f} "
+            f"{r['total_ms']:10.2f} {r['self_ms']:10.2f}"
+        )
+    out.append("")
+    out.append("top self-time: " + ", ".join(
+        f"{n} ({rows[n]['self_ms']:.2f} ms)" for n in report["top_self"]))
+    out.append(f"xla compiles: {report['compiles']}")
+    for where, n in sorted(report["compile_spans"].items(), key=lambda kv: -kv[1]):
+        out.append(f"  {n:4d} in {where}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace JSON (Tracer.export_chrome) "
+                                  "or tracer JSONL file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="entries in the self-time ranking")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON document")
+    args = ap.parse_args(argv)
+
+    spans, instants = load_events(args.trace)
+    if not spans and not instants:
+        print(f"no trace events in {args.trace}", file=sys.stderr)
+        return 1
+    report = summarize(spans, instants, top=args.top)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
