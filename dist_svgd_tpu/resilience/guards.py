@@ -53,16 +53,42 @@ class GuardConfig:
             which the supervisor snapshots only when this is set.
         backoff_factor: step-size multiplier applied on rollback (the
             supervisor's step-size-backoff policy).
+        max_ksd: trip when the diagnosed kernelized Stein discrepancy
+            exceeds this — the posterior-drift guard.  Evaluated (like the
+            three thresholds below) against the supervisor's periodic
+            :class:`~dist_svgd_tpu.telemetry.diagnostics.
+            PosteriorDiagnostics` report, so it only fires on boundaries
+            where diagnostics ran (and, for KSD, only when a score
+            function is configured).
+        min_ess_frac: trip when kernel-ESS over n falls below this — the
+            particle-collapse guard (score-free).
+        min_dim_var: trip when any dimension's particle variance falls
+            below this — the dead-dimension / mode-collapse guard.
+        max_shard_mean_div: trip when the scale-normalised inter-shard
+            mean divergence exceeds this (``DistSampler`` runs only).
     """
 
     check_finite: bool = True
     max_particle_norm: Optional[float] = None
     max_step_norm: Optional[float] = None
     backoff_factor: float = 0.5
+    max_ksd: Optional[float] = None
+    min_ess_frac: Optional[float] = None
+    min_dim_var: Optional[float] = None
+    max_shard_mean_div: Optional[float] = None
 
     @property
     def needs_prev(self) -> bool:
         return self.max_step_norm is not None
+
+    @property
+    def checks_diagnostics(self) -> bool:
+        """True when any drift/collapse threshold is set — the supervisor
+        then routes diagnostics reports through :func:`check_diagnostics`."""
+        return any(v is not None for v in (
+            self.max_ksd, self.min_ess_frac, self.min_dim_var,
+            self.max_shard_mean_div,
+        ))
 
 
 @jax.jit
@@ -110,4 +136,42 @@ def check_state(particles, prev=None, steps: int = 1,
         raise GuardViolation(
             f"per-step displacement exceeds {config.max_step_norm}", report
         )
+    return report
+
+
+def check_diagnostics(report: dict, config: GuardConfig) -> dict:
+    """Judge a posterior-diagnostics report against the drift/collapse
+    thresholds; returns ``report``, raising :class:`GuardViolation` on the
+    first tripped check.
+
+    ``report`` is a :class:`~dist_svgd_tpu.telemetry.diagnostics.
+    PosteriorDiagnostics` report dict (plain floats).  A statistic absent
+    from the report (e.g. ``ksd`` with no score function, shard divergence
+    on a single-device run) leaves its check inert; every comparison is
+    the NaN-safe ``not <=`` / ``not >=`` form, so a NaN statistic trips
+    instead of comparing False.
+    """
+    ksd = report.get("ksd")
+    if (config.max_ksd is not None and ksd is not None
+            and not ksd <= config.max_ksd):
+        raise GuardViolation(
+            f"posterior drift: ksd exceeds {config.max_ksd}", report)
+    ess_frac = report.get("ess_frac")
+    if (config.min_ess_frac is not None and ess_frac is not None
+            and not ess_frac >= config.min_ess_frac):
+        raise GuardViolation(
+            f"particle collapse: ess_frac below {config.min_ess_frac}",
+            report)
+    min_var = report.get("min_dim_var")
+    if (config.min_dim_var is not None and min_var is not None
+            and not min_var >= config.min_dim_var):
+        raise GuardViolation(
+            f"dimension collapse: min_dim_var below {config.min_dim_var}",
+            report)
+    shard_div = report.get("shard_mean_div")
+    if (config.max_shard_mean_div is not None and shard_div is not None
+            and not shard_div <= config.max_shard_mean_div):
+        raise GuardViolation(
+            f"shard divergence: shard_mean_div exceeds "
+            f"{config.max_shard_mean_div}", report)
     return report

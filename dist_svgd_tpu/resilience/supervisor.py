@@ -44,7 +44,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from dist_svgd_tpu.resilience.faults import FaultPlan, TransientDispatchError
-from dist_svgd_tpu.resilience.guards import GuardConfig, GuardViolation, check_state
+from dist_svgd_tpu.resilience.guards import (
+    GuardConfig,
+    GuardViolation,
+    check_diagnostics,
+    check_state,
+)
+from dist_svgd_tpu.telemetry import diagnostics as _diagnostics
 from dist_svgd_tpu.telemetry import metrics as _metrics
 from dist_svgd_tpu.telemetry import trace as _trace
 from dist_svgd_tpu.utils.checkpoint import CheckpointManager
@@ -125,6 +131,17 @@ class _DistHarness:
     def particles(self):
         return self._s.particles
 
+    @property
+    def num_shards(self) -> int:
+        return self._s._num_shards
+
+    @property
+    def score_fn(self):
+        """No per-θ global score closure: the DistSampler's score is
+        sharded with its data — KSD diagnostics need an explicit
+        ``DiagnosticsConfig.score_fn`` here."""
+        return None
+
     def run_segment(self, k: int, step_size: float) -> None:
         s = self._s
         if s._include_wasserstein and s._wasserstein_solver != "sinkhorn":
@@ -171,6 +188,14 @@ class _SamplerHarness:
         self._bandwidth = None
         if getattr(sampler, "_median_kernel", False):
             self._bandwidth = sampler.freeze_median_kernel(parts)
+
+    num_shards = 1
+
+    @property
+    def score_fn(self):
+        """The sampler's own full-data score closure ``θ ↦ ∇log p(θ)`` —
+        exactly what the KSD diagnostic needs."""
+        return self._s._score_fn
 
     def run_segment(self, k: int, step_size: float) -> None:
         final, _ = self._s.run(
@@ -250,6 +275,22 @@ class RunSupervisor:
             span, with retries, guard trips, rollbacks, and preemptions as
             instant events — the training half of the serving path's
             request-span story.
+        diagnostics: :class:`~dist_svgd_tpu.telemetry.diagnostics.
+            PosteriorDiagnostics` — computed on the carried particle array
+            at the first segment boundary at or past each
+            ``every_steps`` multiple (plus the final boundary), with the
+            single-device sampler's own score closure wired in for KSD
+            when the config has none.  When the :class:`GuardConfig` sets
+            drift/collapse thresholds (``max_ksd``, ``min_ess_frac``,
+            ``min_dim_var``, ``max_shard_mean_div``) each report is judged
+            by ``guards.check_diagnostics`` and a violation takes the
+            SAME rollback + step-size-backoff path as the numerical
+            guards.  ``None`` holds the shared no-op (zero cost).
+        recorder: :class:`~dist_svgd_tpu.telemetry.trace.FlightRecorder`
+            for postmortem bundles; default: whatever recorder is
+            installed process-wide (``telemetry.install_flight_recorder``)
+            at dump time.  A bundle is dumped when a guard trips, a
+            non-retryable fault fires, or the restart budget exhausts.
     """
 
     def __init__(
@@ -271,6 +312,8 @@ class RunSupervisor:
         sleep: Callable[[float], None] = time.sleep,
         slow_segment_warn_s: Optional[float] = None,
         registry: Optional[_metrics.MetricsRegistry] = None,
+        diagnostics=None,
+        recorder=None,
         n: Optional[int] = None,
         seed=0,
         initial_particles=None,
@@ -343,6 +386,14 @@ class RunSupervisor:
             "svgd_train_segment_seconds", "wall per training segment")
         self._m_steps = reg.counter(
             "svgd_train_steps_total", "SVGD steps completed under supervision")
+        if diagnostics is not None and diagnostics.enabled:
+            # a Sampler's own score closure feeds KSD unless the config
+            # already names one (DistSampler harnesses contribute none)
+            diagnostics.ensure_score_fn(self._harness.score_fn)
+        self._diag = diagnostics if diagnostics is not None else _diagnostics.DISABLED
+        self._diag_last_t = 0
+        self._diag_run_report = None
+        self._recorder = recorder
         #: Report of the most recent :meth:`run` call.
         self.report: Optional[dict] = None
 
@@ -440,8 +491,47 @@ class RunSupervisor:
         t_bad = self._harness.t
         t_good, state = self._last_good
         self._harness.load_state_dict(state)
+        # replayed boundaries must re-run diagnostics: a drift guard that
+        # tripped here has to be re-judged on the replayed trajectory
+        self._diag_last_t = min(self._diag_last_t, t_good)
         _trace.instant("train.rollback", {"from_t": t_bad, "to_t": t_good})
         self._log(event="rollback", from_t=t_bad, to_t=t_good)
+
+    def _diag_due(self, t: int) -> bool:
+        """Diagnostics cadence on the boundary grid: fire at the first
+        boundary at or past each ``every_steps`` multiple (boundaries need
+        not be multiples themselves), plus the final boundary."""
+        if not self._diag.enabled:
+            return False
+        k = self._diag.config.every_steps
+        return (t // k > self._diag_last_t // k) or t >= self.num_steps
+
+    def _flight(self, kind: str, **fields) -> None:
+        """Ring-buffer record into the effective flight recorder (explicit
+        arg, else the process-wide one); no-op when neither exists."""
+        rec = (self._recorder if self._recorder is not None
+               else _trace.flight_recorder())
+        if rec is not None:
+            rec.record(kind, **fields)
+
+    def _postmortem(self, reason: str, **context) -> Optional[str]:
+        """Dump a flight-recorder bundle (explicit ``recorder`` arg, else
+        the process-wide one); ``None`` when no recorder is installed.  A
+        failing dump is swallowed — it must never mask the real failure."""
+        rec = (self._recorder if self._recorder is not None
+               else _trace.flight_recorder())
+        if rec is None:
+            return None
+        try:
+            path = rec.dump(reason, {
+                "t": self._harness.t, "step_size": self.step_size,
+                "restarts": self._restarts, "kind": self._harness.kind,
+                **context,
+            })
+        except Exception:
+            return None
+        self._log(event="postmortem", reason=reason, path=path)
+        return path
 
     def _spend_restart(self, err: BaseException) -> None:
         self._restarts += 1
@@ -450,6 +540,10 @@ class RunSupervisor:
             self._log(event="restart_budget_exhausted", t=self._harness.t,
                       restarts=self._restarts - 1,
                       error=f"{type(err).__name__}: {err}")
+            self._flight("restart_budget_exhausted", t=self._harness.t,
+                         error=f"{type(err).__name__}: {err}")
+            self._postmortem("restart_budget_exhausted",
+                             error=f"{type(err).__name__}: {err}")
             raise RestartBudgetExhausted(
                 f"restart budget ({self._retry.max_restarts}) exhausted at "
                 f"step {self._harness.t}: {type(err).__name__}: {err}",
@@ -482,6 +576,8 @@ class RunSupervisor:
         self._log(event="guard_violation", t=self._harness.t,
                   reason=err.reason, **err.report,
                   step_size=old_eps, new_step_size=self.step_size)
+        self._flight("guard_violation", t=self._harness.t, reason=err.reason)
+        self._postmortem("guard_violation", guard_reason=err.reason)
         self._rollback()
 
     # ------------------------------------------------------------------ #
@@ -536,6 +632,12 @@ class RunSupervisor:
         elif self._manager is not None:
             self._manager.clear()
         start_t = self._harness.t
+        self._diag_last_t = start_t
+        # only a report computed during THIS run may land in its report
+        # dict: the diagnostics instance is shareable (the fault drill
+        # reuses one across phases) and a run preempted before its first
+        # cadence boundary must not inherit another run's numbers
+        self._diag_run_report = None
         self._last_good = (start_t, self._state_with_meta())
         if self._manager is not None and resumed_from is None:
             # a step-`start` baseline: retry/guard rollback and a very
@@ -574,6 +676,16 @@ class RunSupervisor:
             except self._retry.retryable as e:
                 self._handle_transient(e)
                 continue
+            except Exception as e:
+                # non-retryable fault (a simulated hard kill, a crash
+                # outside the retry set): dump the black box, then
+                # propagate unhandled — by design this is the no-cleanup
+                # crash the next run(resume=True) recovers from
+                self._flight("fault", t=self._harness.t,
+                             error=f"{type(e).__name__}: {e}")
+                self._postmortem("fault",
+                                 error=f"{type(e).__name__}: {e}")
+                raise
             seg_wall = self._clock() - seg0
             self._seg_wall_s += seg_wall
             self._max_seg_wall_s = max(self._max_seg_wall_s, seg_wall)
@@ -594,6 +706,20 @@ class RunSupervisor:
                 except GuardViolation as e:
                     self._handle_guard(e)
                     continue
+            t_now = self._harness.t
+            if self._diag_due(t_now):
+                d_report = self._diag.compute(
+                    self._harness.particles,
+                    num_shards=self._harness.num_shards, step=t_now)
+                self._diag_last_t = t_now
+                self._diag_run_report = d_report
+                if (d_report is not None and self._guard is not None
+                        and self._guard.checks_diagnostics):
+                    try:
+                        check_diagnostics(d_report, self._guard)
+                    except GuardViolation as e:
+                        self._handle_guard(e)
+                        continue
             self._consecutive_failures = 0
             self._m_steps.inc(k)
             self._log(event="segment", t=self._harness.t, steps=k,
@@ -630,6 +756,7 @@ class RunSupervisor:
             "checkpoint_overhead_frac": round(
                 self._ckpt_wall_s / self._seg_wall_s, 4
             ) if self._seg_wall_s > 0 else 0.0,
+            "last_diagnostics": self._diag_run_report,
         }
         self._log(event=status, **{k: v for k, v in self.report.items()
                                    if k != "status"})

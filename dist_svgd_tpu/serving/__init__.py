@@ -17,19 +17,31 @@ turns a checkpointed ensemble into a low-latency prediction service:
   one fused device call over the whole ensemble, scatters results back
   per-request, sheds on overflow instead of queueing unboundedly;
 - :mod:`server`  — a thin stdlib HTTP front end (``/predict``, ``/healthz``,
-  ``/metrics``) with graceful drain and structured per-request records.
+  ``/metrics``, ``/slo``) with graceful drain and structured per-request
+  records.
+
+Reload admission: an engine built with a ``telemetry.diagnostics.
+ReloadPolicy`` health-checks every hot-reload candidate (kernel ESS,
+collapse indicators) and raises :class:`EnsembleRejected` instead of
+swapping in a regressed ensemble — the reloader then keeps serving the
+previous generation.
 
 The load generator lives in ``tools/serve_bench.py``; the covertype
 train → checkpoint → serve demo in ``experiments/serve_covertype.py``.
 """
 
 from dist_svgd_tpu.serving.batcher import MicroBatcher, Overloaded
-from dist_svgd_tpu.serving.engine import CheckpointHotReloader, PredictiveEngine
+from dist_svgd_tpu.serving.engine import (
+    CheckpointHotReloader,
+    EnsembleRejected,
+    PredictiveEngine,
+)
 from dist_svgd_tpu.serving.server import PredictionServer
 
 __all__ = [
     "PredictiveEngine",
     "CheckpointHotReloader",
+    "EnsembleRejected",
     "MicroBatcher",
     "Overloaded",
     "PredictionServer",

@@ -46,6 +46,18 @@ _LOG_2PI = math.log(2.0 * math.pi)
 MODELS = ("logreg", "bnn", "gmm")
 
 
+class EnsembleRejected(RuntimeError):
+    """A hot reload was refused: the candidate ensemble's diagnostics
+    regressed past the engine's :class:`~dist_svgd_tpu.telemetry.
+    diagnostics.ReloadPolicy` thresholds.  ``reasons`` lists the failed
+    checks; ``report`` carries the candidate's health statistics."""
+
+    def __init__(self, reasons, report):
+        super().__init__("ensemble rejected: " + "; ".join(reasons))
+        self.reasons = list(reasons)
+        self.report = report
+
+
 def bucket_for(rows: int, min_bucket: int) -> int:
     """Smallest power-of-two ≥ ``rows``, clamped up to ``min_bucket``."""
     if rows <= 0:
@@ -87,6 +99,14 @@ class PredictiveEngine:
         registry: ``telemetry.MetricsRegistry`` for the compile-cache
             hit/miss/reload counters (default: the process-wide registry).
             :meth:`stats` keeps per-instance counts alongside.
+        reload_policy: optional :class:`~dist_svgd_tpu.telemetry.
+            diagnostics.ReloadPolicy` — every :meth:`reload` candidate is
+            health-checked (score-free ensemble diagnostics: kernel ESS,
+            collapse indicators) against absolute floors and the
+            currently-served ensemble's numbers; a regressed candidate
+            raises :class:`EnsembleRejected` (and dumps a flight-recorder
+            postmortem when one is installed) instead of being swapped in
+            — a diverged training run cannot silently poison serving.
     """
 
     def __init__(
@@ -102,6 +122,7 @@ class PredictiveEngine:
         min_bucket: int = 8,
         max_bucket: int = 4096,
         registry: Optional[_metrics.MetricsRegistry] = None,
+        reload_policy=None,
     ):
         if model not in MODELS:
             raise ValueError(f"unknown model {model!r}; expected one of {MODELS}")
@@ -166,6 +187,14 @@ class PredictiveEngine:
             "padding-bucket kernel-cache misses (one XLA trace each)")
         self._m_reloads = reg.counter(
             "svgd_engine_reloads_total", "hot ensemble swaps")
+        self._m_reload_rejects = reg.counter(
+            "svgd_engine_reload_rejected_total",
+            "hot reloads refused by the ensemble-health policy")
+        self._reload_policy = reload_policy
+        self._reload_rejects = 0
+        # served ensemble's health baseline (computed lazily at the first
+        # policied reload; refreshed on every admitted swap)
+        self._health_report: Optional[Dict[str, Any]] = None
         self._ensemble_tag: Optional[str] = None
         #: Manager-root step this ensemble was cold-started from (set by
         #: :meth:`from_checkpoint`; ``None`` for direct/array construction).
@@ -399,6 +428,39 @@ class PredictiveEngine:
                 f"reload particles {particles.shape} incompatible with the "
                 f"served layout (n, {self._particles.shape[1]})"
             )
+        new_report = None
+        if self._reload_policy is not None:
+            new_report = self._reload_policy.evaluate(particles)
+            if self._health_report is None:
+                # first policied reload: baseline the ensemble currently
+                # serving (off the request path; reload already is)
+                baseline = self._reload_policy.evaluate(self._particles)
+                with self._lock:
+                    if self._health_report is None:
+                        self._health_report = baseline
+            reasons = self._reload_policy.judge(new_report,
+                                                self._health_report)
+            if reasons:
+                with self._lock:
+                    self._reload_rejects += 1
+                self._m_reload_rejects.inc()
+                _trace.instant("engine.reload_rejected", {"tag": tag})
+                rec = _trace.flight_recorder()
+                if rec is not None:
+                    try:
+                        rec.record("reload_rejected", tag=tag,
+                                   reasons=reasons, **new_report)
+                        rec.dump("reload_rejected",
+                                 {"tag": tag, "reasons": reasons,
+                                  "candidate": new_report,
+                                  "baseline": self._health_report})
+                    except Exception:
+                        # a failing dump (unwritable dir, full disk) must
+                        # not replace EnsembleRejected — the hot reloader
+                        # only handles that one (the supervisor's
+                        # _postmortem discipline)
+                        pass
+                raise EnsembleRejected(reasons, new_report)
         new_kernels: Dict[int, Any] = {}
         with self._lock:
             buckets = sorted(self._kernels)
@@ -423,6 +485,8 @@ class PredictiveEngine:
                     self._kernels = new_kernels
                     self._reloads += 1
                     self._ensemble_tag = tag
+                    if new_report is not None:
+                        self._health_report = new_report
                     break
                 buckets = missing
         self._m_reloads.inc()
@@ -441,7 +505,9 @@ class PredictiveEngine:
                 "bucket_misses": self._misses,
                 "compiled_buckets": sorted(self._kernels),
                 "reloads": self._reloads,
+                "reload_rejects": self._reload_rejects,
                 "ensemble_tag": self._ensemble_tag,
+                "ensemble_health": self._health_report,
             }
 
 
@@ -515,7 +581,18 @@ class CheckpointHotReloader:
                 f"checkpoint step_{step} has no {self._key!r} entry "
                 f"(keys: {sorted(state)})"
             )
-        info = self.engine.reload(np.asarray(arr), tag=f"step_{step}")
+        try:
+            info = self.engine.reload(np.asarray(arr), tag=f"step_{step}")
+        except EnsembleRejected as e:
+            # the engine's health policy refused this generation: keep
+            # serving the current one, but mark the step seen so the
+            # poller doesn't re-evaluate the same bad checkpoint forever
+            # (a later, healthier step will be picked up normally)
+            self.loaded_step = step
+            if self._logger is not None:
+                self._logger.log(event="hot_reload_rejected", step=step,
+                                 reasons=e.reasons)
+            return None
         self.loaded_step = step
         if self._logger is not None:
             self._logger.log(event="hot_reload", step=step, **info)

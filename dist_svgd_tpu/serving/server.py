@@ -1,6 +1,6 @@
 """Thin stdlib HTTP front end over the engine + batcher.
 
-JSON in/out, four routes:
+JSON in/out, five routes:
 
 - ``POST /predict``      — ``{"inputs": [[...], ...]}`` → the engine's
   output dict as lists, plus this request's latency split;
@@ -10,7 +10,11 @@ JSON in/out, four routes:
   latency histograms, engine bucket-cache counters — scrape it);
 - ``GET  /metrics.json`` — the legacy JSON aggregate (the batcher's
   bounded-window percentiles, the engine's ``stats()``, the server's
-  request/error counts) for humans and tests.
+  request/error counts) for humans and tests;
+- ``GET  /slo``          — the declarative SLO engine's evaluation
+  (``telemetry/slo.py``): burn rates for the serve-p99 / shed-rate /
+  dispatch-error objectives over the window since the last ``/slo`` poll,
+  ``status`` ``ok``/``breach`` at the top.
 
 No framework dependency by design: the container bakes only the jax_graft
 toolchain, and the request path is one ``json.loads`` + a batcher future —
@@ -60,6 +64,8 @@ class PredictionServer:
         logger=None,
         batcher: Optional[MicroBatcher] = None,
         registry: Optional[_metrics.MetricsRegistry] = None,
+        slo=None,
+        slo_p99_ms: float = 100.0,
     ):
         self.engine = engine
         self.registry = (registry if registry is not None
@@ -81,6 +87,13 @@ class PredictionServer:
             "svgd_http_requests_total", "HTTP requests by route and status")
         self._m_http_latency = self.registry.histogram(
             "svgd_http_request_seconds", "handler wall per /predict request")
+        if slo is None:
+            from dist_svgd_tpu.telemetry.slo import default_serving_slos
+
+            slo = default_serving_slos(self.registry, p99_ms=slo_p99_ms)
+        #: The declarative SLO engine served at ``/slo`` (pass ``slo=`` to
+        #: replace the default serve-p99/shed/error objective set).
+        self.slo_engine = slo
         self._started = time.time()
 
         server = self  # close over for the handler class
@@ -122,6 +135,8 @@ class PredictionServer:
                     )
                 elif self.path == "/metrics.json":
                     self._reply(200, server.metrics())
+                elif self.path == "/slo":
+                    self._reply(200, server.slo_engine.evaluate())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 

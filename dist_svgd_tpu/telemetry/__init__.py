@@ -1,6 +1,7 @@
-"""Unified telemetry: span tracing + metrics registry.
+"""Unified telemetry: span tracing, metrics registry, posterior
+diagnostics, SLOs, and the crash flight recorder.
 
-One layer, two complementary views of the same running system:
+One layer, four complementary views of the same running system:
 
 - :mod:`~dist_svgd_tpu.telemetry.metrics` — thread-safe **registry** of
   counters / gauges / histograms (fixed log-spaced latency buckets) with
@@ -8,10 +9,20 @@ One layer, two complementary views of the same running system:
 - :mod:`~dist_svgd_tpu.telemetry.trace` — **span tracer**: nestable
   thread-aware spans with optional device fencing, request lane trees,
   XLA-compile instant events; zero-cost no-op while disabled; exports
-  Chrome trace-event JSON (Perfetto) and JSONL.  Summarise a trace with
-  ``tools/trace_report.py``.
+  Chrome trace-event JSON (Perfetto) and JSONL.  Also home of the
+  **flight recorder** — a bounded black box that dumps a postmortem
+  bundle when a guard trips or a fault fires (``tools/trace_report.py
+  --postmortem`` renders it).
+- :mod:`~dist_svgd_tpu.telemetry.diagnostics` — **posterior health**:
+  jitted, chunk-safe on-device statistics (kernelized Stein discrepancy,
+  kernel ESS, collapse indicators, inter-shard divergence) computed every
+  K supervised steps and flowed into the registry as ``svgd_diag_*``
+  gauges.
+- :mod:`~dist_svgd_tpu.telemetry.slo` — **declarative SLOs** (burn rates
+  over the registry's histogram windows, gauge ceilings, staleness);
+  the serving server exposes the evaluation at ``/slo``.
 
-Quickstart (see README "Observability")::
+Quickstart (see README "Observability" and "Posterior health")::
 
     from dist_svgd_tpu import telemetry
 
@@ -31,14 +42,19 @@ from dist_svgd_tpu.telemetry.metrics import (
     default_registry,
 )
 from dist_svgd_tpu.telemetry.trace import (
+    FlightRecorder,
     SpanHandle,
     Tracer,
     disable,
     enable,
     enabled,
+    flight_recorder,
     get_tracer,
+    install_flight_recorder,
     instant,
+    record_flight,
     span,
+    uninstall_flight_recorder,
 )
 
 __all__ = [
@@ -48,12 +64,60 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "default_registry",
+    "FlightRecorder",
     "SpanHandle",
     "Tracer",
     "disable",
     "enable",
     "enabled",
+    "flight_recorder",
     "get_tracer",
+    "install_flight_recorder",
     "instant",
+    "record_flight",
     "span",
+    "uninstall_flight_recorder",
+    # lazy (jax-importing) modules — resolved on first attribute access
+    "DiagnosticsConfig",
+    "PosteriorDiagnostics",
+    "ReloadPolicy",
+    "ensemble_health",
+    "SloEngine",
+    "LatencyObjective",
+    "RatioObjective",
+    "GaugeCeiling",
+    "StalenessObjective",
+    "default_serving_slos",
+    "default_training_slos",
 ]
+
+_LAZY = {
+    "DiagnosticsConfig": "diagnostics",
+    "PosteriorDiagnostics": "diagnostics",
+    "ReloadPolicy": "diagnostics",
+    "ensemble_health": "diagnostics",
+    "SloEngine": "slo",
+    "LatencyObjective": "slo",
+    "RatioObjective": "slo",
+    "GaugeCeiling": "slo",
+    "StalenessObjective": "slo",
+    "default_serving_slos": "slo",
+    "default_training_slos": "slo",
+}
+
+
+def __getattr__(name):
+    """PEP 562 lazy re-exports: the diagnostics module imports jax (and
+    the kernel ops) at module load — deferring keeps ``import
+    dist_svgd_tpu.telemetry`` as light as PR 5 left it for consumers that
+    only want the registry or tracer."""
+    submodule = _LAZY.get(name)
+    if submodule is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f"{__name__}.{submodule}")
+    value = getattr(mod, name)
+    globals()[name] = value  # cache: subsequent lookups skip __getattr__
+    return value

@@ -1,0 +1,586 @@
+"""On-device posterior health diagnostics: KSD, kernel ESS, collapse and
+shard-divergence indicators.
+
+PR 5's telemetry observes the *system* (latency, queue depth, compiles);
+nothing observes whether the *posterior* is healthy.  SVGD with the paper's
+fixed-bandwidth RBF kernel can fail silently in ways no NaN check sees:
+particles collapse onto each other (the kernel repulsion term underpowered
+for the step size), the trajectory stalls far from the target, or — in the
+distributed modes — shards drift apart while each one looks locally fine.
+This module computes cheap, jitted statistics on the particle array already
+resident on the device, every K supervised steps:
+
+- **Kernelized Stein discrepancy** (Liu, Lee & Jordan 2016 — the
+  goodness-of-fit companion to SVGD's Liu & Wang 2016): the U-statistic
+  ``KSD² = 1/(n(n−1)) Σ_{i≠j} u_p(x_i, x_j)`` with the repo's RBF
+  convention ``k(x,y) = exp(−‖x−y‖²/h)`` expanded in closed form
+  (``β = 2/h``)::
+
+      u_p(x,y) = k(x,y)·[ ⟨s_x,s_y⟩ + β⟨s_x−s_y, x−y⟩ + βd − β²‖x−y‖² ]
+
+  where ``s_x = ∇log p(x)`` — the same analytic-RBF pieces the φ update
+  uses (:mod:`dist_svgd_tpu.ops.kernels`), so no new kernel machinery and
+  no ``(n, n, d)`` tensor is ever materialised.  KSD → 0 iff the particle
+  measure converges to ``p`` (under the usual conditions), making it the
+  one scalar that distinguishes "converged" from "collapsed" — a collapsed
+  set has tiny φ updates *and* a large KSD.
+- **Kernel-matrix effective sample size**: the participation ratio
+  ``ESS = (tr K)² / ‖K‖_F² = n² / Σᵢⱼ Kᵢⱼ²`` of the Gram matrix —
+  ``n`` for well-spread particles (K ≈ I), 1 for a fully collapsed set
+  (K ≈ 𝟙𝟙ᵀ).  Score-free, so it also guards *serving-side* reloads where
+  no ∇log p is available (:class:`ReloadPolicy`).
+- **Collapse indicators**: min pairwise distance (exact over all pairs),
+  median pairwise distance (sort-free counting bracket on a strided
+  subsample — :func:`dist_svgd_tpu.ops.kernels._median_bracket`, the
+  adaptive-bandwidth machinery reused), and the per-dimension variance
+  floor (one dead dimension = mode collapse the global norm hides).
+- **Inter-shard divergence** (``DistSampler``): max over shards of the
+  scale-normalised mean / variance discrepancy between a shard's particle
+  block and the global set — exchange bugs and shard-local divergence show
+  up here steps before anything trips a NaN guard.
+
+Everything pairwise is **chunk-safe**: an ``(n, n)`` interaction is
+evaluated as a ``lax.scan`` over fixed-size row blocks against the full
+column set (rows padded to the chunk lattice with zero-weight masks), so a
+2M-particle diagnostic costs ``row_chunk × n`` live memory, never ``n²``.
+All functions are jitted once per (shape, dtype, chunk) — zero steady-state
+recompiles, pinned by ``tests/test_diagnostics.py`` under the retrace
+sentry.
+
+Results flow into the PR 5 :class:`~dist_svgd_tpu.telemetry.metrics.
+MetricsRegistry` as ``svgd_diag_*`` gauges, are emitted as
+``train.diagnostics`` spans while the tracer is enabled, and are handed to
+the flight recorder (:mod:`~dist_svgd_tpu.telemetry.trace`) so a postmortem
+bundle carries the last posterior health picture.  When disabled the
+supervisor holds the shared no-op singleton (:data:`DISABLED`) — no
+allocation, no clock read, the tracer's zero-cost discipline
+(tracemalloc-pinned in ``tests/test_diagnostics.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dist_svgd_tpu.ops.kernels import (
+    _median_bracket,
+    median_bandwidth_approx,
+    squared_distances,
+)
+from dist_svgd_tpu.telemetry import metrics as _metrics
+from dist_svgd_tpu.telemetry import trace as _trace
+
+__all__ = [
+    "DiagnosticsConfig",
+    "PosteriorDiagnostics",
+    "ReloadPolicy",
+    "DISABLED",
+    "ensemble_health",
+]
+
+_HIGH = jax.lax.Precision.HIGHEST
+
+
+def _chunk_layout(n: int, row_chunk: int):
+    """Static row-chunk lattice: ``(chunk, n_chunks, pad)`` with
+    ``n_chunks · chunk = n + pad``."""
+    c = max(1, min(int(row_chunk), n))
+    nc = -(-n // c)
+    return c, nc, nc * c - n
+
+
+def _scan_pair_blocks(particles, scores, h, row_chunk):
+    """One chunked pass over the ``(n, n)`` pairwise interaction.
+
+    Returns ``(sum_u, sum_k2, min_offdiag_sq)`` where ``sum_u`` is the
+    all-pairs (diagonal included) Stein-kernel sum — ``None`` when
+    ``scores`` is ``None`` — and the other two are score-free.  Rows are
+    scanned in fixed blocks against the full column set; padded rows carry
+    zero weight, so the result is exactly the unchunked sum.
+    """
+    n, d = particles.shape
+    dt = particles.dtype
+    beta = 2.0 / h
+    c, nc, pad = _chunk_layout(n, row_chunk)
+    xp = jnp.pad(particles, ((0, pad), (0, 0)))
+    wp = jnp.pad(jnp.ones((n,), dt), (0, pad))
+    cols = jnp.arange(n)
+    with_u = scores is not None
+    if with_u:
+        sp = jnp.pad(scores, ((0, pad), (0, 0)))
+        s_dot_x_cols = jnp.sum(scores * particles, axis=-1)  # (n,)
+
+    def body(carry, blk):
+        sum_u, sum_k2, min_sq = carry
+        if with_u:
+            xb, sb, wb, off = blk
+        else:
+            xb, wb, off = blk
+        sq = squared_distances(xb, particles)  # (c, n)
+        k = jnp.exp(-sq / h)
+        w = wb[:, None]
+        sum_k2 = sum_k2 + jnp.sum(k * k * w)
+        if with_u:
+            ss = jnp.matmul(sb, scores.T, precision=_HIGH)
+            sxr = (jnp.sum(sb * xb, axis=-1)[:, None]
+                   - jnp.matmul(sb, particles.T, precision=_HIGH))
+            syr = (jnp.matmul(xb, scores.T, precision=_HIGH)
+                   - s_dot_x_cols[None, :])
+            u = k * (ss + beta * (sxr - syr) + beta * d - beta * beta * sq)
+            sum_u = sum_u + jnp.sum(u * w)
+        rows = off + jnp.arange(c)
+        offdiag = (cols[None, :] != rows[:, None]) & (w > 0)
+        min_sq = jnp.minimum(
+            min_sq, jnp.min(jnp.where(offdiag, sq, jnp.inf))
+        )
+        return (sum_u, sum_k2, min_sq), None
+
+    init = (jnp.zeros((), dt) if with_u else None,
+            jnp.zeros((), dt), jnp.asarray(jnp.inf, dt))
+    xs = xp.reshape(nc, c, d)
+    ws = wp.reshape(nc, c)
+    offs = jnp.arange(nc) * c
+    blocks = (xs, sp.reshape(nc, c, d), ws, offs) if with_u else (xs, ws, offs)
+    (sum_u, sum_k2, min_sq), _ = lax.scan(body, init, blocks)
+    return sum_u, sum_k2, min_sq
+
+
+def _resolve_bandwidth(particles, bandwidth, median_bw: bool):
+    if median_bw:
+        return median_bandwidth_approx(particles)
+    return jnp.asarray(bandwidth, particles.dtype)
+
+
+#: Row cap for the median-distance bracket inside the pairwise pass — the
+#: bracket's four broadcast-compare passes dominate everything else above
+#: this, and a median order statistic stabilises far below it.
+MEDIAN_DIST_POINTS = 256
+
+
+def _median_dist(particles):
+    """Median pairwise distance over a further-capped strided slice: the
+    sort-free counting bracket (``ops.kernels._median_bracket``) at 8
+    probes — resolution 8⁻⁴ of the distance range, plenty for a health
+    gauge at a fraction of the 16-probe bandwidth estimator's cost."""
+    p0 = particles.shape[0]
+    if p0 > MEDIAN_DIST_POINTS:
+        particles = particles[::-(-p0 // MEDIAN_DIST_POINTS)]
+    p = particles.shape[0]
+    sq = squared_distances(particles, particles)
+    # the p diagonal zeros are below any positive threshold: add them to
+    # the target rank instead of masking (median_bandwidth_approx's trick)
+    target = p + (p * p - p + 1) // 2
+    return jnp.sqrt(_median_bracket(sq, target, 8))
+
+
+@partial(jax.jit, static_argnames=("row_chunk", "median_bw"))
+def _ksd_stats(particles, scores, bandwidth, row_chunk, median_bw):
+    """One fused dispatch: KSD² (U-statistic) + kernel ESS + min/median
+    pairwise distance, chunked.  Fused deliberately — the diagnostics
+    cadence pays per-dispatch latency plus a host sync per call, which on
+    a ``max_points``-bounded subsample costs more than the statistics
+    themselves."""
+    n, d = particles.shape
+    h = _resolve_bandwidth(particles, bandwidth, median_bw)
+    sum_u, sum_k2, min_sq = _scan_pair_blocks(particles, scores, h, row_chunk)
+    beta = 2.0 / h
+    diag_u = jnp.sum(scores * scores) + n * beta * d  # u(x, x) summed
+    ksd_sq = (sum_u - diag_u) / (n * (n - 1))
+    return {
+        "ksd_sq": ksd_sq,
+        "ksd": jnp.sqrt(jnp.maximum(ksd_sq, 0.0)),
+        "ess": (n * n) / sum_k2,
+        "min_pairwise_dist": jnp.sqrt(min_sq),
+        "median_pairwise_dist": _median_dist(particles),
+        "bandwidth": h,
+    }
+
+
+@partial(jax.jit, static_argnames=("row_chunk", "median_bw"))
+def _kernel_stats(particles, bandwidth, row_chunk, median_bw):
+    """Score-free twin of :func:`_ksd_stats` (no KSD term)."""
+    n, _ = particles.shape
+    h = _resolve_bandwidth(particles, bandwidth, median_bw)
+    _, sum_k2, min_sq = _scan_pair_blocks(particles, None, h, row_chunk)
+    return {
+        "ess": (n * n) / sum_k2,
+        "min_pairwise_dist": jnp.sqrt(min_sq),
+        "median_pairwise_dist": _median_dist(particles),
+        "bandwidth": h,
+    }
+
+
+@jax.jit
+def _dim_var_stats(particles):
+    """Per-dimension variance floor — O(nd), over the full set.  Only
+    dispatched on single-shard runs: :func:`_shard_stats` folds it in
+    (the global variance is on its path anyway)."""
+    return jnp.min(jnp.var(particles, axis=0))
+
+
+@partial(jax.jit, static_argnames=("num_shards",))
+def _shard_stats(particles, num_shards):
+    """Scale-normalised divergence of each contiguous shard block from the
+    global particle set (the samplers' block layout: shard s owns rows
+    ``[s·per, (s+1)·per)``)."""
+    n, d = particles.shape
+    blocks = particles.reshape(num_shards, n // num_shards, d)
+    mu = jnp.mean(blocks, axis=1)           # (S, d)
+    var = jnp.var(blocks, axis=1)           # (S, d)
+    gmu = jnp.mean(particles, axis=0)
+    gvar = jnp.var(particles, axis=0)
+    scale = jnp.sqrt(jnp.sum(gvar)) + 1e-12
+    return {
+        "shard_mean_div": jnp.max(
+            jnp.linalg.norm(mu - gmu[None, :], axis=1)) / scale,
+        "shard_var_div": jnp.max(
+            jnp.linalg.norm(var - gvar[None, :], axis=1))
+        / (jnp.sum(gvar) + 1e-12),
+        # the variance floor rides along: gvar is already computed here,
+        # saving the single-shard path's separate dispatch
+        "min_dim_var": jnp.min(gvar),
+    }
+
+
+def _subsample(particles, max_points: int):
+    """Evenly-strided row subsample (the median-bandwidth discipline: an
+    O(n²) statistic over more than ``max_points`` rows costs more than the
+    step it observes), pulled onto ONE device.
+
+    The full array may be mesh-sharded (``DistSampler``); the O(n)
+    statistics stay on that layout, but an O(rows²) pairwise pass over a
+    ``max_points``-bounded subsample gains nothing from sharding — and on
+    the emulated CPU mesh every cross-device elementwise op costs more
+    than the whole statistic.  The gather moves at most
+    ``max_points × d`` floats.
+    """
+    n = particles.shape[0]
+    if n > max_points:
+        stride = -(-n // max_points)
+        particles = particles[::stride]
+    try:
+        spread = len(particles.sharding.device_set) > 1
+    except AttributeError:  # non-Array input (numpy) — already local
+        spread = False
+    if spread:
+        particles = jax.device_put(particles, jax.devices()[0])
+    return particles
+
+
+@dataclass
+class DiagnosticsConfig:
+    """What to compute, how often, and at what cost ceiling.
+
+    Args:
+        every_steps: compute at supervised-step multiples of this (the
+            supervisor only checks at segment boundaries, so the effective
+            cadence is the first boundary at or past each multiple).
+        bandwidth: RBF bandwidth ``h`` for KSD/ESS — a float, or
+            ``'median'`` to re-resolve via the sort-free median heuristic
+            (:func:`~dist_svgd_tpu.ops.kernels.median_bandwidth_approx`)
+            inside the same jitted program on every compute.
+        row_chunk: pairwise row-block size — live memory is
+            ``row_chunk × rows``, never ``rows²``.
+        max_points: cap on the rows entering any O(rows²) statistic (KSD,
+            ESS, min/median pairwise distance): past it an evenly-strided
+            subsample is evaluated instead (the ``median_bandwidth``
+            discipline — a diagnostic must cost less than the steps it
+            observes).  Per-dim variance and shard divergence always use
+            the full set (they are O(n·d)).  ``ess_frac`` is ESS over the
+            *evaluated* rows, so thresholds stay comparable across caps.
+        score_fn: ``θ ↦ ∇log p(θ)`` for the KSD term.  ``None`` skips KSD
+            (ESS/collapse/shard stats are score-free).  The supervisor
+            fills this from a single-device ``Sampler``'s own score closure
+            when left unset.
+    """
+
+    every_steps: int = 50
+    bandwidth: Union[float, str] = 1.0
+    row_chunk: int = 1024
+    max_points: int = 1024
+    score_fn: Optional[Callable] = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self.every_steps < 1:
+            raise ValueError(
+                f"every_steps must be >= 1, got {self.every_steps}")
+        if self.bandwidth != "median" and not float(self.bandwidth) > 0:
+            raise ValueError(f"bandwidth must be positive or 'median', "
+                             f"got {self.bandwidth}")
+        if self.row_chunk < 1:
+            raise ValueError(f"row_chunk must be >= 1, got {self.row_chunk}")
+        if self.max_points < 2:
+            raise ValueError(f"max_points must be >= 2, got {self.max_points}")
+
+
+class _NoopDiagnostics:
+    """Disabled-path singleton: the supervisor's per-boundary check is one
+    attribute load + a constant-returning method — no allocation, no clock
+    read (tracemalloc-pinned, the tracer's discipline)."""
+
+    __slots__ = ()
+    enabled = False
+    last_report = None
+
+    def should_run(self, t):
+        return False
+
+    def compute(self, particles, scores=None, num_shards=None, step=None):
+        return None
+
+    def ensure_score_fn(self, score_fn):
+        return self
+
+
+#: Shared no-op instance — what the supervisor holds when diagnostics are
+#: off, so the enabled check costs nothing on the segment path.
+DISABLED = _NoopDiagnostics()
+
+
+class PosteriorDiagnostics:
+    """Computes, records, and remembers the posterior health statistics.
+
+    Args:
+        config: :class:`DiagnosticsConfig` (default: defaults above).
+        registry: metrics registry for the ``svgd_diag_*`` gauges, the
+            computation counter, and the compute-wall histogram (default:
+            the process-wide registry).
+        logger: optional ``JsonlLogger`` — one record per computation.
+        wall_clock: unix-time source for the freshness gauge
+            (``svgd_diag_last_update_ts`` — what a staleness SLO reads).
+
+    Every computation runs inside a ``train.diagnostics`` span (tagged with
+    step and n) while the tracer is enabled, and is handed to the installed
+    flight recorder so postmortems carry the last health picture.
+    """
+
+    enabled = True
+
+    def __init__(self, config: Optional[DiagnosticsConfig] = None,
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 logger=None, wall_clock: Callable[[], float] = time.time):
+        self.config = config or DiagnosticsConfig()
+        reg = registry if registry is not None else _metrics.default_registry()
+        self.registry = reg
+        self._logger = logger
+        self._wall_clock = wall_clock
+        # instance-held score closure: ensure_score_fn adopts a sampler's
+        # closure HERE, never into the caller-owned (possibly shared)
+        # config — a config reused across runs must not leak one run's
+        # ∇log p into another's KSD
+        self._score_fn = self.config.score_fn
+        self._scores_jit = None  # built lazily from _score_fn
+        self._gauges = {
+            name: reg.gauge(f"svgd_diag_{name}", help)
+            for name, help in (
+                ("ksd", "kernelized Stein discrepancy (U-statistic sqrt)"),
+                ("ess", "kernel-matrix effective sample size"),
+                ("ess_frac", "kernel ESS over particle count"),
+                ("min_pairwise_dist", "smallest inter-particle distance"),
+                ("median_pairwise_dist",
+                 "median inter-particle distance (strided subsample)"),
+                ("min_dim_var", "smallest per-dimension particle variance"),
+                ("shard_mean_div",
+                 "max scale-normalised shard-mean divergence"),
+                ("shard_var_div",
+                 "max normalised shard-variance divergence"),
+                ("last_step", "step of the newest diagnostics computation"),
+                ("last_update_ts",
+                 "unix time of the newest diagnostics computation"),
+            )
+        }
+        self._m_computations = reg.counter(
+            "svgd_diag_computations_total", "diagnostics passes completed")
+        self._m_wall = reg.histogram(
+            "svgd_diag_compute_seconds", "wall per diagnostics pass")
+        #: Most recent report dict (plain floats), ``None`` before any.
+        self.last_report: Optional[Dict] = None
+
+    # ------------------------------------------------------------------ #
+
+    def should_run(self, t: int) -> bool:
+        """True when step ``t`` is on the cadence grid (t > 0)."""
+        return t > 0 and t % self.config.every_steps == 0
+
+    def ensure_score_fn(self, score_fn: Optional[Callable]) -> "PosteriorDiagnostics":
+        """Adopt ``score_fn`` if this instance has none (the supervisor
+        wires a single-device sampler's own score closure through here).
+        Instance-scoped: the shared config object is never mutated."""
+        if self._score_fn is None and score_fn is not None:
+            self._score_fn = score_fn
+            self._scores_jit = None
+        return self
+
+    def _score_array(self, particles):
+        if self._score_fn is None:
+            return None
+        if self._scores_jit is None:
+            # one jitted vmap per diagnostics instance: steady-state
+            # computes reuse the compiled program (shape-keyed by jit)
+            self._scores_jit = jax.jit(jax.vmap(self._score_fn))
+        return self._scores_jit(particles)
+
+    def compute(self, particles, scores=None, num_shards: Optional[int] = None,
+                step: Optional[int] = None) -> Dict:
+        """One full diagnostics pass over ``particles`` (``(n, d)``).
+
+        ``scores`` overrides the config's ``score_fn`` (pass the score
+        array a training step already computed); ``num_shards`` > 1 adds
+        the inter-shard divergence block.  Returns the report dict of
+        plain floats (also kept as :attr:`last_report`).
+        """
+        cfg = self.config
+        particles = jnp.asarray(particles)
+        n, d = particles.shape
+        if n < 2:
+            raise ValueError(f"diagnostics need n >= 2 particles, got {n}")
+        t0 = time.perf_counter()
+        traced = _trace.enabled()
+        with _trace.span("train.diagnostics",
+                         {"step": step, "n": n} if traced else None):
+            median_bw = cfg.bandwidth == "median"
+            bw = 1.0 if median_bw else float(cfg.bandwidth)
+            # all O(rows²) statistics run on the capped subsample; the
+            # stride is static per n, so every compute at one shape reuses
+            # the same compiled programs
+            sub = _subsample(particles, cfg.max_points)
+            n_eval = sub.shape[0]
+            if scores is not None:
+                sub_scores = _subsample(jnp.asarray(scores), cfg.max_points)
+            else:
+                sub_scores = self._score_array(sub)
+            if sub_scores is not None:
+                pair = _ksd_stats(sub, sub_scores, bw,
+                                  cfg.row_chunk, median_bw)
+            else:
+                pair = _kernel_stats(sub, bw, cfg.row_chunk, median_bw)
+            if (num_shards and num_shards > 1
+                    and n % num_shards == 0):
+                extra = _shard_stats(particles, num_shards)
+            else:
+                extra = {"min_dim_var": _dim_var_stats(particles)}
+            # the float() conversions ARE the fence: every statistic is a
+            # scalar fetch, so the span's wall covers device execution
+            report = {k: float(v) for block in (pair, extra)
+                      for k, v in block.items()}
+        report["ess_frac"] = report["ess"] / n_eval
+        report["n"] = n
+        report["n_eval"] = n_eval
+        report["d"] = d
+        if step is not None:
+            report["step"] = step
+        wall = time.perf_counter() - t0
+        report["wall_s"] = round(wall, 6)
+        self._record(report, wall)
+        return report
+
+    def _record(self, report: Dict, wall: float) -> None:
+        for name, gauge in self._gauges.items():
+            if name == "last_step":
+                if "step" in report:
+                    gauge.set(report["step"])
+            elif name == "last_update_ts":
+                gauge.set(self._wall_clock())
+            elif name in report:
+                gauge.set(report[name])
+        self._m_computations.inc()
+        self._m_wall.observe(wall)
+        self.last_report = report
+        _trace.record_flight("diagnostics", **report)
+        if self._logger is not None:
+            self._logger.log(event="diagnostics", **report)
+
+
+def ensemble_health(particles, max_points: int = 2048,
+                    bandwidth: Union[float, str] = "median",
+                    row_chunk: int = 1024) -> Dict:
+    """Score-free health snapshot of a particle ensemble — the serving
+    side's diagnostic (no ∇log p at serve time).
+
+    Evaluates kernel ESS / min distance / variance floor / median distance
+    over an evenly-strided subsample of at most ``max_points`` rows (the
+    reported ``ess`` is the subsample's; ``ess_frac`` — ESS over evaluated
+    rows — is the scale-free number to threshold).  Used by
+    :class:`ReloadPolicy` and ``tools/serve_bench.py``.
+    """
+    particles = jnp.asarray(particles)
+    if particles.ndim != 2 or particles.shape[0] < 2:
+        raise ValueError(
+            f"ensemble_health needs an (n>=2, d) array, got {particles.shape}"
+        )
+    sub = _subsample(particles, max_points)
+    median_bw = bandwidth == "median"
+    bw = 1.0 if median_bw else float(bandwidth)
+    pair = _kernel_stats(sub, bw, row_chunk, median_bw)
+    report = {k: float(v) for k, v in pair.items()}
+    report["min_dim_var"] = float(_dim_var_stats(particles))
+    report["n_eval"] = int(sub.shape[0])
+    report["ess_frac"] = report["ess"] / sub.shape[0]
+    return report
+
+
+class ReloadPolicy:
+    """Serve-side admission check: reject a candidate ensemble whose
+    health regressed past thresholds (``PredictiveEngine.reload``).
+
+    All checks are score-free (:func:`ensemble_health`); absolute floors
+    apply always, relative checks compare against the currently-served
+    ensemble's report.  A ``None`` threshold disables that check.
+
+    Args:
+        min_ess_frac: absolute floor on ``ess_frac`` (collapse filter).
+        max_ess_drop_frac: max allowed *relative* ESS-fraction drop vs the
+            served baseline (0.5 = reject below half the baseline).
+        min_dim_var: absolute floor on the per-dimension variance minimum.
+        max_points / bandwidth / row_chunk: forwarded to
+            :func:`ensemble_health`.
+    """
+
+    def __init__(self, min_ess_frac: Optional[float] = 0.01,
+                 max_ess_drop_frac: Optional[float] = 0.5,
+                 min_dim_var: Optional[float] = None,
+                 max_points: int = 2048,
+                 bandwidth: Union[float, str] = "median",
+                 row_chunk: int = 1024):
+        self.min_ess_frac = min_ess_frac
+        self.max_ess_drop_frac = max_ess_drop_frac
+        self.min_dim_var = min_dim_var
+        self.max_points = int(max_points)
+        self.bandwidth = bandwidth
+        self.row_chunk = int(row_chunk)
+
+    def evaluate(self, particles) -> Dict:
+        return ensemble_health(particles, max_points=self.max_points,
+                               bandwidth=self.bandwidth,
+                               row_chunk=self.row_chunk)
+
+    def judge(self, candidate: Dict, baseline: Optional[Dict]) -> list:
+        """Reasons the candidate fails (empty list = admit).  ``not <=`` /
+        ``not >=`` comparisons so a NaN statistic rejects instead of
+        comparing False."""
+        reasons = []
+        if (self.min_ess_frac is not None
+                and not candidate["ess_frac"] >= self.min_ess_frac):
+            reasons.append(
+                f"ess_frac {candidate['ess_frac']:.4g} below floor "
+                f"{self.min_ess_frac:g}")
+        if (self.max_ess_drop_frac is not None and baseline is not None
+                and baseline.get("ess_frac", 0) > 0):
+            floor = baseline["ess_frac"] * (1.0 - self.max_ess_drop_frac)
+            if not candidate["ess_frac"] >= floor:
+                reasons.append(
+                    f"ess_frac {candidate['ess_frac']:.4g} dropped past "
+                    f"{self.max_ess_drop_frac:g} of served baseline "
+                    f"{baseline['ess_frac']:.4g}")
+        if (self.min_dim_var is not None
+                and not candidate["min_dim_var"] >= self.min_dim_var):
+            reasons.append(
+                f"min_dim_var {candidate['min_dim_var']:.4g} below floor "
+                f"{self.min_dim_var:g}")
+        return reasons

@@ -58,15 +58,25 @@ def _label_key(labels: dict) -> _LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
-def _escape(value: str) -> str:
+def _escape_label(value: str) -> str:
+    """Label-value escaping per the text exposition format 0.0.4:
+    backslash, double-quote, and line feed — in that order, so an
+    already-escaped sequence is never double-mangled."""
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(value: str) -> str:
+    """HELP-text escaping: only backslash and line feed — the format
+    leaves double quotes literal in HELP lines (they are not quoted), so
+    escaping them there corrupts the docstring a scraper shows."""
+    return value.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _format_labels(key: _LabelKey, extra: Tuple[Tuple[str, str], ...] = ()) -> str:
     items = key + extra
     if not items:
         return ""
-    body = ",".join(f'{k}="{_escape(v)}"' for k, v in items)
+    body = ",".join(f'{k}="{_escape_label(v)}"' for k, v in items)
     return "{" + body + "}"
 
 
@@ -93,9 +103,16 @@ class _Metric:
     def _header(self) -> list:
         lines = []
         if self.help:
-            lines.append(f"# HELP {self.name} {_escape(self.help)}")
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
         lines.append(f"# TYPE {self.name} {self.kind}")
         return lines
+
+    def has(self, **labels) -> bool:
+        """True once this label set has been written (distinguishes a
+        never-set gauge from one legitimately at 0 — the SLO engine's
+        ``no_data`` vs ``ok``)."""
+        with self._lock:
+            return _label_key(labels) in self._series
 
 
 class Counter(_Metric):

@@ -1,0 +1,354 @@
+"""Declarative SLO engine over the metrics registry.
+
+The registry (PR 5) answers "what are the numbers"; this layer answers
+**"are we meeting the objectives"** — the SRE-style formulation (burn rate
+against an error budget) evaluated directly on the registry's histogram
+buckets and counters, with no external scrape stack:
+
+- :class:`LatencyObjective` — "fraction of requests over ``threshold_s``
+  stays within ``1 − target``" evaluated on a latency histogram's
+  **window delta** (the observations since the previous evaluation;
+  cumulative-since-start on the first).  ``burn_rate`` =
+  observed-error-fraction / error-budget — 1.0 is the edge of the budget,
+  the standard multi-window burn-rate alerting number.
+- :class:`RatioObjective` — bad-event counter over a base counter (or a
+  histogram's observation count) across the same window: shed rate per
+  request, guard trips per segment.
+- :class:`GaugeCeiling` — an instantaneous statistic must stay at or
+  under a ceiling: the KSD ceiling on ``svgd_diag_ksd`` is the posterior
+  convergence SLO.
+- :class:`StalenessObjective` — a unix-timestamp gauge must be newer than
+  ``max_age_s`` (freshness-style: diagnostics recency, last hot reload).
+
+:class:`SloEngine` owns the objective list and the per-objective window
+state, returns one JSON-friendly evaluation document, and writes its own
+verdicts back into the registry (``svgd_slo_burn_rate{slo=...}`` gauges,
+``svgd_slo_breaches_total{slo=...}`` counters) so SLO state itself is
+scrapeable.  The serving server exposes it at ``/slo``;
+``tools/serve_bench.py`` / ``tools/fault_drill.py`` stamp each bench row
+with the resulting ``slo_status``, and ``tools/perf_regress.py`` treats a
+breaching row as FAIL.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from dist_svgd_tpu.telemetry import metrics as _metrics
+from dist_svgd_tpu.telemetry.metrics import Counter, Histogram, MetricsRegistry
+
+__all__ = [
+    "LatencyObjective",
+    "RatioObjective",
+    "GaugeCeiling",
+    "StalenessObjective",
+    "SloEngine",
+    "default_serving_slos",
+    "default_training_slos",
+]
+
+OK = "ok"
+BREACH = "breach"
+NO_DATA = "no_data"
+
+
+class _Objective:
+    """Shared name plumbing; subclasses implement ``evaluate(registry,
+    now_s)`` returning a row dict with at least ``status`` and
+    ``burn_rate``.  Objectives are stateful (window snapshots) and belong
+    to one engine."""
+
+    def __init__(self, name: str):
+        if not name:
+            raise ValueError("objective needs a non-empty name")
+        self.name = name
+
+    def evaluate(self, registry: MetricsRegistry, now_s: float) -> Dict:
+        raise NotImplementedError
+
+
+def _count_delta(registry: MetricsRegistry, name: str, labels: dict,
+                 prev: Dict, key: str) -> Optional[float]:
+    """Windowed total of a Counter (value) or Histogram (observation
+    count) since the previous evaluation; ``None`` when the metric was
+    never registered."""
+    metric = registry._metrics.get(name)  # read-only peek, same package
+    if metric is None:
+        return None
+    if isinstance(metric, Counter):
+        now = metric.value(**labels)
+    elif isinstance(metric, Histogram):
+        series = metric._snapshot(labels)
+        now = float(series.count) if series is not None else 0.0
+    else:
+        raise ValueError(f"metric {name!r} is not a counter or histogram")
+    before = prev.get(key, 0.0)
+    prev[key] = now
+    return max(now - before, 0.0)
+
+
+class LatencyObjective(_Objective):
+    """``target`` fraction of observations must land at or under
+    ``threshold_s``, judged per evaluation window."""
+
+    def __init__(self, name: str, histogram: str, threshold_s: float,
+                 target: float = 0.99, labels: Optional[dict] = None):
+        super().__init__(name)
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {target}")
+        if threshold_s <= 0:
+            raise ValueError(f"threshold_s must be positive, got {threshold_s}")
+        self.histogram = histogram
+        self.threshold_s = float(threshold_s)
+        self.target = float(target)
+        self.labels = dict(labels or {})
+        self._prev_counts: Optional[List[int]] = None
+
+    def _window_counts(self, hist: Histogram) -> Optional[List[int]]:
+        series = hist._snapshot(self.labels)
+        if series is None:
+            return None
+        counts = list(series.counts)
+        prev = self._prev_counts
+        self._prev_counts = counts
+        if prev is None or len(prev) != len(counts):
+            return counts
+        return [max(c - p, 0) for c, p in zip(counts, prev)]
+
+    def evaluate(self, registry: MetricsRegistry, now_s: float) -> Dict:
+        metric = registry._metrics.get(self.histogram)
+        row = {"objective": "latency", "histogram": self.histogram,
+               "threshold_ms": round(self.threshold_s * 1e3, 4),
+               "target": self.target}
+        if not isinstance(metric, Histogram):
+            row.update(status=NO_DATA, burn_rate=0.0, window_count=0)
+            return row
+        counts = self._window_counts(metric)
+        total = sum(counts) if counts else 0
+        if not total:
+            row.update(status=NO_DATA, burn_rate=0.0, window_count=0)
+            return row
+        # observations at or under the threshold: whole buckets below it
+        # plus a linear share of the bucket the threshold lands in (the
+        # same within-bucket interpolation Histogram.quantile uses)
+        bounds = metric.buckets
+        under = 0.0
+        lo = 0.0
+        for i, hi in enumerate(bounds):
+            c = counts[i]
+            if hi <= self.threshold_s:
+                under += c
+            elif lo < self.threshold_s:
+                under += c * (self.threshold_s - lo) / (hi - lo)
+            lo = hi
+        # the overflow bucket is entirely over any finite threshold
+        frac_over = max(0.0, 1.0 - under / total)
+        budget = 1.0 - self.target
+        burn = frac_over / budget
+        row.update(
+            status=BREACH if burn > 1.0 else OK,
+            burn_rate=round(burn, 4),
+            frac_over=round(frac_over, 6),
+            window_count=total,
+        )
+        return row
+
+
+class RatioObjective(_Objective):
+    """Windowed ``numerator / denominator`` must stay at or under
+    ``max_ratio``.  Either name may be a counter or a histogram (a
+    histogram contributes its observation count)."""
+
+    def __init__(self, name: str, numerator: str, denominator: str,
+                 max_ratio: float, labels: Optional[dict] = None):
+        super().__init__(name)
+        if max_ratio < 0:
+            raise ValueError(f"max_ratio must be >= 0, got {max_ratio}")
+        self.numerator = numerator
+        self.denominator = denominator
+        self.max_ratio = float(max_ratio)
+        self.labels = dict(labels or {})
+        self._prev: Dict[str, float] = {}
+
+    def evaluate(self, registry: MetricsRegistry, now_s: float) -> Dict:
+        num = _count_delta(registry, self.numerator, self.labels,
+                           self._prev, "num")
+        den = _count_delta(registry, self.denominator, self.labels,
+                           self._prev, "den")
+        row = {"objective": "ratio", "numerator": self.numerator,
+               "denominator": self.denominator, "max_ratio": self.max_ratio}
+        if (num or 0.0) > 0 and not den:
+            # bad events with ZERO base events is the outage shape (every
+            # request shed → none resolved): an infinite ratio, a breach —
+            # never no_data (burn_rate None: unbounded, not a number)
+            row.update(status=BREACH, burn_rate=None, ratio=None,
+                       window_num=num, window_den=den or 0)
+            return row
+        if den is None or not den:
+            row.update(status=NO_DATA, burn_rate=0.0, window_den=den or 0)
+            return row
+        ratio = (num or 0.0) / den
+        burn = (ratio / self.max_ratio) if self.max_ratio > 0 else (
+            0.0 if ratio == 0 else None)  # None: unbounded, not a number
+        row.update(
+            status=BREACH if ratio > self.max_ratio else OK,
+            burn_rate=round(burn, 4) if burn is not None else None,
+            ratio=round(ratio, 6),
+            window_num=num or 0.0,
+            window_den=den,
+        )
+        return row
+
+
+class GaugeCeiling(_Objective):
+    """The gauge's current value must stay at or under ``ceiling`` —
+    instantaneous, not windowed (a gauge is already last-write-wins).
+    A gauge that was never written is ``no_data``, not a breach."""
+
+    def __init__(self, name: str, gauge: str, ceiling: float,
+                 labels: Optional[dict] = None):
+        super().__init__(name)
+        if ceiling <= 0:
+            raise ValueError(f"ceiling must be positive, got {ceiling}")
+        self.gauge = gauge
+        self.ceiling = float(ceiling)
+        self.labels = dict(labels or {})
+
+    def evaluate(self, registry: MetricsRegistry, now_s: float) -> Dict:
+        metric = registry._metrics.get(self.gauge)
+        row = {"objective": "gauge_ceiling", "gauge": self.gauge,
+               "ceiling": self.ceiling}
+        if metric is None or not metric.has(**self.labels):
+            row.update(status=NO_DATA, burn_rate=0.0)
+            return row
+        value = metric.value(**self.labels)
+        burn = value / self.ceiling
+        # `not <=` so a NaN statistic reads as a breach, never as ok
+        row.update(
+            status=OK if value <= self.ceiling else BREACH,
+            burn_rate=round(burn, 4),
+            value=value,
+        )
+        return row
+
+
+class StalenessObjective(_Objective):
+    """A unix-timestamp gauge must be at most ``max_age_s`` old."""
+
+    def __init__(self, name: str, gauge: str, max_age_s: float,
+                 labels: Optional[dict] = None):
+        super().__init__(name)
+        if max_age_s <= 0:
+            raise ValueError(f"max_age_s must be positive, got {max_age_s}")
+        self.gauge = gauge
+        self.max_age_s = float(max_age_s)
+        self.labels = dict(labels or {})
+
+    def evaluate(self, registry: MetricsRegistry, now_s: float) -> Dict:
+        metric = registry._metrics.get(self.gauge)
+        row = {"objective": "staleness", "gauge": self.gauge,
+               "max_age_s": self.max_age_s}
+        if metric is None or not metric.has(**self.labels):
+            row.update(status=NO_DATA, burn_rate=0.0)
+            return row
+        age = max(now_s - metric.value(**self.labels), 0.0)
+        burn = age / self.max_age_s
+        row.update(
+            status=BREACH if age > self.max_age_s else OK,
+            burn_rate=round(burn, 4),
+            age_s=round(age, 3),
+        )
+        return row
+
+
+class SloEngine:
+    """Evaluates a fixed objective list against one registry.
+
+    Each :meth:`evaluate` call advances every objective's window (the
+    delta since the previous call; cumulative on the first) and returns::
+
+        {"status": "ok"|"breach", "ts": <unix>,
+         "objectives": {name: {status, burn_rate, ...}, ...}}
+
+    ``no_data`` objectives never breach the overall status (a fresh server
+    with zero traffic is healthy, not failing).  Verdicts are mirrored
+    into the registry: ``svgd_slo_burn_rate{slo=name}`` gauges and
+    ``svgd_slo_breaches_total{slo=name}`` counters.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 objectives: Sequence[_Objective] = (),
+                 clock: Callable[[], float] = time.time):
+        import threading
+
+        self.registry = (registry if registry is not None
+                         else _metrics.default_registry())
+        self.objectives = list(objectives)
+        names = [o.name for o in self.objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names: {names}")
+        self._clock = clock
+        # the objectives' window snapshots are stateful: concurrent
+        # evaluations (two scrapers on /slo — ThreadingHTTPServer runs one
+        # thread per request) would double-judge one window and starve the
+        # next; one engine lock serialises them
+        self._lock = threading.Lock()
+        self._m_burn = self.registry.gauge(
+            "svgd_slo_burn_rate", "error-budget burn rate per objective")
+        self._m_breaches = self.registry.counter(
+            "svgd_slo_breaches_total", "SLO evaluations that breached")
+
+    def evaluate(self) -> Dict:
+        with self._lock:
+            now = self._clock()
+            rows = {}
+            worst = OK
+            for obj in self.objectives:
+                row = obj.evaluate(self.registry, now)
+                rows[obj.name] = row
+                burn = row.get("burn_rate", 0.0)
+                if isinstance(burn, (int, float)) and burn != float("inf"):
+                    self._m_burn.set(burn, slo=obj.name)
+                if row["status"] == BREACH:
+                    worst = BREACH
+                    self._m_breaches.inc(slo=obj.name)
+        return {"status": worst, "ts": round(now, 3), "objectives": rows}
+
+
+def default_serving_slos(registry: MetricsRegistry, *,
+                         p99_ms: float = 100.0,
+                         shed_budget: float = 0.01,
+                         error_budget: float = 0.01,
+                         clock: Callable[[], float] = time.time) -> SloEngine:
+    """The serving server's standard objective set: request p99 under
+    ``p99_ms``, sheds under ``shed_budget`` per resolved request, and
+    dispatch errors under ``error_budget`` per batch."""
+    return SloEngine(registry, [
+        LatencyObjective("serve_p99", "svgd_serve_request_latency_seconds",
+                         p99_ms / 1e3, target=0.99),
+        RatioObjective("shed_rate", "svgd_serve_shed_total",
+                       "svgd_serve_requests_total", shed_budget),
+        RatioObjective("dispatch_errors", "svgd_serve_dispatch_errors_total",
+                       "svgd_serve_batches_total", error_budget),
+    ], clock=clock)
+
+
+def default_training_slos(registry: MetricsRegistry, *,
+                          max_ksd: Optional[float] = None,
+                          guard_trip_budget: float = 0.1,
+                          diag_max_age_s: Optional[float] = None,
+                          clock: Callable[[], float] = time.time) -> SloEngine:
+    """The supervised-training objective set: guard trips under
+    ``guard_trip_budget`` per segment, optionally a KSD ceiling (the
+    posterior-convergence SLO) and a diagnostics-freshness bound."""
+    objectives: List[_Objective] = [
+        RatioObjective("guard_trip_rate", "svgd_train_guard_trips_total",
+                       "svgd_train_segment_seconds", guard_trip_budget),
+    ]
+    if max_ksd is not None:
+        objectives.append(GaugeCeiling("ksd_ceiling", "svgd_diag_ksd", max_ksd))
+    if diag_max_age_s is not None:
+        objectives.append(StalenessObjective(
+            "diag_freshness", "svgd_diag_last_update_ts", diag_max_age_s))
+    return SloEngine(registry, objectives, clock=clock)

@@ -44,12 +44,17 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "Tracer",
     "SpanHandle",
+    "FlightRecorder",
     "enable",
     "disable",
     "get_tracer",
     "enabled",
     "span",
     "instant",
+    "install_flight_recorder",
+    "uninstall_flight_recorder",
+    "flight_recorder",
+    "record_flight",
 ]
 
 
@@ -209,6 +214,13 @@ class Tracer:
         })
 
     def _append(self, event: dict) -> None:
+        rec = _RECORDER
+        if rec is not None:
+            # the flight recorder's ring keeps the NEWEST events (deque
+            # maxlen) while the tracer's buffer keeps the oldest under its
+            # drop cap — a crash postmortem wants what happened just
+            # before the end, so feed the ring even past the tracer's cap
+            rec._record_trace_event(event)
         tid = event["tid"]
         with self._lock:
             if isinstance(tid, int) and tid not in self._thread_names:
@@ -359,6 +371,171 @@ class Tracer:
             for e in self._events:
                 out[e["name"]] = out.get(e["name"], 0) + 1
             return out
+
+
+# --------------------------------------------------------------------- #
+# flight recorder: bounded black box for crash postmortems
+
+class FlightRecorder:
+    """Bounded ring buffer of recent spans, instants, explicit records,
+    and the last diagnostics report — the training/serving "black box".
+
+    While installed (:func:`install_flight_recorder`) the tracer feeds
+    every completed span/instant into the ring (newest kept — a crash
+    wants the moments *before* the end, the opposite retention of the
+    tracer's own drop-oldest-never buffer), and components add structured
+    records off their hot paths via :func:`record_flight`.  On a guard
+    trip, an injected fault, or an exhausted restart budget the supervisor
+    calls :meth:`dump`, which writes one **postmortem bundle** — JSONL:
+    a header line, the registry's metric snapshot, the last diagnostics
+    report, then the ring oldest→newest — rendered by
+    ``tools/trace_report.py --postmortem``.
+
+    Args:
+        capacity: max retained events (ring; oldest evicted).
+        dump_dir: where :meth:`dump` writes bundles
+          (``postmortem_<seq>_<reason>.jsonl``).
+        registry: metrics registry snapshotted into each bundle — every
+            bundle carries the numbers (default: the process-wide
+            registry).
+        clock: unix-time source for event/bundle timestamps.
+    """
+
+    def __init__(self, capacity: int = 1024, dump_dir: str = ".",
+                 registry=None, clock: Callable[[], float] = time.time):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        import collections
+
+        from dist_svgd_tpu.telemetry import metrics as _metrics
+
+        self._lock = threading.Lock()
+        self._ring = collections.deque(maxlen=int(capacity))
+        self._dump_dir = dump_dir
+        self._registry = (registry if registry is not None
+                          else _metrics.default_registry())
+        self._clock = clock
+        self._last_diagnostics: Optional[dict] = None
+        self._dumps = 0
+        self._m_dumps = self._registry.counter(
+            "svgd_flight_dumps_total", "postmortem bundles written")
+
+    # ------------------------------------------------------------------ #
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one structured record to the ring.  ``kind='diagnostics'``
+        additionally becomes the bundle's last-diagnostics block."""
+        entry = {"kind": kind, "ts": self._clock(), **fields}
+        with self._lock:
+            self._ring.append(entry)
+            if kind == "diagnostics":
+                self._last_diagnostics = entry
+
+    def _record_trace_event(self, event: dict) -> None:
+        """Tracer feed: one completed span/instant (tracer-relative
+        timestamps, like the trace exports)."""
+        entry = {"kind": "span" if event["ph"] == "X" else "instant",
+                 "name": event["name"], "ts": event["ts"]}
+        if event["ph"] == "X":
+            entry["dur"] = event["dur"]
+        if event.get("args"):
+            entry["args"] = event["args"]
+        with self._lock:
+            self._ring.append(entry)
+
+    @property
+    def last_diagnostics(self) -> Optional[dict]:
+        with self._lock:
+            return self._last_diagnostics
+
+    def events(self) -> List[dict]:
+        """Ring contents oldest→newest (a copy)."""
+        with self._lock:
+            return list(self._ring)
+
+    @property
+    def dumps(self) -> int:
+        with self._lock:
+            return self._dumps
+
+    # ------------------------------------------------------------------ #
+
+    def dump(self, reason: str, context: Optional[dict] = None,
+             path: Optional[str] = None) -> str:
+        """Write one postmortem bundle; returns its path.
+
+        The bundle is JSONL so a truncated write (the crash may be a
+        dying process) still yields parseable leading lines: header,
+        metrics snapshot, last diagnostics, then ring events.
+        """
+        import os
+        import re
+
+        with self._lock:
+            self._dumps += 1
+            seq = self._dumps
+            events = list(self._ring)
+            last_diag = self._last_diagnostics
+        if path is None:
+            slug = re.sub(r"[^a-zA-Z0-9_.-]+", "_", reason)[:48] or "unknown"
+            os.makedirs(self._dump_dir, exist_ok=True)
+            path = os.path.join(self._dump_dir,
+                                f"postmortem_{seq:03d}_{slug}.jsonl")
+        lines = [{"kind": "postmortem", "reason": reason,
+                  "ts": self._clock(), "events": len(events),
+                  "context": context or {}}]
+        try:
+            lines.append({"kind": "metrics",
+                          "snapshot": self._registry.snapshot()})
+        except Exception:  # a half-poisoned registry must not block a dump
+            lines.append({"kind": "metrics", "snapshot": None})
+        if last_diag is not None:
+            lines.append(last_diag)
+        lines.extend(events)
+        with open(path, "w") as fh:
+            for rec in lines:
+                fh.write(json.dumps(rec, default=str))
+                fh.write("\n")
+        self._m_dumps.inc()
+        return path
+
+
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def install_flight_recorder(recorder: Optional[FlightRecorder] = None,
+                            **kwargs) -> FlightRecorder:
+    """Install (and return) the process flight recorder.  Idempotent while
+    installed — a second call returns the live recorder unchanged (nested
+    tooling composes, the tracer-enable convention).  ``kwargs`` build a
+    fresh :class:`FlightRecorder` when none is passed."""
+    global _RECORDER
+    with _SWITCH_LOCK:
+        if _RECORDER is None:
+            _RECORDER = recorder if recorder is not None else FlightRecorder(
+                **kwargs)
+        return _RECORDER
+
+
+def uninstall_flight_recorder() -> Optional[FlightRecorder]:
+    """Remove and return the installed recorder (``None`` when absent)."""
+    global _RECORDER
+    with _SWITCH_LOCK:
+        recorder, _RECORDER = _RECORDER, None
+    return recorder
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def record_flight(kind: str, **fields) -> None:
+    """Structured record into the installed recorder; no-op when none.
+    Hot paths should guard on :func:`flight_recorder` first — the kwargs
+    dict is built at the call site either way."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.record(kind, **fields)
 
 
 # --------------------------------------------------------------------- #

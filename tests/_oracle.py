@@ -34,6 +34,44 @@ def rbf(x, y):
     return float(np.exp(-np.dot(d, d)))
 
 
+def ksd_u_stat(particles, scores, bandwidth=1.0):
+    """Kernelized Stein discrepancy, squared, as the U-statistic
+    ``1/(n(n−1)) Σ_{i≠j} u_p(x_i, x_j)`` with the repo's RBF convention
+    ``k(x, y) = exp(−‖x−y‖²/h)`` (Liu, Lee & Jordan 2016, eq. per-pair
+    form) — deliberately loopy float64, the diagnostics ground truth."""
+    x = np.asarray(particles, dtype=np.float64)
+    s = np.asarray(scores, dtype=np.float64)
+    n, d = x.shape
+    beta = 2.0 / bandwidth
+    total = 0.0
+    for i in range(n):
+        for j in range(n):
+            if i == j:
+                continue
+            r = x[i] - x[j]
+            sq = float(np.dot(r, r))
+            k = np.exp(-sq / bandwidth)
+            total += k * (
+                np.dot(s[i], s[j]) + beta * np.dot(s[i], r)
+                - beta * np.dot(s[j], r) + beta * d - beta * beta * sq
+            )
+    return total / (n * (n - 1))
+
+
+def kernel_ess(particles, bandwidth=1.0):
+    """Kernel-matrix effective sample size: the participation ratio
+    ``(tr K)² / ‖K‖_F² = n² / Σᵢⱼ Kᵢⱼ²`` of the Gram matrix — n for
+    spread particles (K ≈ I), 1 for a collapsed set (K ≈ 𝟙𝟙ᵀ)."""
+    x = np.asarray(particles, dtype=np.float64)
+    n = x.shape[0]
+    k2 = 0.0
+    for i in range(n):
+        for j in range(n):
+            r = x[i] - x[j]
+            k2 += np.exp(-np.dot(r, r) / bandwidth) ** 2
+    return n * n / k2
+
+
 def drbf_dx(x, y):
     """∇_x k(x, y) for the bandwidth-1 RBF."""
     return -2.0 * (x - y) * rbf(x, y)

@@ -1,0 +1,558 @@
+"""Posterior health observability (telemetry/diagnostics.py, slo.py, the
+flight recorder, and their supervisor / serving hooks).
+
+Numerics are pinned against the loopy float64 oracles in ``_oracle.py``
+(KSD U-statistic, kernel ESS) at small n; everything else is CPU-shaped
+and small-N per the tier-1 budget discipline.
+"""
+
+import json
+import os
+import sys
+import tracemalloc
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+# repo root (for tools.jaxlint) and tools/ (for trace_report) — the
+# test_telemetry convention
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import _oracle
+from dist_svgd_tpu import telemetry
+from dist_svgd_tpu.resilience import GuardConfig, RunSupervisor
+from dist_svgd_tpu.resilience.guards import GuardViolation, check_diagnostics
+from dist_svgd_tpu.resilience.supervisor import RestartBudgetExhausted
+from dist_svgd_tpu.telemetry import diagnostics as diag_mod
+from dist_svgd_tpu.telemetry import slo as slo_mod
+from dist_svgd_tpu.telemetry.diagnostics import (
+    DISABLED,
+    DiagnosticsConfig,
+    PosteriorDiagnostics,
+    ReloadPolicy,
+    ensemble_health,
+)
+from dist_svgd_tpu.telemetry.metrics import MetricsRegistry
+from dist_svgd_tpu.telemetry.trace import (
+    FlightRecorder,
+    install_flight_recorder,
+    uninstall_flight_recorder,
+)
+
+import dist_svgd_tpu as dt
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    rec = install_flight_recorder(FlightRecorder(
+        capacity=64, dump_dir=str(tmp_path / "flight"),
+        registry=MetricsRegistry()))
+    yield rec
+    uninstall_flight_recorder()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(3)
+
+
+# --------------------------------------------------------------------- #
+# numerics vs the float64 oracle
+
+
+def test_ksd_matches_oracle_chunked(rng):
+    """Jitted chunked KSD² ≡ the loopy float64 U-statistic (f64 inputs —
+    conftest enables x64 — so the comparison is at full precision), with
+    a row_chunk that forces padding (14 rows in chunks of 5)."""
+    n, d, bw = 14, 3, 1.7
+    x = rng.normal(size=(n, d))
+    s = -x + 0.1 * rng.normal(size=(n, d))
+    want = _oracle.ksd_u_stat(x, s, bandwidth=bw)
+    out = diag_mod._ksd_stats(jnp.asarray(x), jnp.asarray(s), bw, 5, False)
+    np.testing.assert_allclose(float(out["ksd_sq"]), want, rtol=1e-10)
+    assert float(out["ksd"]) == pytest.approx(np.sqrt(max(want, 0.0)))
+    # chunk invariance: any row_chunk gives the same sums
+    whole = diag_mod._ksd_stats(jnp.asarray(x), jnp.asarray(s), bw, 64, False)
+    np.testing.assert_allclose(float(out["ksd_sq"]), float(whole["ksd_sq"]),
+                               rtol=1e-12)
+
+
+def test_kernel_ess_matches_oracle_and_bounds(rng):
+    n, d, bw = 12, 2, 1.0
+    x = rng.normal(size=(n, d))
+    want = _oracle.kernel_ess(x, bandwidth=bw)
+    out = diag_mod._kernel_stats(jnp.asarray(x), bw, 5, False)
+    np.testing.assert_allclose(float(out["ess"]), want, rtol=1e-10)
+    assert 1.0 <= want <= n
+    # fully collapsed set → ESS ≈ 1; well-separated set → ESS ≈ n
+    collapsed = np.tile(x[:1], (n, 1))
+    out_c = diag_mod._kernel_stats(jnp.asarray(collapsed), bw, 5, False)
+    assert float(out_c["ess"]) == pytest.approx(1.0)
+    spread = 100.0 * np.arange(n, dtype=np.float64)[:, None] * np.ones((1, d))
+    out_s = diag_mod._kernel_stats(jnp.asarray(spread), bw, 5, False)
+    assert float(out_s["ess"]) == pytest.approx(n)
+
+
+def test_ksd_separates_converged_from_drifted(rng):
+    """For a standard-normal target (score = −θ), samples drawn FROM the
+    target score a far smaller KSD than the same samples shifted off it —
+    the one-scalar convergence signal the drift guard thresholds."""
+    x = rng.normal(size=(64, 2))
+    shifted = x + 3.0
+    good = float(diag_mod._ksd_stats(jnp.asarray(x), jnp.asarray(-x),
+                                     1.0, 32, False)["ksd"])
+    bad = float(diag_mod._ksd_stats(jnp.asarray(shifted),
+                                    jnp.asarray(-shifted),
+                                    1.0, 32, False)["ksd"])
+    assert bad > 3 * good
+
+
+def test_collapse_indicators(rng):
+    x = rng.normal(size=(16, 3))
+    x[7] = x[3]          # one duplicated particle
+    x[:, 1] = 0.25       # one dead dimension
+    out = diag_mod._kernel_stats(jnp.asarray(x), 1.0, 8, False)
+    assert float(out["min_pairwise_dist"]) == 0.0
+    assert float(diag_mod._dim_var_stats(jnp.asarray(x))) == 0.0
+    # median pairwise distance tracks the numpy median (counting-bracket
+    # resolution: 8⁻⁴ of the range, lower-middle order statistic)
+    sq = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = np.median(np.sqrt(sq[~np.eye(len(x), dtype=bool)]))
+    got = float(out["median_pairwise_dist"])
+    assert abs(got - want) / want < 0.05
+
+
+def test_shard_divergence_detects_shifted_shard(rng):
+    """A single drifted shard lights up shard_mean_div while a healthy
+    sharded set stays near zero — the exchange-bug detector."""
+    S, per, d = 4, 64, 2
+    x = rng.normal(size=(S * per, d))
+    base = diag_mod._shard_stats(jnp.asarray(x), S)
+    shifted = x.copy()
+    shifted[2 * per:3 * per] += 6.0
+    drift = diag_mod._shard_stats(jnp.asarray(shifted), S)
+    assert float(base["shard_mean_div"]) < 0.2
+    assert float(drift["shard_mean_div"]) > 4 * float(base["shard_mean_div"])
+    assert float(drift["shard_var_div"]) > float(base["shard_var_div"])
+    # min_dim_var rides along with the shard pass
+    assert float(base["min_dim_var"]) == pytest.approx(
+        float(np.var(x, axis=0).min()), rel=1e-6)
+
+
+def test_compute_subsamples_past_max_points(rng):
+    """Past max_points the pairwise stats run on the strided subsample
+    (ess_frac normalised by evaluated rows), and repeated computes at one
+    shape are steady-state: zero XLA compiles under the retrace sentry."""
+    from tools.jaxlint.sentry import retrace_sentry
+
+    x = rng.normal(size=(96, 2))
+    pd = PosteriorDiagnostics(
+        DiagnosticsConfig(every_steps=4, max_points=32, row_chunk=16,
+                          score_fn=lambda th: -th),
+        registry=MetricsRegistry())
+    rep = pd.compute(x, num_shards=4, step=4)
+    assert rep["n"] == 96 and rep["n_eval"] == 32
+    assert rep["ess_frac"] == pytest.approx(rep["ess"] / 32)
+    for key in ("ksd", "ksd_sq", "ess", "min_pairwise_dist",
+                "median_pairwise_dist", "min_dim_var", "shard_mean_div",
+                "shard_var_div", "bandwidth", "wall_s"):
+        assert key in rep, key
+    with retrace_sentry("diagnostics steady state") as sentry:
+        for step in (8, 12, 16):
+            pd.compute(x, num_shards=4, step=step)
+    if sentry.supported:
+        assert sentry.compiles == 0
+
+
+def test_median_bandwidth_mode_and_registry_gauges(rng):
+    x = rng.normal(size=(24, 2))
+    reg = MetricsRegistry()
+    pd = PosteriorDiagnostics(
+        DiagnosticsConfig(every_steps=2, bandwidth="median", row_chunk=24),
+        registry=reg, wall_clock=lambda: 123.0)
+    rep = pd.compute(x, step=6)
+    assert rep["bandwidth"] > 0  # resolved per-compute by the median
+    assert rep.get("ksd") is None  # no score_fn → score-free report
+    assert reg.gauge("svgd_diag_ess").value() == pytest.approx(rep["ess"])
+    assert reg.gauge("svgd_diag_last_step").value() == 6
+    assert reg.gauge("svgd_diag_last_update_ts").value() == 123.0
+    assert reg.counter("svgd_diag_computations_total").value() == 1
+    assert pd.last_report is rep
+    assert not pd.should_run(5) and pd.should_run(6)
+
+
+def test_disabled_diagnostics_is_zero_alloc():
+    """The DISABLED singleton's per-boundary check allocates nothing —
+    the tracer's no-op discipline, tracemalloc-pinned."""
+    assert DISABLED.compute(None) is None  # warm any lazy machinery
+    tracemalloc.start()
+    try:
+        before = tracemalloc.get_traced_memory()[0]
+        for t in range(200):
+            DISABLED.should_run(t)
+            DISABLED.compute(None, None, None, None)
+        after = tracemalloc.get_traced_memory()[0]
+    finally:
+        tracemalloc.stop()
+    assert after - before == 0
+    assert DISABLED.enabled is False and DISABLED.last_report is None
+
+
+# --------------------------------------------------------------------- #
+# drift guard + supervisor integration
+
+
+def test_check_diagnostics_thresholds():
+    cfg = GuardConfig(max_ksd=1.0, min_ess_frac=0.1, min_dim_var=1e-6,
+                      max_shard_mean_div=0.5)
+    assert cfg.checks_diagnostics
+    ok = {"ksd": 0.5, "ess_frac": 0.4, "min_dim_var": 0.1,
+          "shard_mean_div": 0.1}
+    assert check_diagnostics(ok, cfg) is ok
+    with pytest.raises(GuardViolation, match="posterior drift"):
+        check_diagnostics({**ok, "ksd": 2.0}, cfg)
+    with pytest.raises(GuardViolation, match="particle collapse"):
+        check_diagnostics({**ok, "ess_frac": 0.01}, cfg)
+    with pytest.raises(GuardViolation, match="dimension collapse"):
+        check_diagnostics({**ok, "min_dim_var": 0.0}, cfg)
+    with pytest.raises(GuardViolation, match="shard divergence"):
+        check_diagnostics({**ok, "shard_mean_div": 2.0}, cfg)
+    # NaN statistics trip instead of comparing False
+    with pytest.raises(GuardViolation):
+        check_diagnostics({**ok, "ksd": float("nan")}, cfg)
+    # absent statistics leave their checks inert
+    assert check_diagnostics({}, cfg) == {}
+    assert not GuardConfig().checks_diagnostics
+
+
+def _make_supervisor(tmp_path, name, diagnostics=None, guard=None,
+                     steps=8, **kw):
+    sampler = dt.Sampler(2, lambda th: -0.5 * jnp.sum(th ** 2))
+    return RunSupervisor(
+        sampler, steps, 0.05, n=12, seed=0,
+        checkpoint_dir=os.path.join(str(tmp_path), name),
+        checkpoint_every=4, segment_steps=2, sleep=lambda s: None,
+        registry=MetricsRegistry(), diagnostics=diagnostics, guard=guard,
+        **kw)
+
+
+def test_supervisor_runs_diagnostics_on_cadence(tmp_path):
+    """Diagnostics fire at the first boundary at or past each every_steps
+    multiple (every=3 on a 2-step grid → boundaries 4, 6... cross 3 and 6)
+    plus the final boundary, the report lands in the run report, and the
+    Sampler's own score closure feeds KSD without any config."""
+    reg = MetricsRegistry()
+    diag = PosteriorDiagnostics(DiagnosticsConfig(every_steps=3,
+                                                  row_chunk=12),
+                                registry=reg)
+    sup = _make_supervisor(tmp_path, "d", diagnostics=diag)
+    report = sup.run()
+    assert report["status"] == "completed"
+    last = report["last_diagnostics"]
+    assert last is not None and last["step"] == 8
+    assert last["ksd"] >= 0  # score wired from the sampler automatically
+    assert reg.counter("svgd_diag_computations_total").value() >= 2
+
+
+def test_drift_guard_rolls_back_and_exhausts_budget(tmp_path, recorder):
+    """An impossible ESS floor trips the drift guard at every replayed
+    boundary: rollback + step-size backoff until the restart budget
+    exhausts — and every trip plus the final exhaustion dumped postmortem
+    bundles through the flight recorder."""
+    diag = PosteriorDiagnostics(DiagnosticsConfig(every_steps=2,
+                                                  row_chunk=12),
+                                registry=MetricsRegistry())
+    sup = _make_supervisor(tmp_path, "g", diagnostics=diag,
+                           guard=GuardConfig(min_ess_frac=2.0))
+    eps0 = sup.step_size
+    with pytest.raises(RestartBudgetExhausted):
+        sup.run()
+    assert sup.step_size < eps0  # backoff applied on each trip
+    dumps = sorted(os.listdir(str(recorder._dump_dir)))
+    assert any("guard_violation" in d for d in dumps)
+    assert any("restart_budget_exhausted" in d for d in dumps)
+    # the bundle renders through the CLI
+    import trace_report
+
+    bundle = os.path.join(str(recorder._dump_dir), dumps[0])
+    assert trace_report.main([bundle, "--postmortem"]) == 0
+
+
+def test_healthy_run_passes_drift_guard(tmp_path):
+    diag = PosteriorDiagnostics(DiagnosticsConfig(every_steps=2,
+                                                  row_chunk=12),
+                                registry=MetricsRegistry())
+    sup = _make_supervisor(tmp_path, "h", diagnostics=diag,
+                           guard=GuardConfig(min_ess_frac=1e-4, max_ksd=1e3))
+    assert sup.run()["status"] == "completed"
+    assert sup.report["restarts"] == 0
+
+
+def test_fault_dumps_postmortem(tmp_path, recorder):
+    """A non-retryable fault (simulated hard kill) dumps the black box on
+    the way out — the bundle the next resume's operator reads first."""
+    from dist_svgd_tpu.resilience import FaultPlan, HardKillAt, SimulatedHardKill
+
+    sup = _make_supervisor(tmp_path, "k",
+                           faults=FaultPlan(HardKillAt(4)))
+    with pytest.raises(SimulatedHardKill):
+        sup.run()
+    dumps = os.listdir(str(recorder._dump_dir))
+    assert any("fault" in d for d in dumps)
+    header = json.loads(open(
+        os.path.join(str(recorder._dump_dir), sorted(dumps)[0])).readline())
+    assert header["kind"] == "postmortem"
+    assert "SimulatedHardKill" in header["context"]["error"]
+
+
+# --------------------------------------------------------------------- #
+# flight recorder
+
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("t_total").inc(5)
+    rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path), registry=reg,
+                         clock=lambda: 42.0)
+    for i in range(20):
+        rec.record("tick", i=i)
+    rec.record("diagnostics", ksd=0.5, ess=3.0)
+    assert len(rec.events()) == 8  # bounded ring, oldest evicted
+    assert rec.last_diagnostics["ksd"] == 0.5
+    path = rec.dump("test_reason", {"t": 7})
+    assert os.path.basename(path) == "postmortem_001_test_reason.jsonl"
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["kind"] == "postmortem"
+    assert lines[0]["reason"] == "test_reason"
+    assert lines[0]["context"] == {"t": 7}
+    assert lines[1]["kind"] == "metrics" and lines[1]["snapshot"]["t_total"] == 5
+    assert lines[2]["kind"] == "diagnostics" and lines[2]["ksd"] == 0.5
+    assert [l for l in lines if l["kind"] == "tick"][-1]["i"] == 19
+    assert rec.dumps == 1
+
+
+def test_tracer_feeds_recorder_ring(tmp_path, recorder):
+    tracer = telemetry.enable()
+    try:
+        with telemetry.span("diag.test"):
+            pass
+        telemetry.instant("mark")
+    finally:
+        telemetry.disable()
+    kinds = [(e["kind"], e.get("name")) for e in recorder.events()]
+    assert ("span", "diag.test") in kinds
+    assert ("instant", "mark") in kinds
+
+
+def test_record_flight_noop_without_recorder():
+    assert telemetry.flight_recorder() is None
+    telemetry.record_flight("orphan", x=1)  # must not raise
+
+
+def test_install_flight_recorder_idempotent(tmp_path):
+    rec = install_flight_recorder(dump_dir=str(tmp_path))
+    try:
+        assert install_flight_recorder() is rec
+    finally:
+        assert uninstall_flight_recorder() is rec
+    assert uninstall_flight_recorder() is None
+
+
+# --------------------------------------------------------------------- #
+# serving: reload policy + /slo route
+
+
+def test_reload_policy_rejects_collapsed_ensemble(rng, tmp_path):
+    from dist_svgd_tpu.serving import EnsembleRejected, PredictiveEngine
+
+    parts = rng.normal(size=(64, 5)).astype(np.float32)
+    eng = PredictiveEngine("logreg", parts, min_bucket=4, max_bucket=16,
+                          registry=MetricsRegistry(),
+                          reload_policy=ReloadPolicy(min_ess_frac=0.05,
+                                                     max_points=64))
+    eng.predict(rng.normal(size=(3, 4)).astype(np.float32))
+    healthy = rng.normal(size=(64, 5)).astype(np.float32)
+    eng.reload(healthy, tag="gen2")
+    assert eng.stats()["ensemble_tag"] == "gen2"
+    assert eng.stats()["ensemble_health"]["ess_frac"] > 0.05
+    collapsed = np.tile(healthy[:1], (64, 1))
+    with pytest.raises(EnsembleRejected, match="ess_frac"):
+        eng.reload(collapsed, tag="gen3")
+    st = eng.stats()
+    assert st["ensemble_tag"] == "gen2"  # still serving the old generation
+    assert st["reload_rejects"] == 1
+
+
+def test_hot_reloader_skips_rejected_generation(rng, tmp_path):
+    from dist_svgd_tpu.serving import CheckpointHotReloader, PredictiveEngine
+    from dist_svgd_tpu.utils.checkpoint import CheckpointManager
+
+    parts = rng.normal(size=(32, 5)).astype(np.float32)
+    eng = PredictiveEngine("logreg", parts, min_bucket=4, max_bucket=8,
+                          registry=MetricsRegistry(),
+                          reload_policy=ReloadPolicy(min_ess_frac=0.05,
+                                                     max_points=32))
+    root = str(tmp_path / "root")
+    mgr = CheckpointManager(root, every=1)
+    mgr.save(1, {"particles": np.tile(parts[:1], (32, 1))})  # collapsed
+    rel = CheckpointHotReloader(eng, root, baseline_step=0)
+    assert rel.poll_once() is None       # rejected, not served
+    assert rel.loaded_step == 1          # ...but marked seen
+    assert eng.stats()["reloads"] == 0
+    mgr.save(2, {"particles": rng.normal(size=(32, 5)).astype(np.float32)})
+    assert rel.poll_once() == 2          # healthier generation swaps in
+    assert eng.stats()["reloads"] == 1
+
+
+def test_server_slo_route(rng):
+    from dist_svgd_tpu.serving import PredictionServer, PredictiveEngine
+
+    reg = MetricsRegistry()
+    parts = rng.normal(size=(32, 5)).astype(np.float32)
+    eng = PredictiveEngine("logreg", parts, min_bucket=4, max_bucket=16,
+                          registry=reg)
+    eng.warmup()  # the one traced request must not blow the p99 objective
+    srv = PredictionServer(eng, port=0, max_wait_ms=1.0, registry=reg)
+    with srv:
+        body = json.dumps(
+            {"inputs": [[0.1, 0.2, 0.3, 0.4]]}).encode()
+        req = urllib.request.Request(
+            srv.url + "/predict", body, {"Content-Type": "application/json"})
+        assert json.loads(urllib.request.urlopen(req, timeout=10).read())[
+            "outputs"]
+        doc = json.loads(urllib.request.urlopen(
+            srv.url + "/slo", timeout=10).read())
+    assert doc["status"] == "ok"
+    assert doc["objectives"]["serve_p99"]["status"] in ("ok", "no_data")
+    assert set(doc["objectives"]) == {"serve_p99", "shed_rate",
+                                      "dispatch_errors"}
+    # verdicts mirrored into the scrapeable registry
+    assert reg.gauge("svgd_slo_burn_rate").has(slo="shed_rate")
+
+
+# --------------------------------------------------------------------- #
+# SLO engine
+
+
+def test_latency_objective_burn_and_windowing():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", buckets=(0.001, 0.01, 0.1, 1.0))
+    eng = slo_mod.SloEngine(reg, [slo_mod.LatencyObjective(
+        "p99", "t_lat_seconds", threshold_s=0.1, target=0.9)],
+        clock=lambda: 10.0)
+    assert eng.evaluate()["objectives"]["p99"]["status"] == "no_data"
+    for _ in range(98):
+        h.observe(0.005)
+    h.observe(0.5)
+    h.observe(0.5)
+    doc = eng.evaluate()
+    row = doc["objectives"]["p99"]
+    # 2/100 over a 10% budget → burn 0.2, ok
+    assert row["status"] == "ok"
+    assert row["burn_rate"] == pytest.approx(0.2)
+    assert doc["status"] == "ok"
+    # next window: mostly-slow traffic breaches even though the cumulative
+    # distribution would still pass — the delta-window discipline
+    for _ in range(10):
+        h.observe(0.5)
+    doc = eng.evaluate()
+    row = doc["objectives"]["p99"]
+    assert row["status"] == "breach" and row["window_count"] == 10
+    assert doc["status"] == "breach"
+    assert reg.counter("svgd_slo_breaches_total").value(slo="p99") == 1
+
+
+def test_ratio_gauge_and_staleness_objectives():
+    reg = MetricsRegistry()
+    shed = reg.counter("t_shed_total")
+    seg = reg.histogram("t_seg_seconds")
+    now = [100.0]
+    eng = slo_mod.SloEngine(reg, [
+        slo_mod.RatioObjective("shed", "t_shed_total", "t_seg_seconds",
+                               max_ratio=0.5),
+        slo_mod.GaugeCeiling("ksd", "t_ksd", ceiling=1.0),
+        slo_mod.StalenessObjective("fresh", "t_ts", max_age_s=60.0),
+    ], clock=lambda: now[0])
+    doc = eng.evaluate()["objectives"]
+    assert doc["shed"]["status"] == "no_data"   # empty denominator window
+    assert doc["ksd"]["status"] == "no_data"    # gauge never written
+    assert doc["fresh"]["status"] == "no_data"
+    for _ in range(4):
+        seg.observe(0.1)
+    shed.inc(1)
+    reg.gauge("t_ksd").set(0.4)
+    reg.gauge("t_ts").set(90.0)
+    doc = eng.evaluate()["objectives"]
+    assert doc["shed"]["status"] == "ok"
+    assert doc["shed"]["ratio"] == pytest.approx(0.25)
+    assert doc["ksd"]["status"] == "ok"
+    assert doc["ksd"]["burn_rate"] == pytest.approx(0.4)
+    assert doc["fresh"]["status"] == "ok"
+    reg.gauge("t_ksd").set(2.0)
+    now[0] = 200.0  # 110 s stale
+    shed.inc(3)
+    seg.observe(0.1)
+    doc = eng.evaluate()["objectives"]
+    assert doc["shed"]["status"] == "breach"  # 3 sheds / 1 segment
+    assert doc["ksd"]["status"] == "breach"
+    assert doc["fresh"]["status"] == "breach"
+    # total-outage shape: bad events with a ZERO base window (every
+    # request shed → none resolved) is a breach, never no_data
+    shed.inc(5)
+    doc = eng.evaluate()["objectives"]
+    assert doc["shed"]["status"] == "breach"
+    assert doc["shed"]["window_den"] == 0 and doc["shed"]["window_num"] == 5
+    json.dumps(doc)  # unbounded burn serialises as null, not Infinity
+
+
+def test_default_slo_sets_and_duplicate_names():
+    reg = MetricsRegistry()
+    serving = slo_mod.default_serving_slos(reg, p99_ms=50.0)
+    assert {o.name for o in serving.objectives} == {
+        "serve_p99", "shed_rate", "dispatch_errors"}
+    training = slo_mod.default_training_slos(reg, max_ksd=2.0,
+                                             diag_max_age_s=300.0)
+    assert {o.name for o in training.objectives} == {
+        "guard_trip_rate", "ksd_ceiling", "diag_freshness"}
+    with pytest.raises(ValueError, match="duplicate"):
+        slo_mod.SloEngine(reg, [slo_mod.GaugeCeiling("x", "g", 1.0),
+                                slo_mod.GaugeCeiling("x", "g2", 1.0)])
+
+
+# --------------------------------------------------------------------- #
+# ensemble_health + ReloadPolicy unit behaviour
+
+
+def test_ensemble_health_and_reload_policy_judgement(rng):
+    x = rng.normal(size=(200, 3)).astype(np.float32)
+    h = ensemble_health(x, max_points=50)
+    assert h["n_eval"] == 50
+    assert 0 < h["ess_frac"] <= 1 and h["min_dim_var"] > 0
+    pol = ReloadPolicy(min_ess_frac=0.05, max_ess_drop_frac=0.5,
+                       min_dim_var=1e-8, max_points=50)
+    assert pol.judge(h, None) == []
+    # relative drop: candidate at less than half the baseline's ess_frac
+    bad = dict(h, ess_frac=h["ess_frac"] * 0.3)
+    reasons = pol.judge(bad, h)
+    assert reasons and "dropped past" in reasons[0]
+    # NaN statistics reject rather than comparing False
+    assert pol.judge(dict(h, ess_frac=float("nan")), None)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="every_steps"):
+        DiagnosticsConfig(every_steps=0)
+    with pytest.raises(ValueError, match="bandwidth"):
+        DiagnosticsConfig(bandwidth=-1.0)
+    with pytest.raises(ValueError, match="row_chunk"):
+        DiagnosticsConfig(row_chunk=0)
+    with pytest.raises(ValueError, match="n >= 2"):
+        PosteriorDiagnostics(registry=MetricsRegistry()).compute(
+            np.zeros((1, 2)))
+    with pytest.raises(ValueError, match="n>=2"):
+        ensemble_health(np.zeros((1, 2)))

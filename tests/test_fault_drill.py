@@ -32,13 +32,15 @@ def test_fault_drill_row_schema(tmp_path):
 
     row = fault_drill.run_drill(
         n=64, num_steps=12, checkpoint_every=4, segment_steps=2,
-        root=str(tmp_path),
+        root=str(tmp_path), diag_overhead=False,
     )
     for key in ("metric", "platform", "step_wall_ms",
                 "checkpoint_overhead_pct", "kill_step",
                 "last_checkpoint_step", "steps_lost", "recovery_wall_s",
                 "resumed_bitwise_identical", "retry_backoff_recovered",
-                "nan_rollback_recovered", "overhead_under_5pct"):
+                "nan_rollback_recovered", "overhead_under_5pct",
+                "ksd", "ess", "ess_frac", "slo_status",
+                "diagnostics_overhead"):
         assert key in row, key
     assert row["metric"] == "fault_recovery"
     assert row["kill_step"] == 10 and row["last_checkpoint_step"] == 8
@@ -46,6 +48,14 @@ def test_fault_drill_row_schema(tmp_path):
     assert row["resumed_bitwise_identical"]
     assert row["retry_backoff_recovered"]
     assert row["nan_rollback_recovered"]
+    # posterior-health fields (round 11): the baseline run's diagnostics
+    # (GMM score is closed-form, so the KSD column is real here) plus the
+    # training-SLO verdict over the whole drill registry
+    assert row["ksd"] > 0 and row["ess"] > 1
+    assert 0 < row["ess_frac"] <= 1
+    assert row["slo_status"] == "ok"
+    assert row["diagnostics_overhead"] is None  # diag_overhead=False
+    assert row["diagnostics_per_run"] >= 1
     json.dumps(row)
 
 

@@ -78,7 +78,15 @@ def reference_final(tmp_path, steps=12, **kw):
 # resume exactness (the acceptance pin, both sampler kinds)
 
 
-@pytest.mark.parametrize("preempt_step", [3, 4, 7])
+# tier-1 keeps one preempt point (3 — mid-segment, the interesting
+# non-boundary case); the boundary-exact and late variants are the same
+# code path at ~2 s apiece and run in the slow tier (runtime-budget audit,
+# round 11)
+@pytest.mark.parametrize("preempt_step", [
+    3,
+    pytest.param(4, marks=pytest.mark.slow),
+    pytest.param(7, marks=pytest.mark.slow),
+])
 def test_distsampler_preempt_resume_bitwise(tmp_path, preempt_step):
     """An injected preemption at an arbitrary step (honoured at the next
     boundary, like a real SIGTERM) then resume-from-latest reproduces the
@@ -161,6 +169,7 @@ def test_sampler_median_kernel_frozen_across_segments(tmp_path):
     np.testing.assert_array_equal(np.asarray(mono), np.asarray(sup3.particles))
 
 
+@pytest.mark.slow  # host-LP W2 is the exotic make_step-only path (~2.4 s)
 def test_distsampler_w2_lp_supervised_resume(tmp_path):
     """The eager host-LP W2 path (make_step-only) supervises through the
     harness's make_step loop; preempt + resume stays bitwise (the W2

@@ -185,6 +185,9 @@ def test_engine_gmm_kde_matches_direct(rng):
 # engine: checkpoint cold start (all three layouts)
 
 
+@pytest.mark.slow  # the orbax-backed save's fixed ~2 s import/manifest
+# cost buys no coverage the manager-root and multiprocess cold-start
+# tests below don't already give (runtime-budget audit, round 11)
 def test_from_checkpoint_single_save(tmp_path, rng):
     parts = rng.normal(size=(8, 3)).astype(np.float32)
     save_state(str(tmp_path / "c"), {"particles": parts, "t": 3})
@@ -571,7 +574,9 @@ def test_serve_bench_row_schema():
     for key in ("metric", "value", "unit", "p50_ms", "p99_ms",
                 "queue_wait_p50_ms", "device_p50_ms", "batch_occupancy_mean",
                 "recompiles", "bucket_hit_rate", "shed", "open_loop",
-                "serve_latency_p99", "latency_hist_ms", "telemetry"):
+                "serve_latency_p99", "latency_hist_ms", "telemetry",
+                "ksd", "ess", "ess_frac", "slo_status",
+                "diagnostics_overhead"):
         assert key in row, key
     assert row["metric"] == "serve_throughput"
     assert row["value"] > 0
@@ -585,6 +590,13 @@ def test_serve_bench_row_schema():
     # window (None only when jax.monitoring is unavailable)
     assert row["sentry_compiles"] in (0, None)
     assert row["open_loop"]["completed"] == 20
+    # posterior-health stamp (round 11): serve-side diagnostics are
+    # score-free (ksd stays null — the fault_recovery row measures it),
+    # and an unloaded bench window must satisfy the default serving SLOs
+    assert row["ksd"] is None
+    assert row["ess"] > 1 and 0 < row["ess_frac"] <= 1
+    assert row["slo_status"] == "ok"
+    assert 0 <= row["diagnostics_overhead"] < 1
     json.dumps(row)  # one BENCH-style JSON line, serialisable as-is
 
 

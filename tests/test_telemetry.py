@@ -116,10 +116,19 @@ def test_prometheus_exposition_golden():
     h = reg.histogram("t_lat_seconds", "latency", buckets=(0.001, 0.01, 0.1))
     for v in (0.0005, 0.05, 5.0):
         h.observe(v)
+    # exposition-format escaping (satellite pin): label values escape
+    # backslash, double-quote, and newline; HELP text escapes ONLY
+    # backslash and newline — a double quote stays literal there (HELP is
+    # not a quoted string in the format)
+    esc = reg.counter("t_esc_total", 'say "hi"\\no\nwrap')
+    esc.inc(1, path='a"b\\c\nd')
     expected = (
         "# HELP t_depth queue depth\n"
         "# TYPE t_depth gauge\n"
         "t_depth 7\n"
+        "# HELP t_esc_total say \"hi\"\\\\no\\nwrap\n"
+        "# TYPE t_esc_total counter\n"
+        't_esc_total{path="a\\"b\\\\c\\nd"} 1\n'
         "# HELP t_lat_seconds latency\n"
         "# TYPE t_lat_seconds histogram\n"
         't_lat_seconds_bucket{le="0.001"} 1\n'
@@ -141,6 +150,10 @@ def test_exposition_escapes_label_values():
     reg.counter("t_esc_total").inc(1, path='a"b\\c\nd')
     text = reg.exposition()
     assert 't_esc_total{path="a\\"b\\\\c\\nd"} 1' in text
+    # escaping order: backslash first, so a pre-escaped-looking value is
+    # not double-mangled into an escape sequence
+    reg.gauge("t_esc2").set(1, v="\\n")
+    assert 't_esc2{v="\\\\n"} 1' in reg.exposition()
 
 
 def test_registry_thread_safety_exact_counts():
@@ -549,3 +562,73 @@ def test_trace_report_cli_json(tmp_path, capsys):
     assert trace_report.main([path, "--json"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["n_spans"] == 1 and "x" in out["spans"]
+
+
+def test_trace_report_cli_bad_inputs(tmp_path, capsys):
+    """Missing / empty / corrupt / truncated inputs exit nonzero with ONE
+    line on stderr — never an unhandled traceback (satellite pin)."""
+    import trace_report
+
+    missing = str(tmp_path / "nope.json")
+    assert trace_report.main([missing]) == 2
+    err = capsys.readouterr().err
+    assert err.startswith("trace_report:") and err.count("\n") == 1
+
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert trace_report.main([str(empty)]) == 2
+    assert capsys.readouterr().err.startswith("trace_report:")
+
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text('{"traceEvents": [{"ph": "X", "na')  # truncated doc
+    assert trace_report.main([str(corrupt)]) == 2
+    assert capsys.readouterr().err.startswith("trace_report:")
+
+    corrupt_jsonl = tmp_path / "corrupt.jsonl"
+    corrupt_jsonl.write_text(
+        '{"kind": "span", "name": "a", "ts": 0.0, "dur": 1.0}\n{"kind": bro')
+    assert trace_report.main([str(corrupt_jsonl)]) == 2
+    assert capsys.readouterr().err.startswith("trace_report:")
+
+    # structurally valid but zero trace events: a distinct, clear error
+    zero = tmp_path / "zero.json"
+    zero.write_text('{"traceEvents": []}\n')
+    assert trace_report.main([str(zero)]) == 1
+    assert "no trace events" in capsys.readouterr().err
+
+    # a non-bundle handed to --postmortem is refused, not half-rendered
+    good = tmp_path / "good.json"
+    tracer2 = Tracer(clock=ManualClock())
+    with tracer2.span("x"):
+        pass
+    tracer2.export_chrome(str(good))
+    assert trace_report.main([str(good), "--postmortem"]) == 2
+    assert "postmortem" in capsys.readouterr().err
+
+
+def test_trace_report_postmortem_render(tmp_path, capsys):
+    """A flight-recorder bundle renders (human + --json) with reason,
+    context, diagnostics, metrics, and ring events."""
+    import trace_report
+
+    from dist_svgd_tpu.telemetry import FlightRecorder
+
+    reg = MetricsRegistry()
+    reg.counter("t_restarts_total").inc(2)
+    rec = FlightRecorder(capacity=16, dump_dir=str(tmp_path), registry=reg,
+                         clock=lambda: 99.0)
+    rec.record("diagnostics", ksd=1.25, ess=4.0)
+    rec.record("guard_violation", t=8, reason="posterior drift")
+    path = rec.dump("guard_violation", {"t": 8, "step_size": 0.05})
+    assert trace_report.main([path, "--postmortem"]) == 0
+    out = capsys.readouterr().out
+    assert "postmortem: guard_violation" in out
+    assert "context.step_size = 0.05" in out
+    assert "ksd = 1.25" in out
+    assert "t_restarts_total = 2" in out
+    assert "guard_violation" in out.splitlines()[-1] or "guard_violation" in out
+    assert trace_report.main([path, "--postmortem", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["header"]["reason"] == "guard_violation"
+    assert doc["diagnostics"]["ksd"] == 1.25
+    assert any(e["kind"] == "guard_violation" for e in doc["events"])

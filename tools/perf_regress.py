@@ -95,6 +95,11 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
 #: by definition, not a noise band question.
 TELEMETRY_OVERHEAD_MAX = 0.03
 
+#: Same fixed-ceiling discipline for the posterior diagnostics (round 11):
+#: the diagnostics-on/off A/B over one warmed supervised run
+#: (``fault_drill.measure_diagnostics_overhead``) FAILs above this.
+DIAGNOSTICS_OVERHEAD_MAX = 0.03
+
 #: serve_throughput row config (tools/serve_bench.py defaults at a fixed,
 #: recorded load): logreg d=55, 10k-particle ensemble, 16 closed-loop
 #: clients, mixed 1/4/16-row requests.
@@ -421,11 +426,19 @@ def main():
            "batch_occupancy_mean": serve_best["batch_occupancy_mean"],
            "recompiles": serve_recompiles,
            "sentry_compiles": (serve_sentry_compiles if sentry_supported
-                               else None)}
+                               else None),
+           "slo_status": serve_best.get("slo_status")}
     if serve_recompiles or serve_sentry_compiles:
         # bucket-cache misses OR any raw XLA compile the sentry saw in any
         # round's timed window: either way the steady-state contract broke
         row["status"] = "FAIL"
+        failures += 1
+    elif serve_best.get("slo_status") == "breach":
+        # a breaching slo_status in the bench row (p99 over the declared
+        # objective, shed/error budget blown) is a FAIL regardless of raw
+        # throughput — the row can get faster while violating its SLO
+        row["status"] = "FAIL"
+        row["slo"] = serve_best.get("slo")
         failures += 1
     else:
         tol = min(args.tol * TOL_FACTOR.get(serve_key, 1.0), 0.9)
@@ -479,6 +492,24 @@ def main():
            "rps_enabled": ov["rps_enabled"],
            "ceiling": TELEMETRY_OVERHEAD_MAX}
     if ov["overhead_frac"] > TELEMETRY_OVERHEAD_MAX:
+        row["status"] = "FAIL"
+        failures += 1
+    else:
+        row["status"] = "PASS"
+    print(json.dumps(row), flush=True)
+
+    # diagnostics-overhead gate (round 11): posterior health checks must
+    # stay within the same fixed 3% ceiling on the supervised training
+    # loop — measured like the telemetry A/B, never recorded as an
+    # incumbent
+    import fault_drill
+
+    dov = fault_drill.measure_diagnostics_overhead(rounds=args.rounds)
+    row = {"bench": "diagnostics_overhead", "value": dov["overhead_frac"],
+           "unit": "fraction of supervised-run wall added by diagnostics",
+           "wall_off_s": dov["wall_off_s"], "wall_on_s": dov["wall_on_s"],
+           "ceiling": DIAGNOSTICS_OVERHEAD_MAX}
+    if dov["overhead_frac"] > DIAGNOSTICS_OVERHEAD_MAX:
         row["status"] = "FAIL"
         failures += 1
     else:

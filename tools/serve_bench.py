@@ -224,7 +224,7 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
               clients=16, requests=2000, rows=(1, 4, 16), max_batch=256,
               max_wait_ms=2.0, max_queue_rows=8192, open_rate=0.0,
               open_requests=500, checkpoint=None, seed=0, url=None,
-              engine=None, trace=None):
+              engine=None, trace=None, slo_p99_ms=100.0):
     """Measure and return the JSON row (importable — perf_regress uses this).
 
     ``trace``: a path enables the span tracer for the timed window and
@@ -235,6 +235,16 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
     Telemetry rows: each call uses a **fresh** ``MetricsRegistry``, so the
     histogram-derived fields (``serve_latency_p99``, ``latency_hist_ms``)
     aggregate exactly this call's timed window.
+
+    Posterior-health fields (round 11): ``ess``/``ess_frac`` — score-free
+    kernel-ESS of the served ensemble over a strided subsample
+    (``telemetry.diagnostics.ensemble_health``; ``ksd`` is ``None`` here —
+    serving has no ∇log p; the training-side ``fault_recovery`` row carries
+    the measured KSD); ``slo_status`` — the declarative serving SLOs
+    (p99 under ``slo_p99_ms``, shed/error budgets) evaluated over exactly
+    this window (``perf_regress`` FAILs a breaching row);
+    ``diagnostics_overhead`` — wall of the (off-request-path) health
+    evaluation as a fraction of the timed window.
     """
     import jax
 
@@ -349,6 +359,31 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
     if open_row is not None:
         row["open_loop"] = {k: round(v, 3) if isinstance(v, float) else v
                             for k, v in open_row.items()}
+
+    # posterior-health + SLO stamp (round 11): ensemble diagnostics are
+    # score-free at serve time and run OFF the request path; the first
+    # (compile-bearing) health call is warmed untimed so the reported
+    # overhead is the steady-state cost relative to the timed window
+    from dist_svgd_tpu.telemetry.diagnostics import ensemble_health
+    from dist_svgd_tpu.telemetry.slo import default_serving_slos
+
+    ensemble_health(engine.particles, max_points=1024)  # warm (compiles)
+    t_diag0 = time.perf_counter()
+    health = ensemble_health(engine.particles, max_points=1024)
+    diag_wall = time.perf_counter() - t_diag0
+    slo_doc = default_serving_slos(
+        registry, p99_ms=slo_p99_ms).evaluate()
+    row.update(
+        ksd=None,  # no score function at serve time (schema parity with
+                   # the fault_recovery row, which measures it in training)
+        ess=round(health["ess"], 2),
+        ess_frac=round(health["ess_frac"], 4),
+        slo_status=slo_doc["status"],
+        slo={name: {"status": o["status"], "burn_rate": o["burn_rate"]}
+             for name, o in slo_doc["objectives"].items()},
+        diagnostics_overhead=round(
+            diag_wall / max(closed["wall_s"] + diag_wall, 1e-9), 4),
+    )
     return row
 
 
@@ -406,6 +441,9 @@ def main():
     ap.add_argument("--url", default=None,
                     help="closed-loop against a live serving.server "
                          "instead of in-process")
+    ap.add_argument("--slo-p99-ms", type=float, default=100.0,
+                    help="serve-p99 SLO threshold stamped into the row's "
+                         "slo_status (perf_regress FAILs a breaching row)")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="enable the span tracer for the timed window and "
                          "export a Perfetto-loadable Chrome trace here "
@@ -428,7 +466,8 @@ def main():
     if args.ab_telemetry:
         out = measure_telemetry_overhead(rounds=args.ab_telemetry, **kw)
     else:
-        out = run_bench(url=args.url, trace=args.trace, **kw)
+        out = run_bench(url=args.url, trace=args.trace,
+                        slo_p99_ms=args.slo_p99_ms, **kw)
     print(json.dumps(out), flush=True)
 
 

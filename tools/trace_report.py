@@ -16,11 +16,21 @@ plus the top-N self-time ranking and every ``xla_compile`` instant bucketed
 by the span it fired inside (a compile inside ``serve.dispatch`` in steady
 state is a retrace bug — the runtime cousin of ``tools/jaxlint``'s sentry).
 
+``--postmortem`` instead renders a **flight-recorder bundle**
+(``telemetry.FlightRecorder.dump`` — written when a guard trips, a fault
+fires, the restart budget exhausts, or a hot reload is rejected): the
+header's reason and context, the last posterior-diagnostics report, the
+metric snapshot, and the ring of events leading up to the dump.
+
+A missing, empty, or corrupt input exits with one line on stderr and a
+nonzero status (2) — no tracebacks from the CLI.
+
 Usage::
 
     python tools/trace_report.py trace.json           # human table
     python tools/trace_report.py trace.json --json    # machine row
     python tools/trace_report.py serve.jsonl --top 5
+    python tools/trace_report.py postmortem_001_guard_violation.jsonl --postmortem
 """
 
 import argparse
@@ -187,19 +197,113 @@ def render(report):
     return "\n".join(out)
 
 
+def load_postmortem(path):
+    """Parse a flight-recorder bundle (JSONL): returns
+    ``(header, metrics_snapshot, diagnostics, events)``.  Raises
+    ``ValueError`` when the file is not a postmortem bundle."""
+    header = None
+    snapshot = None
+    diagnostics = None
+    events = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError(f"line {lineno} is not a JSON object")
+            kind = rec.get("kind")
+            if lineno == 1:
+                if kind != "postmortem":
+                    raise ValueError(
+                        "first record is not a postmortem header "
+                        f"(kind={kind!r}) — is this a flight-recorder "
+                        "bundle?")
+                header = rec
+            elif kind == "metrics":
+                snapshot = rec.get("snapshot")
+            elif kind == "diagnostics":
+                diagnostics = rec
+            else:
+                events.append(rec)
+    if header is None:
+        raise ValueError("empty file")
+    return header, snapshot, diagnostics, events
+
+
+def render_postmortem(header, snapshot, diagnostics, events, top=10):
+    out = [f"postmortem: {header.get('reason', '?')}",
+           f"  dumped at unix {header.get('ts')}; "
+           f"{len(events)} ring events"]
+    ctx = header.get("context") or {}
+    for k in sorted(ctx):
+        out.append(f"  context.{k} = {ctx[k]}")
+    if diagnostics is not None:
+        out.append("last diagnostics:")
+        for k in sorted(diagnostics):
+            if k not in ("kind", "ts"):
+                out.append(f"  {k} = {diagnostics[k]}")
+    if snapshot:
+        out.append(f"metrics snapshot ({len(snapshot)} series):")
+        for k in sorted(snapshot):
+            out.append(f"  {k} = {snapshot[k]}")
+    if events:
+        out.append(f"ring (oldest first, last {min(len(events), top)} shown):")
+        for rec in events[-top:]:
+            kind = rec.get("kind", "?")
+            name = rec.get("name") or rec.get("reason") or ""
+            extra = {k: v for k, v in rec.items()
+                     if k not in ("kind", "name", "ts")}
+            out.append(f"  [{rec.get('ts', 0):>12.6f}] {kind:11s} {name} "
+                       f"{extra if extra else ''}".rstrip())
+    return "\n".join(out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome trace JSON (Tracer.export_chrome) "
-                                  "or tracer JSONL file")
+    ap.add_argument("trace", help="Chrome trace JSON (Tracer.export_chrome), "
+                                  "tracer JSONL file, or (with --postmortem) "
+                                  "a flight-recorder bundle")
     ap.add_argument("--top", type=int, default=10,
-                    help="entries in the self-time ranking")
+                    help="entries in the self-time ranking (or postmortem "
+                         "ring events shown)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as one JSON document")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="render a flight-recorder postmortem bundle "
+                         "instead of a span summary")
     args = ap.parse_args(argv)
 
-    spans, instants = load_events(args.trace)
+    try:
+        if args.postmortem:
+            header, snapshot, diagnostics, events = load_postmortem(args.trace)
+        else:
+            spans, instants = load_events(args.trace)
+    except OSError as e:
+        print(f"trace_report: cannot read {args.trace}: "
+              f"{e.strerror or e}", file=sys.stderr)
+        return 2
+    except (json.JSONDecodeError, UnicodeDecodeError, KeyError, ValueError,
+            TypeError) as e:
+        # corrupt/truncated JSON, a non-trace file, a malformed record:
+        # one clear line, no traceback
+        print(f"trace_report: {args.trace} is not a readable "
+              f"{'postmortem bundle' if args.postmortem else 'trace file'}: "
+              f"{e}", file=sys.stderr)
+        return 2
+
+    if args.postmortem:
+        if args.json:
+            print(json.dumps({"header": header, "metrics": snapshot,
+                              "diagnostics": diagnostics, "events": events}))
+        else:
+            print(render_postmortem(header, snapshot, diagnostics, events,
+                                    top=args.top))
+        return 0
     if not spans and not instants:
-        print(f"no trace events in {args.trace}", file=sys.stderr)
+        print(f"trace_report: no trace events in {args.trace}",
+              file=sys.stderr)
         return 1
     report = summarize(spans, instants, top=args.top)
     if args.json:
