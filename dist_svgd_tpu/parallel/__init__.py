@@ -1,6 +1,7 @@
 """SPMD parallelism: mesh utilities, exchange strategies, multi-host setup."""
 
 from dist_svgd_tpu.parallel.mesh import AXIS, make_mesh, bind_shard_fn
+from dist_svgd_tpu.parallel.plan import Plan, make_plan
 from dist_svgd_tpu.parallel.exchange import (
     ALL_PARTICLES,
     ALL_SCORES,
@@ -13,6 +14,8 @@ __all__ = [
     "AXIS",
     "make_mesh",
     "bind_shard_fn",
+    "Plan",
+    "make_plan",
     "ALL_PARTICLES",
     "ALL_SCORES",
     "PARTITIONS",

@@ -1,0 +1,231 @@
+"""Unified sharding **Plan**: one compile entrypoint for mesh-sharded and
+single-device execution (ROADMAP item 5's seed, grown for serving first).
+
+The training side already shards per-particle work across the mesh
+(``bind_shard_fn``'s shard_map/vmap backends), but the serving engine's
+predictive kernels were plain single-device ``jax.jit`` — the mesh that
+trains 2M particles idled at serve time.  A :class:`Plan` closes that gap
+the pjit-preferring way (SNIPPETS.md [2]): when a mesh is given, compile
+with **explicit in/out shardings** (replicated request batches in, a
+particle-sharded ensemble closed over, replicated outputs back out — the
+particle-axis reduction becomes one cross-shard ``psum`` XLA inserts);
+when no mesh is given, fall back to today's single-device ``jit`` so the
+CPU tier-1 path is byte-for-byte the old behavior.
+
+Placement follows the ``shard_params`` / ``get_naive_sharding`` pattern
+(SNIPPETS.md [3]): :meth:`Plan.shard_ensemble` is a ``jax.device_put``
+with ``NamedSharding(mesh, PartitionSpec(AXIS, ...))`` on the particle
+axis.  jax 0.4.x rejects uneven shardings outright, so a particle count
+the mesh doesn't divide falls back to replication with a warning rather
+than failing the cold start — serving an ensemble beats serving an error.
+
+Buffer donation rides the same entrypoint (ROADMAP item 2):
+``donate_argnums`` passes straight through to ``jit`` so steady-state
+dispatch inputs stop re-allocating per call.  Donation is declared per
+*compiled program*; on backends where a donated buffer cannot alias an
+output (CPU, and reduction kernels whose outputs are smaller than their
+inputs) XLA just frees it early and warns.  For a *deliberate* donation
+that nag carries no signal, so :meth:`Plan.compile` suppresses it —
+scoped to the first (lowering) call of each donating program, never as a
+process-global filter, so a future training-loop donation that wants the
+warning as a tuning signal can keep it (``quiet_donation=False``).
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dist_svgd_tpu.parallel.mesh import AXIS, make_mesh
+
+_DONATION_NAG = "Some donated buffers were not usable"
+
+__all__ = ["Plan", "make_plan"]
+
+
+def _quiet_first_call(fn: Callable) -> Callable:
+    """Suppress the not-usable-donation nag around ``fn``'s first call.
+
+    The warning is emitted at lowering time — exactly once per compiled
+    program — so only the first invocation needs the filter; steady-state
+    calls pay one bool check.  Concurrent cold callers serialise on a
+    private lock (compiles serialise on jax's internals anyway), keeping
+    the ``catch_warnings`` window single-threaded.
+    """
+    state = {"lowered": False}
+    guard = threading.Lock()
+
+    def wrapped(*args):
+        if state["lowered"]:
+            return fn(*args)
+        with guard:
+            with warnings.catch_warnings():
+                warnings.filterwarnings("ignore", message=_DONATION_NAG)
+                out = fn(*args)
+            state["lowered"] = True
+            return out
+
+    return wrapped
+
+
+class Plan:
+    """A compile + placement recipe bound to one (optional) device mesh.
+
+    Args:
+        mesh: a 1-D particle-axis :class:`~jax.sharding.Mesh` (axis name
+            :data:`~dist_svgd_tpu.parallel.mesh.AXIS`), or ``None`` for
+            single-device execution.  Build one with
+            :func:`~dist_svgd_tpu.parallel.mesh.make_mesh` or use
+            :func:`make_plan`.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        if mesh is not None and AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"plan mesh must carry the {AXIS!r} axis, got {mesh.axis_names}"
+            )
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------ #
+    # identity
+
+    @property
+    def num_shards(self) -> int:
+        """Devices on the particle axis (1 when single-device)."""
+        return self.mesh.shape[AXIS] if self.mesh is not None else 1
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.mesh is not None
+
+    def __repr__(self) -> str:
+        return f"Plan(num_shards={self.num_shards})"
+
+    def describe(self) -> dict:
+        """JSON-friendly identity for stats()/bench rows."""
+        return {
+            "sharded": self.is_sharded,
+            "num_shards": self.num_shards,
+            "devices": ([str(d) for d in self.mesh.devices.flat]
+                        if self.mesh is not None else None),
+        }
+
+    # ------------------------------------------------------------------ #
+    # shardings
+
+    def replicated(self) -> Optional[NamedSharding]:
+        """Every-device-sees-everything placement (request batches,
+        outputs); ``None`` without a mesh (plain jit semantics)."""
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P())
+
+    def particle_sharding(self, ndim: int = 2) -> Optional[NamedSharding]:
+        """Leading-axis (particle) sharding for an ``ndim``-dim array."""
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P(AXIS, *([None] * (ndim - 1))))
+
+    # ------------------------------------------------------------------ #
+    # placement
+
+    def shard_ensemble(self, particles) -> jax.Array:
+        """Place an ``(n, d)`` ensemble on the plan's devices, sharded
+        along the particle axis (``get_naive_sharding`` discipline).
+
+        Without a mesh this is a no-op pass-through (``jnp.asarray``) —
+        single-device callers keep their uncommitted-array behavior.
+        jax 0.4.x cannot shard a dimension the mesh doesn't divide; such
+        an ensemble is **replicated** instead, with a warning (correct,
+        just not distributed — reshape or repad upstream to win it back).
+        """
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(particles)
+        if self.mesh is None:
+            return arr
+        if arr.shape[0] % self.num_shards:
+            warnings.warn(
+                f"ensemble of {arr.shape[0]} particles is not divisible by "
+                f"{self.num_shards} shards; replicating instead of sharding "
+                "(serving stays correct, the mesh win is lost)",
+                UserWarning,
+                stacklevel=2,
+            )
+            return jax.device_put(arr, self.replicated())
+        return jax.device_put(arr, self.particle_sharding(arr.ndim))
+
+    def replicate(self, value) -> Any:
+        """Place a value replicated on every plan device (no-op without
+        a mesh) — pre-placing dispatch inputs keeps ``donate_argnums``
+        usable (a buffer that must first be resharded cannot be donated).
+        """
+        if self.mesh is None:
+            return value
+        return jax.device_put(value, self.replicated())
+
+    # ------------------------------------------------------------------ #
+    # compile
+
+    def compile(
+        self,
+        fn: Callable,
+        *,
+        donate_argnums: Union[int, Sequence[int], Tuple] = (),
+        static_argnums: Union[int, Sequence[int], Tuple] = (),
+        quiet_donation: bool = True,
+    ) -> Callable:
+        """Compile ``fn`` under this plan.
+
+        With a mesh: ``jit`` with explicit shardings — every argument
+        replicated in, every output replicated back out (the pjit layer
+        of SNIPPETS.md [2]); arrays ``fn`` closes over keep their own
+        committed shardings (a :meth:`shard_ensemble`'d ensemble stays
+        particle-sharded and XLA partitions the reduction).  Without a
+        mesh: plain ``jax.jit`` — the exact pre-plan behavior.
+        ``donate_argnums``/``static_argnums`` pass through either way.
+
+        ``quiet_donation`` (default True) suppresses XLA's not-usable-
+        donation warning around the donating program's lowering call —
+        a deliberate donation of a reduction input can never alias an
+        output, and the nag would fire once per compiled bucket.  Pass
+        False to keep the warning (e.g. when tuning donation on a
+        training loop where "not usable" is the regression signal).
+        """
+        if self.mesh is None:
+            compiled = jax.jit(fn, donate_argnums=donate_argnums,
+                               static_argnums=static_argnums)
+        else:
+            repl = self.replicated()
+            compiled = jax.jit(
+                fn,
+                in_shardings=repl,
+                out_shardings=repl,
+                donate_argnums=donate_argnums,
+                static_argnums=static_argnums,
+            )
+        if quiet_donation and donate_argnums not in ((), None):
+            compiled = _quiet_first_call(compiled)
+        return compiled
+
+
+def make_plan(num_shards: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Plan:
+    """Build a :class:`Plan` over ``num_shards`` devices.
+
+    ``num_shards=None`` uses every visible device; ``1`` (or a host with
+    fewer devices than asked) yields the single-device plan — the same
+    graceful degradation ``make_mesh`` gives the samplers, so one code
+    path serves laptops and pods.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_shards is None:
+        num_shards = len(devices)
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    return Plan(make_mesh(num_shards, devices))

@@ -12,10 +12,15 @@ turns a checkpointed ensemble into a low-latency prediction service:
   never recompiles), with **checkpoint hot reload**
   (:class:`CheckpointHotReloader` watches a manager root and atomically
   swaps the served ensemble between micro-batches — train-while-serving
-  with ``resilience.RunSupervisor``);
+  with ``resilience.RunSupervisor``).  Pass ``plan=``/``mesh=`` and the
+  ensemble is **particle-sharded across the device mesh** — every bucket
+  kernel compiles through ``parallel/plan.py`` with explicit in/out
+  shardings, and hot reload re-places each new generation on the mesh;
 - :mod:`batcher` — :class:`MicroBatcher`: coalesces concurrent requests into
   one fused device call over the whole ensemble, scatters results back
-  per-request, sheds on overflow instead of queueing unboundedly;
+  per-request, sheds on overflow instead of queueing unboundedly, and runs
+  ``lanes=N`` dispatch workers over the shared queue so queue-wait stops
+  serializing behind one in-flight device call;
 - :mod:`server`  — a thin stdlib HTTP front end (``/predict``, ``/healthz``,
   ``/metrics``, ``/slo``) with graceful drain and structured per-request
   records.

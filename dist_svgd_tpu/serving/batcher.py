@@ -31,6 +31,17 @@ tree** per completed request — ``serve.request`` with ``serve.queue_wait`` /
 occupancy — the "where did this slow request spend its time" view.
 :meth:`stats` keeps its per-instance bounded-window semantics (the registry
 aggregates across instances and over the process lifetime).
+
+Worker lanes (round 12): ``lanes=N`` runs N dispatch workers over the one
+shared queue, so ``queue_wait`` stops serializing behind a single in-flight
+device call — while lane 0's dispatch blocks on the fetch, lane 1 pops the
+next coalesced batch and dispatches it (the engine's kernel lookup is
+lock-snapshotted and the XLA execution itself releases the GIL, so lanes
+genuinely overlap; with a mesh-sharded engine every lane's batch still uses
+all devices).  Each lane is labelled in telemetry
+(``svgd_serve_lane_batches_total{lane=...}``, the per-lane in-flight gauge)
+and tagged on its request lane trees, so a stuck lane is visible instead of
+averaged away.
 """
 
 from __future__ import annotations
@@ -39,7 +50,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from concurrent.futures import CancelledError, Future
+from concurrent.futures import CancelledError, Future, InvalidStateError
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -111,6 +122,10 @@ class MicroBatcher:
         dispatch: called with one ``(rows, feature_dim)`` array per batch;
             must return a dict of arrays with leading dimension ``rows``.
         max_batch: coalescing ceiling in rows; larger requests split.
+        lanes: dispatch worker threads over the shared queue (default 1 —
+            the old serialized behavior).  More lanes overlap device
+            dispatch with coalescing and with other dispatches; pair with
+            a mesh-sharded engine to keep every device busy.
         max_wait_ms: how long the oldest queued request may wait for
             co-travellers before a partial batch is flushed.
         max_queue_rows: bound on queued (not-yet-dispatched) rows; beyond it
@@ -133,6 +148,7 @@ class MicroBatcher:
         dispatch: Callable[[np.ndarray], Dict[str, np.ndarray]],
         *,
         max_batch: int = 256,
+        lanes: int = 1,
         max_wait_ms: float = 2.0,
         max_queue_rows: int = 8192,
         clock: Callable[[], float] = time.monotonic,
@@ -143,12 +159,15 @@ class MicroBatcher:
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if lanes < 1:
+            raise ValueError("lanes must be >= 1")
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         if max_queue_rows < max_batch:
             raise ValueError("max_queue_rows must be >= max_batch")
         self._dispatch = dispatch
         self.max_batch = int(max_batch)
+        self.lanes = int(lanes)
         self._max_wait_s = float(max_wait_ms) / 1e3
         self.max_queue_rows = int(max_queue_rows)
         self._clock = clock
@@ -171,6 +190,12 @@ class MicroBatcher:
         self._queue_wait_ms: deque = deque(maxlen=4096)  # per batch
         self._device_ms: deque = deque(maxlen=4096)  # per batch
         self._latency_ms: deque = deque(maxlen=8192)  # per request, end to end
+        # per-lane fairness counters (round 12): a stuck/starved lane is
+        # visible here and in the lane-labelled registry series instead of
+        # being averaged into the aggregate
+        self._lane_batches = [0] * self.lanes
+        self._lane_requests = [0] * self.lanes
+        self._lane_rows = [0] * self.lanes
 
         # process-wide telemetry (shared registry; get-or-create, so several
         # batchers aggregate into the same counter/histogram series — the
@@ -207,8 +232,20 @@ class MicroBatcher:
         self._m_batch_rows = reg.histogram(
             "svgd_serve_batch_rows", "rows per dispatched batch",
             buckets=_BATCH_ROW_BUCKETS)
+        # lane-labelled series (per-instance + per-lane labels): counters
+        # for fairness, and an in-flight gauge a stuck lane pins nonzero
+        self._m_lane_batches = reg.counter(
+            "svgd_serve_lane_batches_total", "batches dispatched per lane")
+        self._m_lane_requests = reg.counter(
+            "svgd_serve_lane_requests_total", "requests resolved per lane")
+        self._m_lane_rows = reg.counter(
+            "svgd_serve_lane_rows_total", "rows dispatched per lane")
+        self._m_lane_inflight = reg.gauge(
+            "svgd_serve_lane_inflight_rows",
+            "rows currently inside a lane's dispatch (0 when idle; a lane "
+            "stuck in a hung device call stays nonzero)")
 
-        self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         if autostart:
             self.start()
 
@@ -256,11 +293,14 @@ class MicroBatcher:
     # worker side
 
     def start(self) -> None:
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._loop, name="microbatcher", daemon=True
-            )
-            self._thread.start()
+        if not self._threads:
+            for lane in range(self.lanes):
+                t = threading.Thread(
+                    target=self._loop, args=(lane,),
+                    name=f"microbatcher-l{lane}", daemon=True,
+                )
+                self._threads.append(t)
+                t.start()
 
     def _collect(self) -> Optional[List[_Chunk]]:
         """Block until a batch is ready (max_batch reached, max_wait expired,
@@ -290,13 +330,16 @@ class MicroBatcher:
                                         batcher=self.metrics_instance)
                 return batch
 
-    def _run_batch(self, batch: List[_Chunk]) -> None:
+    def _run_batch(self, batch: List[_Chunk], lane: int = 0) -> None:
         rows = sum(c.x.shape[0] for c in batch)
+        lane_label = f"l{lane}"
         tracer = _trace.get_tracer()
         t0 = self._clock()
         t_pop = tracer.now() if tracer is not None else 0.0
         queue_wait_ms = (t0 - min(c.req.enqueued for c in batch)) * 1e3
         x = np.concatenate([c.x for c in batch], axis=0)
+        self._m_lane_inflight.set(rows, batcher=self.metrics_instance,
+                                  lane=lane_label)
         t_disp0 = tracer.now() if tracer is not None else 0.0
         try:
             out = self._dispatch(x)
@@ -304,27 +347,46 @@ class MicroBatcher:
             with self._cond:
                 self._n_errors += 1
             self._m_errors.inc()
+            self._m_lane_inflight.set(0, batcher=self.metrics_instance,
+                                      lane=lane_label)
             for c in batch:
-                if not c.req.future.done():
+                try:
                     c.req.future.set_exception(e)
+                except InvalidStateError:
+                    # another lane resolved a sibling chunk's request (a
+                    # split request erroring in two batches at once) —
+                    # first resolution wins, and losing must not kill
+                    # this lane thread
+                    pass
             return
         t_disp1 = tracer.now() if tracer is not None else 0.0
+        self._m_lane_inflight.set(0, batcher=self.metrics_instance,
+                                  lane=lane_label)
         device_ms = (self._clock() - t0) * 1e3
-        done_requests = []
-        offset = 0
-        for c in batch:
-            n = c.x.shape[0]
-            c.req.parts[c.index] = {k: v[offset : offset + n] for k, v in out.items()}
-            offset += n
-            if all(p is not None for p in c.req.parts):
-                done_requests.append(c.req)
         now = self._clock()
         with self._cond:
+            # chunk reassembly UNDER the lock: with lanes > 1, the chunks
+            # of one split request can finish in different lanes at the
+            # same moment — the write-then-completeness-check must be
+            # atomic so exactly ONE lane observes the final fill (else
+            # both count the request and race future.set_result)
+            done_requests = []
+            offset = 0
+            for c in batch:
+                n = c.x.shape[0]
+                c.req.parts[c.index] = {
+                    k: v[offset : offset + n] for k, v in out.items()
+                }
+                offset += n
+                if all(p is not None for p in c.req.parts):
+                    done_requests.append(c.req)
             self._n_batches += 1
             self._occupancy.append(rows)
             self._requests_per_batch.append(len(batch))
             self._queue_wait_ms.append(queue_wait_ms)
             self._device_ms.append(device_ms)
+            self._lane_batches[lane] += 1
+            self._lane_rows[lane] += rows
             latencies = []
             for req in done_requests:
                 self._n_requests += 1
@@ -333,10 +395,19 @@ class MicroBatcher:
                 lat_ms = (now - req.enqueued) * 1e3
                 self._latency_ms.append(lat_ms)
                 latencies.append((req, n_rows, lat_ms))
+            self._lane_requests[lane] += len(latencies)
         self._m_batches.inc()
         self._m_batch_rows.observe(rows)
         self._m_queue_wait.observe(queue_wait_ms / 1e3)
         self._m_device.observe(device_ms / 1e3)
+        self._m_lane_batches.inc(batcher=self.metrics_instance,
+                                 lane=lane_label)
+        self._m_lane_rows.inc(rows, batcher=self.metrics_instance,
+                              lane=lane_label)
+        if latencies:
+            self._m_lane_requests.inc(len(latencies),
+                                      batcher=self.metrics_instance,
+                                      lane=lane_label)
         for req, n_rows, lat_ms in latencies:
             self._m_requests.inc()
             self._m_rows.inc(n_rows)
@@ -357,17 +428,20 @@ class MicroBatcher:
                 tracer.lane_tree(
                     "serve.request", enq, t_reply,
                     {"rows": n_rows, "n_chunks": req.n_chunks,
-                     "batch_rows": rows, "batch_requests": len(batch)},
+                     "batch_rows": rows, "batch_requests": len(batch),
+                     "lane": lane_label},
                     children=[
                         ("serve.queue_wait", enq, t_pop, None),
                         ("serve.coalesce", t_pop, t_disp0,
                          {"requests": len(batch), "rows": rows}),
-                        ("serve.dispatch", t_disp0, t_disp1, {"rows": rows}),
+                        ("serve.dispatch", t_disp0, t_disp1,
+                         {"rows": rows, "lane": lane_label}),
                     ],
                 )
         if self._logger is not None:
             self._logger.log(
                 event="batch",
+                lane=lane_label,
                 rows=rows,
                 requests=len(batch),
                 queue_wait_ms=round(queue_wait_ms, 3),
@@ -378,15 +452,20 @@ class MicroBatcher:
             result = {
                 k: np.concatenate([p[k] for p in req.parts], axis=0) for k in keys
             }
-            if not req.future.done():
+            try:
                 req.future.set_result(result)
+            except InvalidStateError:
+                # already failed by a sibling chunk's dispatch error (the
+                # completion check above makes this lane the only
+                # *resolver*, but an error lane may have beaten it)
+                pass
 
-    def _loop(self) -> None:
+    def _loop(self, lane: int = 0) -> None:
         while True:
             batch = self._collect()
             if batch is None:
                 return
-            self._run_batch(batch)
+            self._run_batch(batch, lane)
 
     # ------------------------------------------------------------------ #
     # lifecycle / metrics
@@ -405,8 +484,8 @@ class MicroBatcher:
                     if not req.future.done():
                         req.future.set_exception(CancelledError("batcher closed"))
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
+        for t in self._threads:
+            t.join(timeout=timeout)
 
     def __enter__(self):
         return self
@@ -433,6 +512,13 @@ class MicroBatcher:
                 "shed": self._n_shed,
                 "dispatch_errors": self._n_errors,
                 "queued_rows": self._queued_rows,
+                "lanes": self.lanes,
+                "lane_batches": {f"l{i}": v
+                                 for i, v in enumerate(self._lane_batches)},
+                "lane_requests": {f"l{i}": v
+                                  for i, v in enumerate(self._lane_requests)},
+                "lane_rows": {f"l{i}": v
+                              for i, v in enumerate(self._lane_rows)},
             }
         lat.sort()
         qw.sort()

@@ -18,6 +18,20 @@ engine closes both gaps:
   cached jitted kernel, so at most ``log2(max_bucket/min_bucket)+1`` programs
   are ever traced regardless of traffic mix.  Hits/misses are counted
   (:meth:`stats`) — steady-state traffic must be all hits.
+- **Mesh-sharded dispatch** (round 12): pass ``plan``/``mesh`` and the
+  ensemble is placed with ``NamedSharding(mesh, PartitionSpec('shards',
+  None))`` while every bucket kernel compiles through the unified
+  :class:`~dist_svgd_tpu.parallel.plan.Plan` entrypoint — replicated
+  request batches in, particle-sharded reduction inside, replicated
+  outputs out.  The mesh that trains the ensemble now serves it; without
+  a mesh the plan degrades to exactly the old single-device ``jit``.
+  Hot reload re-places every new generation through the same plan, so a
+  swap can never silently de-shard the served ensemble.
+- **Buffer donation + low-precision** (round 12): dispatch inputs are
+  pre-placed replicated and donated (``donate=False`` opts out), and an
+  opt-in ``dtype=jnp.bfloat16`` stores + computes the ensemble in bf16
+  while keeping f32 request/response surfaces (outputs are upcast in the
+  kernel; numerics pinned vs the f32 path in tests/test_plan.py).
 
 Padding is exact, not approximate: every per-row output depends only on that
 row (row-wise matmul + elementwise + particle-axis reduction), so the served
@@ -38,6 +52,7 @@ import numpy as np
 
 from dist_svgd_tpu.models import bnn as bnn_model
 from dist_svgd_tpu.models.logreg import posterior_predictive_prob
+from dist_svgd_tpu.parallel.plan import Plan
 from dist_svgd_tpu.telemetry import metrics as _metrics
 from dist_svgd_tpu.telemetry import trace as _trace
 
@@ -96,6 +111,24 @@ class PredictiveEngine:
             bucket).  Requests larger than the rounded ``max_bucket`` are
             rejected — the batcher splits oversize requests *before* the
             engine sees them.
+        plan / mesh: mesh-sharded dispatch (round 12).  ``plan`` is a
+            :class:`~dist_svgd_tpu.parallel.plan.Plan`; ``mesh`` is the
+            shorthand (a 1-D ``'shards'``-axis ``Mesh``, wrapped into a
+            plan).  The ensemble is particle-sharded across the plan's
+            devices and every bucket kernel compiles with explicit
+            in/out shardings; omit both (or pass a mesh-less plan) for
+            the single-device path.  A particle count the mesh doesn't
+            divide replicates with a warning instead of failing.
+        dtype: opt-in low-precision serve path (``jnp.bfloat16``): the
+            ensemble is stored and the kernels compute in this dtype;
+            request/response surfaces stay f32 (inputs cast inside the
+            kernel, outputs upcast before the fetch).  Default ``None``
+            keeps the checkpoint's dtype untouched.
+        donate: donate the dispatch input buffer to XLA
+            (``donate_argnums``) so steady-state ``/predict`` stops
+            re-allocating it per call; served values are unchanged (the
+            bitwise E2E pin covers this path).  Reload warm-up buffers
+            ride the same compiled programs and are donated too.
         registry: ``telemetry.MetricsRegistry`` for the compile-cache
             hit/miss/reload counters (default: the process-wide registry).
             :meth:`stats` keeps per-instance counts alongside.
@@ -121,6 +154,10 @@ class PredictiveEngine:
         kde_bandwidth: float = 1.0,
         min_bucket: int = 8,
         max_bucket: int = 4096,
+        plan: Optional[Plan] = None,
+        mesh=None,
+        dtype=None,
+        donate: bool = True,
         registry: Optional[_metrics.MetricsRegistry] = None,
         reload_policy=None,
     ):
@@ -130,17 +167,23 @@ class PredictiveEngine:
             raise ValueError(
                 f"need 1 <= min_bucket <= max_bucket, got {min_bucket}/{max_bucket}"
             )
+        if plan is not None and mesh is not None:
+            raise ValueError("pass plan= or mesh=, not both")
+        self._plan = plan if plan is not None else Plan(mesh)
+        self._donate = bool(donate)
+        self._compute_dtype = jnp.dtype(dtype) if dtype is not None else None
+        if (self._compute_dtype is not None
+                and not jnp.issubdtype(self._compute_dtype, jnp.floating)):
+            raise ValueError(
+                f"dtype must be a float dtype, got {self._compute_dtype}"
+            )
         # normalise both ends up to powers of two: a non-pow2 max_bucket
         # (e.g. --max-batch 100) would otherwise admit requests whose bucket
         # (128) warmup() never traced — an in-window recompile that breaks
         # the steady-state contract
         min_bucket = 1 << (min_bucket - 1).bit_length()
         max_bucket = 1 << (max_bucket - 1).bit_length()
-        self._particles = jnp.asarray(particles)
-        if self._particles.ndim != 2:
-            raise ValueError(
-                f"particles must be (n, d), got shape {self._particles.shape}"
-            )
+        self._particles = self._place_ensemble(particles)
         self.model = model
         n, d = self._particles.shape
         if model == "logreg":
@@ -272,6 +315,32 @@ class PredictiveEngine:
         """Expected per-row input width for :meth:`predict`."""
         return self._feature_dim
 
+    @property
+    def plan(self) -> Plan:
+        """The sharding plan dispatch compiles under."""
+        return self._plan
+
+    def _place_ensemble(self, particles) -> jax.Array:
+        """Validate, (optionally) cast to the compute dtype, and place on
+        the plan's devices — used by both cold start and :meth:`reload`,
+        so a hot swap can never de-shard or de-cast the served ensemble."""
+        arr = jnp.asarray(particles)
+        if arr.ndim != 2:
+            raise ValueError(
+                f"particles must be (n, d), got shape {arr.shape}"
+            )
+        if (self._compute_dtype is not None
+                and arr.dtype != self._compute_dtype):
+            arr = arr.astype(self._compute_dtype)
+        return self._plan.shard_ensemble(arr)
+
+    def _input_dtype(self, particle_dtype):
+        """Request-surface dtype for dispatch inputs: the ensemble's own
+        dtype, except sub-f32 compute dtypes keep an f32 wire format (the
+        kernel casts inside — callers never build bf16 numpy arrays)."""
+        return (jnp.float32 if jnp.dtype(particle_dtype).itemsize < 4
+                else particle_dtype)
+
     def _build_kernel(self, particles):
         """The padded-batch predictive program over ``particles`` (traced
         per bucket; the ensemble is closed over, so a hot reload builds a
@@ -314,7 +383,26 @@ class PredictiveEngine:
                 ) - math.log(particles.shape[0])
                 return {"log_density": log_density}
 
-        return jax.jit(kernel)
+        low_precision = jnp.dtype(particles.dtype).itemsize < 4
+
+        def dispatch(x):
+            # the wire format stays f32 around a low-precision compute
+            # dtype: cast in, compute in particles.dtype, upcast out —
+            # callers (and the response JSON) never see bf16
+            if low_precision:
+                x = x.astype(particles.dtype)
+            out = kernel(x)
+            if low_precision:
+                out = {k: v.astype(jnp.float32) for k, v in out.items()}
+            return out
+
+        # one compile entrypoint for both worlds (parallel/plan.py): with
+        # a mesh the bucket program partitions the particle-axis reduction
+        # across devices (replicated in/out shardings); without one this
+        # is exactly the old single-device jit.  The padded input buffer
+        # is donated so steady-state dispatch stops re-allocating it.
+        return self._plan.compile(
+            dispatch, donate_argnums=(0,) if self._donate else ())
 
     def _kernel_for(self, bucket: int):
         """Returns ``(fn, dtype)`` snapshotted under one lock acquisition:
@@ -329,7 +417,7 @@ class PredictiveEngine:
             else:
                 self._hits += 1
                 miss = False
-            dtype = self._particles.dtype
+            dtype = self._input_dtype(self._particles.dtype)
         # registry write outside the engine lock (its own lock suffices)
         (self._m_misses if miss else self._m_hits).inc()
         return fn, dtype
@@ -375,7 +463,11 @@ class PredictiveEngine:
                     x = xp
             with _trace.span("engine.dispatch",
                              {"bucket": bucket} if traced else None):
-                out = fn(jnp.asarray(x, dtype=dtype))
+                # pre-place the input replicated on the plan's devices: a
+                # buffer already matching in_shardings is donatable as-is
+                # (a mismatched one would be resharded first and the
+                # donation silently lost)
+                out = fn(self._plan.replicate(jnp.asarray(x, dtype=dtype)))
                 # slice AFTER the host fetch: a device-array v[:b] is a
                 # compiled slice program per (bucket, b) shape pair — same
                 # silent-retrace class as the pad above.  The fetch doubles
@@ -461,6 +553,11 @@ class PredictiveEngine:
                         # _postmortem discipline)
                         pass
                 raise EnsembleRejected(reasons, new_report)
+        # place the admitted generation exactly like the cold start did
+        # (shard + compute-dtype cast): a reload must never de-shard or
+        # de-cast the served ensemble (pinned in tests/test_plan.py)
+        particles = self._place_ensemble(particles)
+        warm_dtype = self._input_dtype(particles.dtype)
         new_kernels: Dict[int, Any] = {}
         with self._lock:
             buckets = sorted(self._kernels)
@@ -471,8 +568,8 @@ class PredictiveEngine:
                 if b not in new_kernels:
                     fn = self._build_kernel(particles)
                     if warm:
-                        fn(jnp.zeros((b, self._feature_dim),
-                                     particles.dtype))
+                        fn(self._plan.replicate(
+                            jnp.zeros((b, self._feature_dim), warm_dtype)))
                     new_kernels[b] = fn
             with self._lock:
                 # a predict may have compiled a NEW bucket while we warmed
@@ -501,6 +598,9 @@ class PredictiveEngine:
                 "model": self.model,
                 "n_particles": self.n_particles,
                 "feature_dim": self._feature_dim,
+                "dtype": str(self._particles.dtype),
+                "donate_inputs": self._donate,
+                "plan": self._plan.describe(),
                 "bucket_hits": self._hits,
                 "bucket_misses": self._misses,
                 "compiled_buckets": sorted(self._kernels),

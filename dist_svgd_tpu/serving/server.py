@@ -58,6 +58,7 @@ class PredictionServer:
         host: str = "127.0.0.1",
         port: int = 8000,
         max_batch: int = 256,
+        lanes: int = 1,
         max_wait_ms: float = 2.0,
         max_queue_rows: int = 8192,
         request_timeout_s: float = 30.0,
@@ -73,6 +74,7 @@ class PredictionServer:
         self.batcher = batcher or MicroBatcher(
             engine.predict,
             max_batch=max_batch,
+            lanes=lanes,
             max_wait_ms=max_wait_ms,
             max_queue_rows=max_queue_rows,
             logger=None,  # batch records would interleave with request records
@@ -214,6 +216,8 @@ class PredictionServer:
             "model": st["model"],
             "n_particles": st["n_particles"],
             "feature_dim": st["feature_dim"],
+            "devices": st["plan"]["num_shards"],
+            "lanes": self.batcher.lanes,
             "uptime_s": round(time.time() - self._started, 1),
         }
 
@@ -277,6 +281,19 @@ def main(argv=None):
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--lanes", type=int, default=1,
+                    help="batcher dispatch worker lanes over the shared "
+                         "queue (N frontend lanes, one engine)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="shard the served ensemble across this many "
+                         "devices (0 = every visible device; 1 = "
+                         "single-device). Falls back gracefully when the "
+                         "host has fewer devices")
+    ap.add_argument("--dtype", choices=("float32", "bfloat16"),
+                    default=None,
+                    help="opt-in low-precision serve kernels (the "
+                         "ensemble is stored+computed in this dtype; "
+                         "request/response stay f32)")
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--max-queue-rows", type=int, default=8192)
     ap.add_argument("--request-log", default=None,
@@ -289,10 +306,14 @@ def main(argv=None):
 
     from dist_svgd_tpu.utils.metrics import JsonlLogger
 
+    from dist_svgd_tpu.parallel.plan import make_plan
+
     source = args.checkpoint[0] if len(args.checkpoint) == 1 else args.checkpoint
+    plan = make_plan(args.shards if args.shards else None)
     engine = PredictiveEngine.from_checkpoint(
         source, args.model, n_features=args.n_features, n_hidden=args.n_hidden,
         kde_bandwidth=args.kde_bandwidth, max_bucket=args.max_batch,
+        plan=plan, dtype=args.dtype,
     )
     if args.warmup:
         compiled = engine.warmup()
@@ -300,8 +321,8 @@ def main(argv=None):
     logger = JsonlLogger(path=args.request_log) if args.request_log else None
     srv = PredictionServer(
         engine, host=args.host, port=args.port, max_batch=args.max_batch,
-        max_wait_ms=args.max_wait_ms, max_queue_rows=args.max_queue_rows,
-        logger=logger,
+        lanes=args.lanes, max_wait_ms=args.max_wait_ms,
+        max_queue_rows=args.max_queue_rows, logger=logger,
     )
     print(json.dumps({"serving": srv.url, **srv.health()}), flush=True)
     srv.serve_forever()

@@ -26,6 +26,15 @@ In-process by default (engine + batcher, no network noise — the number
 ``perf_regress.py``'s ``serve_throughput`` incumbent gates); ``--url`` points
 the closed loop at a live ``serving.server`` instead (adds HTTP+JSON cost).
 
+Mesh-sharded serving (round 12): ``--devices N`` shards the ensemble across
+N devices (emulated on CPU hosts via
+``--xla_force_host_platform_device_count``, the MULTICHIP bench pattern) and
+emits the ``serve_sharded`` row — same schema plus ``devices``/``lanes``/
+``dtype`` and per-lane ``lane_fairness`` counts; ``--lanes N`` runs N
+batcher worker lanes over the shared engine (meaningful with or without a
+mesh); ``--dtype bfloat16`` serves the low-precision kernels and stamps the
+same-session ``dtype_speedup`` vs an f32 reference loop.
+
 Output: one JSON row, e.g.::
 
     {"metric": "serve_throughput", "value": 1234.5, "unit": "requests/sec",
@@ -64,18 +73,29 @@ from dist_svgd_tpu.serving.batcher import _percentile  # noqa: E402
 
 
 def build_engine(model="logreg", n_particles=10_000, n_features=54,
-                 checkpoint=None, seed=0, max_bucket=256, registry=None):
+                 checkpoint=None, seed=0, max_bucket=256, registry=None,
+                 devices=1, dtype=None):
     """Checkpointed ensemble when given, else a seeded synthetic one —
-    serving throughput depends on shapes, not on convergence."""
+    serving throughput depends on shapes, not on convergence.
+
+    ``devices > 1`` shards the ensemble across that many devices through
+    the unified :class:`~dist_svgd_tpu.parallel.plan.Plan` (falling back
+    to single-device when the host has fewer — ``make_plan``'s graceful
+    degradation); ``dtype`` opts into the low-precision serve kernels.
+    """
     import numpy as np
 
+    from dist_svgd_tpu.parallel.plan import make_plan
     from dist_svgd_tpu.serving import PredictiveEngine
 
+    plan = make_plan(devices) if devices and devices > 1 else None
+    kw = dict(max_bucket=max_bucket, registry=registry, plan=plan,
+              dtype=dtype)
     if checkpoint:
         source = checkpoint if len(checkpoint) > 1 else checkpoint[0]
         return PredictiveEngine.from_checkpoint(
             source, model, n_features=n_features if model == "bnn" else None,
-            max_bucket=max_bucket, registry=registry,
+            **kw,
         )
     rng = np.random.default_rng(seed)
     if model == "logreg":
@@ -89,7 +109,7 @@ def build_engine(model="logreg", n_particles=10_000, n_features=54,
     return PredictiveEngine(
         model, parts.astype(np.float32),
         n_features=n_features if model == "bnn" else None,
-        max_bucket=max_bucket, registry=registry,
+        **kw,
     )
 
 
@@ -224,8 +244,18 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
               clients=16, requests=2000, rows=(1, 4, 16), max_batch=256,
               max_wait_ms=2.0, max_queue_rows=8192, open_rate=0.0,
               open_requests=500, checkpoint=None, seed=0, url=None,
-              engine=None, trace=None, slo_p99_ms=100.0):
+              engine=None, trace=None, slo_p99_ms=100.0,
+              devices=1, lanes=1, dtype=None):
     """Measure and return the JSON row (importable — perf_regress uses this).
+
+    Mesh-sharded serving (round 12): ``devices > 1`` shards the ensemble
+    across the mesh and flips the row's metric to ``serve_sharded`` (the
+    row carries ``devices``/``lanes``, per-lane fairness counters, and the
+    lane-labelled in-flight gauges); ``lanes`` runs that many batcher
+    worker lanes over the shared engine either way.  ``dtype='bfloat16'``
+    serves the low-precision kernel path and additionally measures an
+    interleaved f32 reference loop on the same shapes, stamping
+    ``f32_rps`` + ``dtype_speedup`` into the row.
 
     ``trace``: a path enables the span tracer for the timed window and
     exports a Perfetto-loadable Chrome trace there (``True`` traces without
@@ -251,18 +281,33 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
     from dist_svgd_tpu import telemetry
     from dist_svgd_tpu.serving import MicroBatcher
 
+    if url:
+        # url mode measures a REMOTE server: the local engine below only
+        # supplies feature_dim/request shapes, so local topology flags
+        # must not label the row (a serve_sharded metric has to describe
+        # the engine that served the traffic, not the load generator)
+        devices, lanes, dtype = 1, 1, None
     registry = telemetry.MetricsRegistry()
+    prebuilt_engine = engine is not None
     if engine is None:
         engine = build_engine(model, n_particles, n_features, checkpoint,
-                              seed, max_bucket=max_batch, registry=registry)
+                              seed, max_bucket=max_batch, registry=registry,
+                              devices=devices, dtype=dtype)
     pool = _request_pool(engine.feature_dim, list(rows))
+    plan_info = engine.stats()["plan"]
+    sharded = bool(plan_info["sharded"])
     row = {
-        "metric": "serve_throughput",
+        # one metric name per serving topology: the sharded row gates
+        # against its own incumbent window, not the single-device one
+        "metric": "serve_sharded" if sharded else "serve_throughput",
         "unit": "requests/sec",
         "platform": jax.devices()[0].platform,
         "model": engine.model,
         "n_particles": engine.n_particles,
         "feature_dim": engine.feature_dim,
+        "devices": plan_info["num_shards"],
+        "lanes": lanes,
+        "dtype": engine.stats()["dtype"],
         "clients": clients,
         "requests": requests,
         "rows_per_request": list(rows),
@@ -281,8 +326,9 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
     engine.warmup()  # steady-state measurement: no compiles in the window
     misses_before = engine.stats()["bucket_misses"]
     batcher = MicroBatcher(
-        engine.predict, max_batch=max_batch, max_wait_ms=max_wait_ms,
-        max_queue_rows=max_queue_rows, registry=registry,
+        engine.predict, max_batch=max_batch, lanes=lanes,
+        max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+        registry=registry,
     )
     # tracing covers exactly the timed window (warmup compiles stay out of
     # the trace, like they stay out of the sentry count); idempotent enable
@@ -347,7 +393,49 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
                            batcher=batcher.metrics_instance),
                    "shed_total": registry.counter(
                        "svgd_serve_shed_total").value()},
+        # per-lane fairness (round 12): raw per-lane resolution counts plus
+        # the lane-labelled in-flight gauges — a stuck lane shows up as a
+        # starved count and a pinned nonzero gauge instead of vanishing
+        # into the aggregate means
+        lane_fairness={
+            "lanes": lanes,
+            "requests": bstats["lane_requests"],
+            "batches": bstats["lane_batches"],
+            "inflight_rows_last": {
+                f"l{i}": registry.gauge(
+                    "svgd_serve_lane_inflight_rows").value(
+                        batcher=batcher.metrics_instance, lane=f"l{i}")
+                for i in range(lanes)
+            },
+        },
     )
+    if (dtype is not None and not prebuilt_engine
+            and str(jax.numpy.dtype(dtype)) != "float32"):
+        # low-precision satellite: an interleaved f32 reference loop on
+        # the same shapes/topology (its own registry — the main row's
+        # histograms stay clean), so the speedup is a same-session A/B.
+        # Skipped when the caller supplied the engine (the telemetry A/B
+        # reuses one warmed engine across many calls — re-measuring the
+        # f32 reference each time would be pure waste)
+        ref_engine = build_engine(model, n_particles, n_features,
+                                  checkpoint, seed, max_bucket=max_batch,
+                                  registry=telemetry.MetricsRegistry(),
+                                  devices=devices, dtype=None)
+        ref_engine.warmup()
+        ref_batcher = MicroBatcher(
+            ref_engine.predict, max_batch=max_batch, lanes=lanes,
+            max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+            registry=telemetry.MetricsRegistry(),
+        )
+        try:
+            ref = closed_loop(ref_batcher.submit, pool, clients, requests)
+        finally:
+            ref_batcher.close(drain=True)
+        row.update(
+            f32_rps=round(ref["rps"], 1),
+            dtype_speedup=round(closed["rps"] / ref["rps"], 3)
+            if ref["rps"] > 0 else None,
+        )
     if tracer is not None:
         if isinstance(trace, str):
             n_events = tracer.export_chrome(trace)
@@ -400,6 +488,7 @@ def measure_telemetry_overhead(rounds=3, **kw):
         kw.get("model", "logreg"), kw.get("n_particles", 10_000),
         kw.get("n_features", 54), kw.get("checkpoint"), kw.get("seed", 0),
         max_bucket=kw.get("max_batch", 256),
+        devices=kw.get("devices", 1), dtype=kw.get("dtype"),
     )
     engine.warmup()
     best = {"off": 0.0, "on": 0.0}
@@ -427,6 +516,19 @@ def main():
     ap.add_argument("--checkpoint", action="append", default=None,
                     help="serve a real ensemble (repeatable for one "
                          "multi-host save); default is a seeded synthetic one")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="shard the served ensemble across this many "
+                         "devices and emit the serve_sharded row; on a "
+                         "CPU host the devices are emulated "
+                         "(--xla_force_host_platform_device_count, the "
+                         "MULTICHIP bench pattern)")
+    ap.add_argument("--lanes", type=int, default=1,
+                    help="batcher dispatch worker lanes over the shared "
+                         "engine")
+    ap.add_argument("--dtype", choices=("float32", "bfloat16"), default=None,
+                    help="serve-kernel compute dtype; bfloat16 also "
+                         "measures the f32 reference loop and stamps "
+                         "dtype_speedup into the row")
     ap.add_argument("--clients", type=int, default=16)
     ap.add_argument("--requests", type=int, default=2000)
     ap.add_argument("--rows", default="1,4,16",
@@ -454,6 +556,23 @@ def main():
                          "disabled/enabled rounds")
     args = ap.parse_args()
 
+    if args.devices > 1:
+        # host device emulation, the MULTICHIP bench pattern: must land in
+        # the environment before the first backend client initialises (no
+        # jax device call has happened yet — imports alone don't init).
+        # The flag only affects the host (CPU) platform; a real TPU host
+        # keeps its real devices and the flag is inert.
+        import re as _re
+
+        flags = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+
     rows = tuple(int(r) for r in args.rows.split(","))
     kw = dict(
         model=args.model, n_particles=args.n_particles,
@@ -462,6 +581,7 @@ def main():
         max_wait_ms=args.max_wait_ms, max_queue_rows=args.max_queue_rows,
         open_rate=args.open_rate, open_requests=args.open_requests,
         checkpoint=args.checkpoint, seed=args.seed,
+        devices=args.devices, lanes=args.lanes, dtype=args.dtype,
     )
     if args.ab_telemetry:
         out = measure_telemetry_overhead(rounds=args.ab_telemetry, **kw)
