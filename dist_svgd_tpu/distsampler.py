@@ -50,7 +50,9 @@ from dist_svgd_tpu.parallel.exchange import (
     make_shard_step_sinkhorn_w2,
 )
 from dist_svgd_tpu.parallel.mesh import AXIS, bind_shard_fn, make_mesh
+from dist_svgd_tpu.parallel.plan import Plan
 from dist_svgd_tpu.telemetry import trace as _trace
+from dist_svgd_tpu.utils import checkpoint as _ckpt
 from dist_svgd_tpu.utils.rng import minibatch_key
 
 
@@ -441,6 +443,15 @@ class DistSampler:
             keep = self._rows_per_shard * self._num_shards
             self._data = jax.tree_util.tree_map(lambda a: a[:keep], self._data)
 
+        # Unified compile entrypoint (ROADMAP item 5): every jitted program
+        # this sampler builds — the eager step, the scan runs, the chunked
+        # executors — compiles through the SAME Plan that serves the
+        # predictive engine, so one explicit-sharding path covers any mesh
+        # size (and an elastic resume at a new shard count recompiles once,
+        # through the same entrypoint, instead of per-step).  Without a
+        # real mesh (vmap emulation) the plan degrades to plain jit.
+        self._plan = Plan(self._mesh)
+        self._data_spec = 0 if shard_data else None
         step = make_shard_step(
             logp=self._logp,
             kernel=self._kernel,
@@ -460,10 +471,14 @@ class DistSampler:
             step,
             self._num_shards,
             self._mesh,
-            in_specs=(0, 0 if shard_data else None, 0, None, None, None, None),
+            in_specs=(0, self._data_spec, 0, None, None, None, None),
             out_specs=(0,),
         )
-        self._step = jax.jit(self._bound_step)
+        self._step = self._plan.compile_sharded(
+            self._bound_step,
+            in_specs=(0, self._data_spec, 0, None, None, None, None),
+            out_specs=(0,),
+        )
         self._exchange_every = int(exchange_every)
         self._bound_lagged = None
         self._bound_lagged_record = None  # built lazily on first record run
@@ -612,7 +627,7 @@ class DistSampler:
         # call computes every shard's gradient (no per-block host round-trips)
         if self._sinkhorn_batched is None:
             warm = self._sinkhorn_warm_start
-            self._sinkhorn_batched = jax.jit(
+            self._sinkhorn_batched = self._plan.compile_sharded(
                 jax.vmap(
                     lambda c, p, g: wasserstein_grad_sinkhorn(
                         c, p, eps=self._sinkhorn_eps,
@@ -686,7 +701,18 @@ class DistSampler:
             "w2_pairing": np.asarray(
                 W2_PAIRING_CODES.index(self._w2_pairing), dtype=np.int8
             ),
+            # the minibatch stream's root key: shard-layout-free (per-step
+            # keys fold (root, t)), so a resharded resume re-derives every
+            # later key deterministically from this saved root
+            "rng_batch_key": np.asarray(self._batch_key),
         }
+        # topology manifest (elastic capacity): loaders compare it against
+        # the requested topology BEFORE any array op, and reshard_state
+        # reshapes the save for a different mesh (utils/checkpoint.py)
+        state.update(_ckpt.topology_manifest(
+            self._num_shards, self._num_particles, self._d,
+            self._rows_per_shard,
+        ))
         if self._previous is None:
             state["previous"] = None
         else:
@@ -749,63 +775,14 @@ class DistSampler:
         A target layout needing pre-update rows that the save does not
         contain (``partitions``/S=1 save → exchanged S>1 restore) raises.
         The carried dual cannot be resharded (its pairing is per-block) —
-        the caller zeroes it instead.
+        the caller zeroes it instead.  The stack math is shared with the
+        checkpoint-level reshard (:func:`dist_svgd_tpu.utils.checkpoint.
+        reshard_previous_stack`); this wrapper just supplies the sampler's
+        target layout.
         """
-        n, d = self._num_particles, self._d
-        want = self._prev_shape()
-        if prev_arr.shape == want:
-            return prev_arr
-        if prev_arr.ndim != 3 or prev_arr.shape[2] != d:
-            raise ValueError(
-                f"checkpoint 'previous' snapshot {prev_arr.shape} is not a "
-                f"snapshot stack for {n} particles of dim {d}"
-            )
-        S_old, rows = prev_arr.shape[0], prev_arr.shape[1]
-        exch_save = rows == n              # mixed per-shard snapshots
-        part_save = rows * S_old == n      # owned-block stacks (S_old == 1:
-        if not (exch_save or part_save):   # both — the post-update global)
-            raise ValueError(
-                f"checkpoint 'previous' snapshot {prev_arr.shape} matches "
-                f"neither a mixed (S, {n}, {d}) nor an owned-block "
-                f"(S, {n}//S, {d}) stack for {n} particles"
-            )
-        if exch_save:
-            s_old = n // S_old
-            post = np.concatenate(
-                [prev_arr[b, b * s_old:(b + 1) * s_old] for b in range(S_old)]
-            )
-        else:
-            post = prev_arr.reshape(n, d)
-        S_new = self._num_shards
-        if len(want) == 3 and want[1] != n:
-            # block-sized target (partitions, or exchanged w2_pairing=
-            # 'block'): owned-block (post-update) stacks
-            return post.reshape(want)
-        if S_new == 1:
-            # the (1, n, d) stack is just the post-update global, whichever
-            # mode family wrote the save
-            return post.reshape(1, n, d)
-        # exchanged target at S_new > 1: needs the pre-update rows
-        if not exch_save or S_old < 2:
-            raise ValueError(
-                f"cannot reshard 'previous' {prev_arr.shape} to {want}: the "
-                "save holds only post-update blocks (partitions-mode, "
-                "w2_pairing='block', or single-shard save), but a global-"
-                f"pairing exchanged stack at num_shards={S_new} needs the "
-                "pre-update rows it never recorded"
-            )
-        s_old = n // S_old
-        pre = np.empty_like(post)
-        for b in range(S_old):
-            # block b's pre-update rows live in any OTHER shard's snapshot
-            pre[b * s_old:(b + 1) * s_old] = (
-                prev_arr[(b + 1) % S_old, b * s_old:(b + 1) * s_old]
-            )
-        out = np.broadcast_to(pre, (S_new, n, d)).copy()
-        s_new = n // S_new
-        for r in range(S_new):
-            out[r, r * s_new:(r + 1) * s_new] = post[r * s_new:(r + 1) * s_new]
-        return out
+        return _ckpt.reshard_previous_stack(
+            prev_arr, self._num_particles, self._d, self._prev_shape()
+        )
 
     def load_state_dict(self, state: dict) -> None:
         """Restore :meth:`state_dict` state.  Single-process restores accept
@@ -818,7 +795,35 @@ class DistSampler:
         Multi-host restores under a different *process* layout go through
         :func:`~dist_svgd_tpu.utils.checkpoint.assemble_full_state` (see
         :meth:`state_dict`); a different *shard count* on a multi-process
-        mesh still requires the saving mesh size."""
+        mesh still requires the saving mesh size.
+
+        When the checkpoint carries a topology manifest it is compared
+        against this sampler BEFORE any array op: a particle-count or
+        dimension mismatch raises
+        :class:`~dist_svgd_tpu.utils.checkpoint.TopologyMismatch` naming
+        both shapes (instead of the raw reshape/broadcast error deep in
+        jax it used to die with); a shard-count difference alone proceeds
+        into the reshard-on-restore path above (multi-process meshes
+        excepted — their blocks need
+        :func:`~dist_svgd_tpu.utils.checkpoint.reshard_state` on the
+        assembled state first)."""
+        # manifest gate first: n/d can never convert, and a foreign shard
+        # count on a multi-process mesh cannot reshard in-place
+        man = _ckpt.check_topology(
+            state,
+            {"n_particles": self._num_particles, "d": self._d},
+            context="checkpoint",
+        )
+        if (man is not None and man["n_shards"] != self._num_shards
+                and self._mesh_is_multiprocess()):
+            raise _ckpt.TopologyMismatch(
+                f"checkpoint was saved at {man['n_shards']} shards but this "
+                f"multi-process mesh runs {self._num_shards}: per-process "
+                "blocks cannot reshard in place — assemble the full state "
+                "(utils.checkpoint.assemble_full_state) and convert it with "
+                "utils.checkpoint.reshard_state(state, "
+                f"{self._num_shards}) first"
+            )
         self._particles = self._restore_global(
             "particles",
             np.asarray(state["particles"]),
@@ -876,6 +881,11 @@ class DistSampler:
                     "the state exactly, but the objective changes)",
                     stacklevel=2,
                 )
+        key = state.get("rng_batch_key")  # absent in pre-elastic checkpoints
+        if key is not None:
+            # the saved minibatch root: layout-free (per-step keys fold
+            # (root, t)), so a resharded resume re-derives the exact stream
+            self._batch_key = jnp.asarray(np.asarray(key))
         self._t = int(state["t"])
 
     # ------------------------------------------------------------------ #
@@ -1241,10 +1251,10 @@ class DistSampler:
                 phi_batch_hint=self._phi_batch_hint,
             )
         b = self._chunk_builders
-        data_spec = 0 if self._shard_data else None
+        data_spec = self._data_spec
         if kind == "local":
             num_hops, rotate_last = args
-            fn = jax.jit(bind_shard_fn(
+            fn = self._plan.compile_sharded(bind_shard_fn(
                 b["local_hops"](num_hops, rotate_last),
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, 0, data_spec, None, None),
@@ -1252,7 +1262,7 @@ class DistSampler:
             ))
         elif kind == "score":
             (num_hops,) = args
-            fn = jax.jit(bind_shard_fn(
+            fn = self._plan.compile_sharded(bind_shard_fn(
                 b["score_hops"](num_hops),
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, data_spec, None, None),
@@ -1260,7 +1270,7 @@ class DistSampler:
             ))
         elif kind == "exact_phi":
             num_hops, rotate_last = args
-            fn = jax.jit(bind_shard_fn(
+            fn = self._plan.compile_sharded(bind_shard_fn(
                 b["exact_phi_hops"](num_hops, rotate_last),
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, 0, 0),
@@ -1269,9 +1279,9 @@ class DistSampler:
         elif kind == "add_prior":
             # row-wise elementwise: applies to the merged global arrays
             # directly, no binding needed (same for 'finish')
-            fn = jax.jit(b["add_prior"])
+            fn = self._plan.compile_sharded(b["add_prior"])
         elif kind == "finish":
-            fn = jax.jit(b["finish"])
+            fn = self._plan.compile_sharded(b["finish"])
         else:  # pragma: no cover - internal
             raise ValueError(f"unknown chunk kind {kind!r}")
         self._chunk_cache[key] = fn
@@ -1303,7 +1313,7 @@ class DistSampler:
                     g_init=None if cold else g, return_g=True,
                 )
 
-        fn = jax.jit(jax.vmap(per))
+        fn = self._plan.compile_sharded(jax.vmap(per))
         self._chunk_cache[key] = fn
         return fn
 
@@ -1565,8 +1575,7 @@ class DistSampler:
                 bound = self._bound_step
             stride = self._exchange_every if lagged else 1
 
-            @jax.jit
-            def run(particles, data, t0, batch_key, eps, h):
+            def scan_run(particles, data, t0, batch_key, eps, h):
                 def body(parts, t):
                     new = bound(parts, data, jnp.zeros_like(parts), t,
                                 jax.random.fold_in(batch_key, t), eps, h)
@@ -1587,6 +1596,14 @@ class DistSampler:
                     hist = hist.reshape((num_steps,) + particles.shape)
                 return (out, hist) if record else out
 
+            # plan-routed compile: particles sharded in/out along the mesh
+            # axis (history along its particle axis 1), everything else
+            # replicated — plain jit under the vmap emulation
+            run = self._plan.compile_sharded(
+                scan_run,
+                in_specs=(0, self._data_spec, None, None, None, None),
+                out_specs=(0, 1) if record else (0,),
+            )
             self._scan_cache[(num_steps, record, lagged)] = run
         out = run(
             self._particles,
@@ -1643,8 +1660,8 @@ class DistSampler:
         if run is None:
             bound = self._bound_w2_step
 
-            @jax.jit
-            def run(particles, prev, g_dual, w0, data, t0, batch_key, eps, h):
+            def scan_run(particles, prev, g_dual, w0, data, t0, batch_key,
+                         eps, h):
                 def body(carry, ti):
                     parts, prv, g = carry
                     t, i = ti
@@ -1667,6 +1684,14 @@ class DistSampler:
                 )
                 return out, prev_out, g_out, hist
 
+            # plan-routed: particle array and the per-shard snapshot/dual
+            # stacks sharded along their leading axes, history along axis 1
+            run = self._plan.compile_sharded(
+                scan_run,
+                in_specs=(0, 0, 0, None, self._data_spec, None, None,
+                          None, None),
+                out_specs=(0, 0, 0, 1 if record else None),
+            )
             self._scan_cache[("w2", num_steps, record)] = run
 
         have_prev = self._previous is not None

@@ -44,7 +44,22 @@ from dist_svgd_tpu.parallel.mesh import AXIS, make_mesh
 
 _DONATION_NAG = "Some donated buffers were not usable"
 
-__all__ = ["Plan", "make_plan"]
+__all__ = ["Plan", "make_plan", "nondividing_replicate_warning"]
+
+
+def nondividing_replicate_warning(n: int, num_shards: int) -> str:
+    """The ONE warning text for the replicate-instead-of-shard fallback.
+
+    Emitted by :meth:`Plan.shard_ensemble` at engine construction AND by
+    ``utils/checkpoint.py:reshard_state`` when an elastic resume targets a
+    shard count that does not divide the particle count — the same
+    degradation (correct but no longer distributed) must read the same in
+    logs wherever it happens."""
+    return (
+        f"ensemble of {n} particles is not divisible by "
+        f"{num_shards} shards; replicating instead of sharding "
+        "(serving stays correct, the mesh win is lost)"
+    )
 
 
 def _quiet_first_call(fn: Callable) -> Callable:
@@ -150,9 +165,7 @@ class Plan:
             return arr
         if arr.shape[0] % self.num_shards:
             warnings.warn(
-                f"ensemble of {arr.shape[0]} particles is not divisible by "
-                f"{self.num_shards} shards; replicating instead of sharding "
-                "(serving stays correct, the mesh win is lost)",
+                nondividing_replicate_warning(arr.shape[0], self.num_shards),
                 UserWarning,
                 stacklevel=2,
             )
@@ -209,6 +222,63 @@ class Plan:
                 static_argnums=static_argnums,
             )
         if quiet_donation and donate_argnums not in ((), None):
+            compiled = _quiet_first_call(compiled)
+        return compiled
+
+    def spec_sharding(self, spec: Optional[int]) -> Optional[NamedSharding]:
+        """Sharding for one ``bind_shard_fn``-style spec entry: ``None`` →
+        replicated, an int ``s`` → split along axis ``s`` (trailing axes
+        replicated, so one spec serves pytree leaves of mixed rank).
+        ``None`` is returned without a mesh (plain-jit semantics)."""
+        if self.mesh is None:
+            return None
+        if spec is None:
+            return self.replicated()
+        return NamedSharding(self.mesh, P(*([None] * spec), AXIS))
+
+    def compile_sharded(
+        self,
+        fn: Callable,
+        in_specs: Optional[Sequence[Optional[int]]] = None,
+        out_specs: Optional[Sequence[Optional[int]]] = None,
+        *,
+        donate_argnums: Union[int, Sequence[int], Tuple] = (),
+        static_argnums: Union[int, Sequence[int], Tuple] = (),
+    ) -> Callable:
+        """Compile a *training* step/scan program under this plan — the
+        sampler half of the unified compile entrypoint (ROADMAP item 5:
+        serving compiled through :meth:`compile` since PR 7; the samplers
+        route here so one explicit-sharding path serves any mesh size, and
+        an elastic resume at a new shard count recompiles once through the
+        same entrypoint instead of growing a private jit per call site).
+
+        ``in_specs`` / ``out_specs`` use ``bind_shard_fn``'s convention
+        (``None`` replicated, int = global split axis); with a mesh they
+        become explicit ``in_shardings``/``out_shardings`` (the particle
+        array stays particle-sharded in and out — unlike :meth:`compile`,
+        whose replicated surfaces are serving semantics), without one —
+        or with ``in_specs=None`` for programs whose placement the bound
+        function already owns — this is plain ``jax.jit``, byte-for-byte
+        the pre-plan behavior.
+        """
+        if self.mesh is None or in_specs is None:
+            compiled = jax.jit(fn, donate_argnums=donate_argnums,
+                               static_argnums=static_argnums)
+        else:
+            if out_specs is None:
+                raise ValueError("out_specs is required when in_specs is given")
+            out_specs = tuple(out_specs)
+            out_sh = (self.spec_sharding(out_specs[0])
+                      if len(out_specs) == 1
+                      else tuple(self.spec_sharding(s) for s in out_specs))
+            compiled = jax.jit(
+                fn,
+                in_shardings=tuple(self.spec_sharding(s) for s in in_specs),
+                out_shardings=out_sh,
+                donate_argnums=donate_argnums,
+                static_argnums=static_argnums,
+            )
+        if donate_argnums not in ((), None):
             compiled = _quiet_first_call(compiled)
         return compiled
 

@@ -14,7 +14,12 @@ already has for overload:
   with a rollback + step-size-backoff policy;
 - :mod:`faults` — deterministic fault injection (raise-on-step-k, NaN into
   the carry, simulated preemption, simulated hard kill, artificial slow
-  dispatch) so every recovery path runs in tier-1 on CPU.
+  dispatch, device loss / mesh shrink / mesh grow) so every recovery path
+  runs in tier-1 on CPU;
+- **elastic capacity** — ``RunSupervisor(reshard=ReshardPolicy(factory))``
+  survives topology faults by resharding the latest checkpoint onto the
+  surviving mesh (``utils/checkpoint.py:reshard_state``) inside the same
+  restart budget; ``tools/elastic_drill.py`` measures it end to end.
 
 The serve side composes through
 ``serving/engine.py:CheckpointHotReloader`` (a live server picks up the
@@ -25,17 +30,22 @@ overhead as one BENCH-style JSON row, and
 """
 
 from dist_svgd_tpu.resilience.faults import (
+    DeviceLossAt,
     FaultPlan,
     HardKillAt,
     InjectNaNAt,
+    MeshGrowAt,
+    MeshShrinkAt,
     PreemptAt,
     RaiseAt,
     SimulatedHardKill,
     SlowSegmentAt,
+    TopologyFault,
     TransientDispatchError,
 )
 from dist_svgd_tpu.resilience.guards import GuardConfig, GuardViolation, check_state
 from dist_svgd_tpu.resilience.supervisor import (
+    ReshardPolicy,
     RestartBudgetExhausted,
     RetryPolicy,
     RunSupervisor,
@@ -44,6 +54,7 @@ from dist_svgd_tpu.resilience.supervisor import (
 __all__ = [
     "RunSupervisor",
     "RetryPolicy",
+    "ReshardPolicy",
     "RestartBudgetExhausted",
     "GuardConfig",
     "GuardViolation",
@@ -54,6 +65,10 @@ __all__ = [
     "PreemptAt",
     "HardKillAt",
     "SlowSegmentAt",
+    "DeviceLossAt",
+    "MeshShrinkAt",
+    "MeshGrowAt",
+    "TopologyFault",
     "TransientDispatchError",
     "SimulatedHardKill",
 ]

@@ -38,6 +38,28 @@ class SimulatedHardKill(RuntimeError):
     ``tools/fault_drill.py`` measures."""
 
 
+class TopologyFault(RuntimeError):
+    """The mesh topology changed under the run — a device dropped out of the
+    pool (the most common real TPU failure) or the scheduler resized the
+    slice.  Deliberately outside the default retryable set: replaying the
+    same segment on the same (now wrong-sized) sampler cannot help.  A
+    supervisor with a :class:`~dist_svgd_tpu.resilience.supervisor.
+    ReshardPolicy` catches it and reshards the latest checkpoint onto the
+    new topology inside the restart budget; without one it propagates like
+    any non-recoverable fault.
+
+    Carries either an explicit ``target_shards`` (mesh shrink/grow notice)
+    or the ``surviving`` device count (device loss — the policy picks the
+    shard count)."""
+
+    def __init__(self, msg: str, *, target_shards: Optional[int] = None,
+                 surviving: Optional[int] = None, lost_devices: int = 0):
+        super().__init__(msg)
+        self.target_shards = target_shards
+        self.surviving = surviving
+        self.lost_devices = int(lost_devices)
+
+
 class Fault:
     """One scheduled fault.  Fires once, at the first segment boundary with
     step counter ``>= step``."""
@@ -91,6 +113,65 @@ class HardKillAt(Fault):
 
     def fire(self, ctx) -> None:
         raise SimulatedHardKill(f"injected hard kill at step {ctx.t}")
+
+
+class DeviceLossAt(Fault):
+    """Simulated loss of ``lost`` mesh device(s): raises
+    :class:`TopologyFault` with the surviving device count, exactly as a
+    real pool-shrink surfaces (the in-flight dispatch dies, the next
+    attempt sees fewer devices).  The supervisor's :class:`ReshardPolicy`
+    picks the new shard count from the survivors."""
+
+    def __init__(self, step: int, lost: int = 1):
+        super().__init__(step)
+        if lost < 1:
+            raise ValueError(f"lost must be >= 1, got {lost}")
+        self.lost = int(lost)
+
+    def fire(self, ctx) -> None:
+        surviving = max(0, ctx.num_shards - self.lost)
+        raise TopologyFault(
+            f"injected loss of {self.lost} device(s) at step {ctx.t} "
+            f"({ctx.num_shards} -> {surviving} surviving)",
+            surviving=surviving, lost_devices=self.lost,
+        )
+
+
+class MeshShrinkAt(Fault):
+    """Scheduler-shaped capacity notice: the mesh must shrink to
+    ``to_shards`` (an explicit target, unlike :class:`DeviceLossAt`'s
+    policy-chosen one)."""
+
+    def __init__(self, step: int, to_shards: int):
+        super().__init__(step)
+        if to_shards < 1:
+            raise ValueError(f"to_shards must be >= 1, got {to_shards}")
+        self.to_shards = int(to_shards)
+
+    def fire(self, ctx) -> None:
+        raise TopologyFault(
+            f"injected mesh shrink to {self.to_shards} shards at step "
+            f"{ctx.t} (from {ctx.num_shards})",
+            target_shards=self.to_shards,
+        )
+
+
+class MeshGrowAt(Fault):
+    """Capacity-returned notice: the mesh may grow to ``to_shards`` — the
+    recovery direction after a loss, same reshard path as the shrink."""
+
+    def __init__(self, step: int, to_shards: int):
+        super().__init__(step)
+        if to_shards < 1:
+            raise ValueError(f"to_shards must be >= 1, got {to_shards}")
+        self.to_shards = int(to_shards)
+
+    def fire(self, ctx) -> None:
+        raise TopologyFault(
+            f"injected mesh grow to {self.to_shards} shards at step "
+            f"{ctx.t} (from {ctx.num_shards})",
+            target_shards=self.to_shards,
+        )
 
 
 class SlowSegmentAt(Fault):

@@ -43,7 +43,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dist_svgd_tpu.resilience.faults import FaultPlan, TransientDispatchError
+from dist_svgd_tpu.resilience.faults import (
+    FaultPlan,
+    TopologyFault,
+    TransientDispatchError,
+)
 from dist_svgd_tpu.resilience.guards import (
     GuardConfig,
     GuardViolation,
@@ -53,7 +57,13 @@ from dist_svgd_tpu.resilience.guards import (
 from dist_svgd_tpu.telemetry import diagnostics as _diagnostics
 from dist_svgd_tpu.telemetry import metrics as _metrics
 from dist_svgd_tpu.telemetry import trace as _trace
-from dist_svgd_tpu.utils.checkpoint import CheckpointManager
+from dist_svgd_tpu.utils.checkpoint import (
+    CheckpointManager,
+    check_topology,
+    read_manifest,
+    reshard_state,
+    topology_manifest,
+)
 
 
 class RestartBudgetExhausted(RuntimeError):
@@ -107,6 +117,73 @@ class RetryPolicy:
             consecutive_failures - 1, 0
         )
         return min(d, self.max_backoff_s)
+
+
+class ReshardPolicy:
+    """Elastic-capacity policy: how :class:`RunSupervisor` rebuilds the
+    training topology when a :class:`~dist_svgd_tpu.resilience.faults.
+    TopologyFault` fires (device loss, mesh shrink/grow).
+
+    With a policy installed, a topology fault no longer kills the run: the
+    supervisor spends one restart from the SAME budget the transient
+    retries draw on, reshards the latest checkpoint onto the new shard
+    count (``utils/checkpoint.py:reshard_state``), rebuilds the sampler
+    through ``sampler_factory``, and continues on the identical absolute
+    segment grid — steps since the last checkpoint are replayed, nothing
+    else changes.
+
+    Args:
+        sampler_factory: ``factory(num_shards) -> DistSampler`` — a FRESH
+            sampler at the requested topology, constructed exactly as the
+            original was (same model/kernel/options/seed; its initial
+            particles are immediately overwritten by the resharded
+            checkpoint).  ``tools/elastic_drill.py`` shows the pattern.
+        device_loss_strategy: how :class:`~dist_svgd_tpu.resilience.faults.
+            DeviceLossAt` (which names no explicit target) picks the new
+            shard count from the survivors: ``'largest_divisor'`` (default)
+            takes the largest shard count ≤ survivors that divides the
+            particle count — keeping every particle sharded; ``'surviving'``
+            takes the raw survivor count, accepting the replicate-and-warn
+            fallback when it doesn't divide n (``Plan.shard_ensemble``'s
+            degradation, applied by ``reshard_state``).
+    """
+
+    def __init__(self, sampler_factory: Callable[[int], object],
+                 device_loss_strategy: str = "largest_divisor"):
+        if device_loss_strategy not in ("largest_divisor", "surviving"):
+            raise ValueError(
+                f"unknown device_loss_strategy {device_loss_strategy!r}"
+            )
+        self.sampler_factory = sampler_factory
+        self.device_loss_strategy = device_loss_strategy
+
+    def target_for_device_loss(self, surviving: int, n_particles: int) -> int:
+        """Shard count to run on after a device loss left ``surviving``
+        devices (≥ 1 always — the last device serves alone)."""
+        surviving = max(1, int(surviving))
+        if self.device_loss_strategy == "surviving":
+            return surviving
+        for s in range(min(surviving, max(int(n_particles), 1)), 0, -1):
+            if n_particles % s == 0:
+                return s
+        return 1
+
+    def build(self, num_shards: int):
+        """Construct (and validate) the factory's sampler at the target."""
+        sampler = self.sampler_factory(num_shards)
+        if not hasattr(sampler, "run_steps"):
+            raise TypeError(
+                "ReshardPolicy.sampler_factory must build a DistSampler "
+                f"(got {type(sampler).__name__}) — elastic resharding is a "
+                "mesh concept; a single-device Sampler has no topology"
+            )
+        built = getattr(sampler, "_num_shards", None)
+        if built != num_shards:
+            raise ValueError(
+                f"sampler_factory({num_shards}) built a sampler at "
+                f"{built} shards — the factory must honour its argument"
+            )
+        return sampler
 
 
 # --------------------------------------------------------------------- #
@@ -210,11 +287,14 @@ class _SamplerHarness:
             "particles": np.asarray(self.particles),
             "t": np.asarray(self.t, dtype=np.int64),
         }
+        state.update(topology_manifest(1, self._n, self._s._d))
         if self._bandwidth is not None:
             state["kernel_bandwidth"] = np.asarray(self._bandwidth)
         return state
 
     def load_state_dict(self, state: dict) -> None:
+        check_topology(state, {"n_particles": self._n, "d": self._s._d},
+                       context="checkpoint")
         self.particles = jnp.asarray(state["particles"])
         self.t = int(state["t"])
         bw = state.get("kernel_bandwidth")
@@ -291,6 +371,14 @@ class RunSupervisor:
             installed process-wide (``telemetry.install_flight_recorder``)
             at dump time.  A bundle is dumped when a guard trips, a
             non-retryable fault fires, or the restart budget exhausts.
+        reshard: :class:`ReshardPolicy` enabling **elastic capacity**: a
+            :class:`~dist_svgd_tpu.resilience.faults.TopologyFault`
+            (device loss, mesh shrink/grow) is handled by resharding the
+            latest checkpoint onto the new shard count and continuing —
+            one restart spent from the shared budget, a ``train.reshard``
+            span, ``svgd_elastic_*`` counters and a flight-recorder
+            ``topology_transition`` record per transition.  ``None``
+            (default) keeps topology faults non-recoverable.
     """
 
     def __init__(
@@ -314,6 +402,7 @@ class RunSupervisor:
         registry: Optional[_metrics.MetricsRegistry] = None,
         diagnostics=None,
         recorder=None,
+        reshard: Optional[ReshardPolicy] = None,
         n: Optional[int] = None,
         seed=0,
         initial_particles=None,
@@ -386,6 +475,20 @@ class RunSupervisor:
             "svgd_train_segment_seconds", "wall per training segment")
         self._m_steps = reg.counter(
             "svgd_train_steps_total", "SVGD steps completed under supervision")
+        self._reshard = reshard
+        self._m_reshards = reg.counter(
+            "svgd_elastic_reshards_total",
+            "elastic topology transitions, by direction (shrink/grow/same)")
+        self._m_steps_lost = reg.counter(
+            "svgd_elastic_steps_lost_total",
+            "steps replayed because a topology transition resumed from the "
+            "last checkpoint")
+        self._g_shards = reg.gauge(
+            "svgd_elastic_shards",
+            "current shard count of the supervised run's mesh")
+        self._g_shards.set(self._harness.num_shards)
+        self._reshard_events: list = []
+        self._pending_recovery: Optional[dict] = None
         if diagnostics is not None and diagnostics.enabled:
             # a Sampler's own score closure feeds KSD unless the config
             # already names one (DistSampler harnesses contribute none)
@@ -404,6 +507,12 @@ class RunSupervisor:
     def t(self) -> int:
         """Current absolute step counter."""
         return self._harness.t
+
+    @property
+    def num_shards(self) -> int:
+        """Current mesh shard count (1 for a single-device Sampler) — the
+        topology the faults' ``ctx`` sees and elastic resharding changes."""
+        return self._harness.num_shards
 
     def request_stop(self, reason: str = "stop requested") -> None:
         """Preemption-shaped stop: honoured at the next segment boundary
@@ -564,6 +673,81 @@ class RunSupervisor:
         self._sleep(delay)
         self._rollback()
 
+    def _handle_topology(self, err: TopologyFault) -> None:
+        """Elastic reshard: rebuild the sampler at the fault's topology from
+        the latest checkpoint and continue on the same absolute grid —
+        inside the shared restart budget (:meth:`_spend_restart` raises
+        :class:`RestartBudgetExhausted` when it is gone)."""
+        self._spend_restart(err)
+        self._m_restarts.inc(kind="topology")
+        from_shards = self._harness.num_shards
+        n_particles = int(self._harness.particles.shape[0])
+        requested = err.target_shards
+        if requested is None:
+            surviving = (err.surviving if err.surviving is not None
+                         else from_shards - err.lost_devices)
+            requested = self._reshard.target_for_device_loss(
+                surviving, n_particles)
+        t_detected = self._harness.t
+        clock0 = self._clock()
+        with _trace.span("train.reshard",
+                         {"t": t_detected, "from_shards": from_shards,
+                          "requested_shards": requested}):
+            if self._manager is not None:
+                t_good, state = self._manager.restore_latest(with_step=True)
+                if state is None:
+                    t_good, state = self._last_good
+            else:
+                t_good, state = self._last_good
+            new_state = reshard_state(state, requested)
+            man = read_manifest(new_state)
+            to_shards = man["n_shards"] if man is not None else requested
+            sampler = self._reshard.build(to_shards)
+            harness = _DistHarness(sampler, self._harness._h)
+            harness.load_state_dict(new_state)
+            eps = new_state.get("sup_step_size")
+            if eps is not None:
+                self.step_size = float(np.asarray(eps))
+            self.sampler = sampler
+            self._harness = harness
+            self._last_good = (harness.t, new_state)
+            # replayed boundaries re-run diagnostics, like a rollback
+            self._diag_last_t = min(self._diag_last_t, harness.t)
+        reshard_wall = self._clock() - clock0
+        steps_lost = t_detected - harness.t
+        direction = ("grow" if to_shards > from_shards
+                     else "shrink" if to_shards < from_shards else "same")
+        self._m_reshards.inc(direction=direction)
+        self._m_steps_lost.inc(steps_lost)
+        self._g_shards.set(to_shards)
+        event = {
+            "t_detected": t_detected,
+            "resumed_from": harness.t,
+            "from_shards": from_shards,
+            "requested_shards": requested,
+            "to_shards": to_shards,
+            "steps_lost": steps_lost,
+            "reshard_wall_s": round(reshard_wall, 4),
+            # filled when the run regains the detection step (replay done)
+            "recovery_wall_s": None,
+            "_clock0": clock0,
+        }
+        if self._pending_recovery is not None:
+            # a second transition landed before the first replay regained
+            # its detection step: close the superseded window honestly
+            # (recovery_wall_s stays None) instead of leaking its clock
+            self._pending_recovery.pop("_clock0", None)
+        self._reshard_events.append(event)
+        self._pending_recovery = event
+        self._flight("topology_transition", t=t_detected,
+                     from_shards=from_shards, to_shards=to_shards,
+                     steps_lost=steps_lost, reason=str(err))
+        self._log(event="reshard", t=t_detected, resumed_from=harness.t,
+                  from_shards=from_shards, to_shards=to_shards,
+                  steps_lost=steps_lost, reshard_wall_s=round(reshard_wall, 4),
+                  error=f"{type(err).__name__}: {err}")
+        self._sleep(self._retry.delay_s(self._consecutive_failures))
+
     def _handle_guard(self, err: GuardViolation) -> None:
         self._spend_restart(err)
         self._m_restarts.inc(kind="guard")
@@ -613,6 +797,8 @@ class RunSupervisor:
         self._max_seg_wall_s = 0.0
         self._n_checkpoints = 0
         self._n_segments = 0
+        self._reshard_events = []
+        self._pending_recovery = None
         # clear the stop flag BEFORE the (potentially long) resume-restore:
         # a real SIGTERM landing while a large checkpoint loads must be
         # honoured at the first boundary, not silently discarded
@@ -676,6 +862,18 @@ class RunSupervisor:
             except self._retry.retryable as e:
                 self._handle_transient(e)
                 continue
+            except TopologyFault as e:
+                if self._reshard is None or self._harness.kind != "distsampler":
+                    # no elastic policy (or a single-device run, which has
+                    # no topology to reshard): non-recoverable, like any
+                    # fault outside the retry set — black box, propagate
+                    self._flight("fault", t=self._harness.t,
+                                 error=f"{type(e).__name__}: {e}")
+                    self._postmortem("fault",
+                                     error=f"{type(e).__name__}: {e}")
+                    raise
+                self._handle_topology(e)
+                continue
             except Exception as e:
                 # non-retryable fault (a simulated hard kill, a crash
                 # outside the retry set): dump the black box, then
@@ -722,6 +920,14 @@ class RunSupervisor:
                         continue
             self._consecutive_failures = 0
             self._m_steps.inc(k)
+            if (self._pending_recovery is not None
+                    and self._harness.t >= self._pending_recovery["t_detected"]):
+                # the replay regained the step the topology fault landed on:
+                # close the recovery window (reshard + backoff + replay)
+                ev = self._pending_recovery
+                ev["recovery_wall_s"] = round(
+                    self._clock() - ev.pop("_clock0"), 4)
+                self._pending_recovery = None
             self._log(event="segment", t=self._harness.t, steps=k,
                       wall_s=round(seg_wall, 4), step_size=self.step_size)
             if self._manager is not None and (
@@ -738,12 +944,20 @@ class RunSupervisor:
             self._log(event="preempted", t=self._harness.t,
                       reason=self._stop_reason)
 
+        if self._pending_recovery is not None:
+            # run ended (preempt/complete) before the replay regained the
+            # detection step: recovery_wall_s honestly stays None
+            self._pending_recovery.pop("_clock0", None)
+            self._pending_recovery = None
         wall = self._clock() - wall0
         self.report = {
             "status": status,
             "t": self._harness.t,
             "steps_run": self._harness.t - start_t,
             "resumed_from": resumed_from,
+            "num_shards": self._harness.num_shards,
+            "reshards": len(self._reshard_events),
+            "reshard_events": list(self._reshard_events),
             "restarts": self._restarts,
             "checkpoints": self._n_checkpoints,
             "segments": self._n_segments,

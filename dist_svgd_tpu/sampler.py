@@ -14,7 +14,6 @@ at ``num_iter`` (dsvgd/sampler.py:62-73, SURVEY.md §7.4).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional
 
 import jax
@@ -24,6 +23,7 @@ from jax import lax
 
 from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.svgd import svgd_step_sequential
+from dist_svgd_tpu.parallel.plan import Plan
 from dist_svgd_tpu.telemetry import trace as _trace
 from dist_svgd_tpu.utils.history import history_to_dataframe
 from dist_svgd_tpu.utils.rng import as_key, draw_minibatch, init_particles, minibatch_key
@@ -141,6 +141,10 @@ class Sampler:
             else:
                 full = lambda theta: logp(theta, self._data)
         self._score_fn = jax.grad(full)
+        # the single-device plan (ROADMAP item 5: one compile entrypoint
+        # for serving and BOTH samplers) — Plan(None).compile is plain jit,
+        # byte-for-byte the pre-plan behavior
+        self._plan = Plan(None)
         self._compiled = {}
         #: Execution report of the most recent :meth:`run` call (mode,
         #: dispatch counts, steps per dispatch) — see ``DistSampler.
@@ -229,8 +233,7 @@ class Sampler:
                 return parts + step_size * phi_fn(parts, parts, scores)
             return svgd_step_sequential(parts, self._score_fn, step_size, kernel)
 
-        @partial(jax.jit, static_argnums=())
-        def run(particles, step_size, batch_key, i0):
+        def scan_run(particles, step_size, batch_key, i0):
             # i0 offsets the per-step key fold so a budget-chunked run
             # (dispatch_budget) draws the SAME minibatch stream as one
             # monolithic scan — chunk boundaries are invisible to the RNG
@@ -244,6 +247,7 @@ class Sampler:
             final, hist = lax.scan(body, particles, jnp.arange(num_iter))
             return final, hist
 
+        run = self._plan.compile(scan_run)
         self._compiled[cache_key] = run
         return run
 

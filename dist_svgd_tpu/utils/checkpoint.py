@@ -20,6 +20,15 @@ Design:
   ``previous_particles`` snapshot, and the step counter ``t`` that drives both
   the ``partitions`` rotation and the per-step minibatch key fold — restoring
   them reproduces the uninterrupted trajectory bit-for-bit.
+- **Topology manifest + reshard (elastic capacity, ROADMAP item 5):** every
+  sampler ``state_dict`` stamps its shard topology
+  (:func:`topology_manifest` — ``n_shards``, per-shard particle counts, the
+  data partition) into the saved dict, so a loader can compare the saved
+  layout against the requested one and raise :class:`TopologyMismatch`
+  *before* any array op (:func:`check_topology`), and
+  :func:`reshard_state` can reshape a run saved at N shards into one
+  loadable at M — the prerequisite for resuming a checkpointed run on a
+  shrunk/grown mesh instead of dying with the lost device.
 """
 
 from __future__ import annotations
@@ -27,12 +36,283 @@ from __future__ import annotations
 import os
 import re
 import shutil
+import warnings
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 _STEP_DIR_RE = re.compile(r"^step_(\d+)$")
 _NPZ_NAME = "state.npz"
+
+#: Keys of the topology manifest stamped into every sampler checkpoint.
+MANIFEST_KEYS = (
+    "topo_n_shards",
+    "topo_n_particles",
+    "topo_d",
+    "topo_particles_per_shard",
+    "topo_data_rows_per_shard",
+)
+
+
+class TopologyMismatch(ValueError):
+    """A checkpoint's saved topology manifest does not match the topology it
+    is being loaded into.  Raised *before* any array reshape/broadcast runs,
+    with both shapes in one line — the raw jax/numpy error it replaces named
+    neither.  Shard-count-only mismatches are reshardable: convert the state
+    with :func:`reshard_state` first."""
+
+
+def topology_manifest(n_shards: int, n_particles: int, d: int,
+                      data_rows_per_shard: int = 0) -> Dict[str, np.ndarray]:
+    """The manifest entries a sampler ``state_dict`` stamps into every save:
+    shard count, global particle count and dimension, per-shard particle
+    counts (equal blocks — the drop-remainder policy runs at construction),
+    and the per-shard data partition (0 = no data)."""
+    s = int(n_shards)
+    if s < 1:
+        raise ValueError(f"n_shards must be >= 1, got {s}")
+    return {
+        "topo_n_shards": np.asarray(s, dtype=np.int64),
+        "topo_n_particles": np.asarray(int(n_particles), dtype=np.int64),
+        "topo_d": np.asarray(int(d), dtype=np.int64),
+        "topo_particles_per_shard": np.full(s, int(n_particles) // s,
+                                            dtype=np.int64),
+        "topo_data_rows_per_shard": np.asarray(int(data_rows_per_shard),
+                                               dtype=np.int64),
+    }
+
+
+def read_manifest(state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Parse the topology manifest out of a loaded state dict.
+
+    Returns ``{'n_shards', 'n_particles', 'd', 'particles_per_shard',
+    'data_rows_per_shard'}`` or ``None`` when the save predates the manifest
+    **or** the manifest entries are unreadable/internally inconsistent (a
+    corrupt manifest must degrade to the manifest-less path, not crash the
+    restore — the caller warns and falls back to shape inference)."""
+    if state.get("topo_n_shards") is None:
+        return None
+    try:
+        man = {
+            "n_shards": int(np.asarray(state["topo_n_shards"])),
+            "n_particles": int(np.asarray(state["topo_n_particles"])),
+            "d": int(np.asarray(state["topo_d"])),
+            "particles_per_shard": np.asarray(
+                state["topo_particles_per_shard"], dtype=np.int64
+            ).reshape(-1),
+            "data_rows_per_shard": int(
+                np.asarray(state.get("topo_data_rows_per_shard", 0))
+            ),
+        }
+    except (KeyError, TypeError, ValueError, OverflowError):
+        return None
+    if (man["n_shards"] < 1
+            or man["particles_per_shard"].shape[0] != man["n_shards"]
+            or int(man["particles_per_shard"].sum()) != man["n_particles"]):
+        return None
+    return man
+
+
+def check_topology(state: Dict[str, Any], expect: Dict[str, int],
+                   context: str = "checkpoint") -> Optional[Dict[str, Any]]:
+    """Compare a state's saved manifest against a requested topology.
+
+    ``expect`` names any subset of ``n_shards`` / ``n_particles`` / ``d``;
+    a mismatch raises :class:`TopologyMismatch` naming both sides and
+    pointing at :func:`reshard_state` — before any array op.  Manifest-less
+    (pre-elastic) saves pass silently; returns the parsed manifest (or
+    ``None``)."""
+    man = read_manifest(state)
+    if man is None:
+        return None
+    bad = {k: (man[k], v) for k, v in expect.items()
+           if v is not None and man.get(k) != int(v)}
+    if bad:
+        saved = ", ".join(f"{k}={man[k]}" for k in sorted(bad))
+        want = ", ".join(f"{k}={int(v)}" for k, (_, v) in sorted(bad.items()))
+        raise TopologyMismatch(
+            f"{context} was saved at topology ({saved}) but ({want}) was "
+            "requested — reshard the state with "
+            "dist_svgd_tpu.utils.checkpoint.reshard_state(state, n_shards) "
+            "(shard counts convert exactly; particle count / dimension "
+            "cannot change)"
+        )
+    return man
+
+
+def reshard_previous_stack(prev_arr: np.ndarray, n: int, d: int,
+                           want: tuple) -> np.ndarray:
+    """Convert a Wasserstein ``previous`` snapshot stack saved under one
+    shard layout to the layout ``want`` — exactly, by reconstructing the
+    shard-independent pre/post-update global states the stacks encode:
+
+    - the post-update global is the concatenation of each shard's own
+      block (exchanged stacks carry it inside the mixed snapshots;
+      ``partitions``/block stacks ARE it);
+    - exchanged stacks at ``S_old ≥ 2`` additionally carry every
+      pre-update row (each block's pre value sits in any *other* shard's
+      snapshot), so a mixed stack at any new S can be rebuilt verbatim.
+
+    A target layout needing pre-update rows the save does not contain
+    (block-only save → mixed S>1 target) raises ``ValueError``.  Shared by
+    ``DistSampler.load_state_dict``'s reshard-on-restore and
+    :func:`reshard_state`."""
+    if prev_arr.shape == want:
+        return prev_arr
+    if prev_arr.ndim != 3 or prev_arr.shape[2] != d:
+        raise ValueError(
+            f"checkpoint 'previous' snapshot {prev_arr.shape} is not a "
+            f"snapshot stack for {n} particles of dim {d}"
+        )
+    S_old, rows = prev_arr.shape[0], prev_arr.shape[1]
+    exch_save = rows == n              # mixed per-shard snapshots
+    part_save = rows * S_old == n      # owned-block stacks (S_old == 1:
+    if not (exch_save or part_save):   # both — the post-update global)
+        raise ValueError(
+            f"checkpoint 'previous' snapshot {prev_arr.shape} matches "
+            f"neither a mixed (S, {n}, {d}) nor an owned-block "
+            f"(S, {n}//S, {d}) stack for {n} particles"
+        )
+    if exch_save:
+        s_old = n // S_old
+        post = np.concatenate(
+            [prev_arr[b, b * s_old:(b + 1) * s_old] for b in range(S_old)]
+        )
+    else:
+        post = prev_arr.reshape(n, d)
+    S_new = want[0]
+    if want[1] != n:
+        # block-sized target (partitions, or exchanged w2_pairing='block'):
+        # owned-block (post-update) stacks
+        return post.reshape(want)
+    if S_new == 1:
+        # the (1, n, d) stack is just the post-update global, whichever
+        # mode family wrote the save
+        return post.reshape(1, n, d)
+    # exchanged target at S_new > 1: needs the pre-update rows
+    if not exch_save or S_old < 2:
+        raise ValueError(
+            f"cannot reshard 'previous' {prev_arr.shape} to {want}: the "
+            "save holds only post-update blocks (partitions-mode, "
+            "w2_pairing='block', or single-shard save), but a global-"
+            f"pairing exchanged stack at num_shards={S_new} needs the "
+            "pre-update rows it never recorded"
+        )
+    s_old = n // S_old
+    pre = np.empty_like(post)
+    for b in range(S_old):
+        # block b's pre-update rows live in any OTHER shard's snapshot
+        pre[b * s_old:(b + 1) * s_old] = (
+            prev_arr[(b + 1) % S_old, b * s_old:(b + 1) * s_old]
+        )
+    out = np.broadcast_to(pre, (S_new, n, d)).copy()
+    s_new = n // S_new
+    for r in range(S_new):
+        out[r, r * s_new:(r + 1) * s_new] = post[r * s_new:(r + 1) * s_new]
+    return out
+
+
+def reshard_state(state: Dict[str, Any], n_shards_to: int) -> Dict[str, Any]:
+    """Reshape a full-global checkpoint saved at N shards into one loadable
+    at ``n_shards_to`` — the elastic-capacity primitive (a run checkpointed
+    at 8 shards resumes at 4 after a device loss, or at 8 again after the
+    capacity comes back).
+
+    What converts, and how:
+
+    - **particles**: unchanged.  The global array is stored in logical block
+      order, which is shard-layout-free — regrouping N blocks into M is a
+      pure reinterpretation of the same rows, no permutation;
+    - **Wasserstein ``previous`` stack**: rebuilt exactly for the new shard
+      count in the family the save used (:func:`reshard_previous_stack`);
+      a stack only the loader can finish adapting (mode-dependent target)
+      is passed through for ``load_state_dict``'s reshard-on-restore;
+    - **Sinkhorn duals** (``w2_g``): *invalidated explicitly* whenever the
+      shard count actually changes — their per-block pairing does not
+      survive a layout change, so the first resumed solve cold-starts from
+      zeroed duals (the safe soft-transform start; trajectory within the
+      solver's tol band).  A same-count reshard keeps them.  Ring-hop
+      chunk carries never enter a checkpoint (they live only inside one
+      ``run_steps`` dispatch chain), so there is nothing to invalidate;
+    - **RNG**: the stamped minibatch root key (``rng_batch_key``) is kept
+      verbatim — the per-step streams fold ``(root, t)`` and are therefore
+      shard-layout-free, so every later key re-derives deterministically
+      from the saved root on any mesh;
+    - **manifest**: restamped for the new topology, with
+      ``topo_resharded_from`` recording the source shard count.
+
+    A target that does not divide the particle count takes the SAME
+    replicate-and-warn fallback as ``Plan.shard_ensemble`` (the state lands
+    at 1 shard — correct, no longer distributed).  Per-process block saves
+    must be assembled first (:func:`assemble_full_state`); resharding a
+    lone block raises."""
+    M = int(n_shards_to)
+    if M < 1:
+        raise ValueError(f"n_shards_to must be >= 1, got {M}")
+    parts = state.get("particles")
+    if parts is None:
+        raise ValueError("reshard_state needs a 'particles' entry — is this "
+                         "a sampler checkpoint?")
+    if int(np.asarray(state.get("particles_start", 0))) != 0:
+        raise ValueError(
+            "reshard_state needs the FULL global state, but this dict is a "
+            "per-process block (particles_start != 0) — assemble every "
+            "process's save with assemble_full_state first"
+        )
+    parts = np.asarray(parts)
+    n = parts.shape[0]
+    d = parts.shape[1] if parts.ndim > 1 else 1
+    man = read_manifest(state)
+    if man is None:
+        warnings.warn(
+            "checkpoint carries no readable topology manifest (pre-elastic "
+            f"save, or corrupt entries): inferring n={n}, d={d} from the "
+            "particle array and resharding anyway",
+            stacklevel=2,
+        )
+        S_old = None
+    else:
+        if man["n_particles"] != n:
+            raise TopologyMismatch(
+                f"manifest says {man['n_particles']} particles but the "
+                f"'particles' array holds {n} rows — corrupt or mixed-up "
+                "checkpoint"
+            )
+        S_old = man["n_shards"]
+    if n % M:
+        from dist_svgd_tpu.parallel.plan import nondividing_replicate_warning
+
+        warnings.warn(nondividing_replicate_warning(n, M), UserWarning,
+                      stacklevel=2)
+        M = 1
+    out = dict(state)
+    prev = out.get("previous")
+    if prev is not None:
+        prev_arr = np.asarray(prev)
+        if prev_arr.ndim == 3 and prev_arr.shape[2] == d:
+            mixed = prev_arr.shape[1] == n and prev_arr.shape[0] >= 2
+            want = (M, n, d) if (mixed and M > 1) else (
+                (1, n, d) if M == 1 else (M, n // M, d))
+            try:
+                out["previous"] = reshard_previous_stack(prev_arr, n, d, want)
+            except ValueError:
+                # mode-dependent target the loader knows better — leave the
+                # stack for load_state_dict's reshard-on-restore
+                pass
+    # duals: per-block pairing does not survive a layout CHANGE — drop
+    # them explicitly so the first resumed solve cold-starts (documented).
+    # A same-count reshard (or an unknown source count) with no change to
+    # make keeps them: the pairing is still valid and cold-starting would
+    # needlessly re-pay the warm-start win.
+    if S_old != M:
+        out.pop("w2_g", None)
+        out.pop("w2_g_start", None)
+    rows_ps = man["data_rows_per_shard"] if man is not None else 0
+    total_rows = rows_ps * (S_old or 1)
+    out.update(topology_manifest(M, n, d, total_rows // M))
+    if S_old is not None:
+        out["topo_resharded_from"] = np.asarray(S_old, dtype=np.int64)
+    return out
 
 
 def _to_numpy_tree(tree: Dict[str, Any]) -> Dict[str, Any]:
@@ -113,7 +393,9 @@ def _looks_like_orbax(path: str, entries) -> bool:
     )
 
 
-def load_state(path: str) -> Dict[str, Any]:
+def load_state(path: str,
+               expect_topology: Optional[Dict[str, int]] = None
+               ) -> Dict[str, Any]:
     """Load a checkpoint written by :func:`save_state` (auto-detects layout).
 
     A directory holding neither layout — empty, or stray files without the
@@ -122,29 +404,42 @@ def load_state(path: str) -> Dict[str, Any]:
     ``CheckpointManager.restore_latest`` can classify it as corruption and
     fall back to an older step even when orbax is not installed
     (``ImportError`` is reserved for a checkpoint that IS orbax-layout in an
-    orbax-less environment, which must propagate)."""
+    orbax-less environment, which must propagate).
+
+    ``expect_topology`` (any subset of ``n_shards`` / ``n_particles`` /
+    ``d``) is compared against the saved topology manifest the moment the
+    dict is read: a mismatch raises :class:`TopologyMismatch` naming both
+    shapes before any array op — instead of the raw reshape/broadcast error
+    a mismatched load used to die with deep inside jax."""
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint directory at {path}")
     npz = os.path.join(path, _NPZ_NAME)
+    state = None
     if os.path.exists(npz):
         with np.load(npz) as data:
-            return {k: data[k] for k in data.files}
-    entries = os.listdir(path)
-    if not _looks_like_orbax(path, entries):
-        raise ValueError(
-            f"checkpoint directory {path} holds neither layout "
-            f"(entries: {sorted(entries)[:5]}) — partial write from a "
-            "killed save?"
-        )
-    import orbax.checkpoint as ocp
+            state = {k: data[k] for k in data.files}
+    if state is None:
+        entries = os.listdir(path)
+        if not _looks_like_orbax(path, entries):
+            raise ValueError(
+                f"checkpoint directory {path} holds neither layout "
+                f"(entries: {sorted(entries)[:5]}) — partial write from a "
+                "killed save?"
+            )
+        import orbax.checkpoint as ocp
 
-    with ocp.PyTreeCheckpointer() as ckptr:
-        restored = ckptr.restore(path)
-    return dict(restored)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            restored = ckptr.restore(path)
+        state = dict(restored)
+    if expect_topology:
+        check_topology(state, expect_topology, context=f"checkpoint {path}")
+    return state
 
 
-def assemble_full_state(paths) -> Dict[str, Any]:
+def assemble_full_state(paths,
+                        expect_topology: Optional[Dict[str, int]] = None
+                        ) -> Dict[str, Any]:
     """Assemble the per-process block checkpoints of ONE multi-host save
     into a full-global state dict, enabling **cross-process-count restore**
     (round-5, VERDICT r04 item 7).
@@ -161,10 +456,16 @@ def assemble_full_state(paths) -> Dict[str, Any]:
     the complete list of old per-process paths.
 
     Raises ``ValueError`` when the blocks are not contiguous from row 0
-    (paths from different saves, or an incomplete list)."""
+    (paths from different saves, or an incomplete list).
+    ``expect_topology`` is checked against each file's saved manifest
+    **before** any block is concatenated (:class:`TopologyMismatch` instead
+    of a shape error mid-assembly)."""
     states = [load_state(p) for p in paths]
     if not states:
         raise ValueError("assemble_full_state needs at least one checkpoint")
+    if expect_topology:
+        for p, s in zip(paths, states):
+            check_topology(s, expect_topology, context=f"checkpoint {p}")
     out: Dict[str, Any] = {}
     keys = {k for s in states for k in s if not k.endswith("_start")}
     for key in keys:

@@ -1,0 +1,470 @@
+"""Elastic capacity (ISSUE 8): reshardable checkpoints, mesh shrink/grow
+resume under the supervisor's restart budget, and device-loss drills.
+
+The reshard-equivalence suite pins: a run checkpointed at N=8 shards and
+resumed at M ∈ {4, 2, 1} (and the grow direction 2 → 8) reproduces the
+never-resharded run's trajectory — posterior stats (KSD/ESS) and the
+replicated hyperparameters (step counter, step size, RNG root, pairing
+code) bitwise, particles to float accumulation-order tolerance (the
+per-shard φ reductions re-associate across shard counts, measured ~1e-7
+at this scale).  Everything runs tier-1 on CPU with injected topology
+faults — no real device loss.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import dist_svgd_tpu as dt
+from dist_svgd_tpu.models.gmm import gmm_logp
+from dist_svgd_tpu.resilience import (
+    DeviceLossAt,
+    FaultPlan,
+    MeshGrowAt,
+    MeshShrinkAt,
+    ReshardPolicy,
+    RestartBudgetExhausted,
+    RetryPolicy,
+    RunSupervisor,
+    TopologyFault,
+)
+from dist_svgd_tpu.utils import checkpoint as ck
+from dist_svgd_tpu.utils.rng import init_particles_per_shard
+
+N = 64
+D = 2
+#: particle tolerance across shard counts (accumulation-order float noise;
+#: bitwise is pinned for the replicated hyperparameters instead)
+ATOL = 1e-5
+
+
+def make_dist(num_shards, n=N, seed=0, **kw):
+    kw.setdefault("exchange_particles", True)
+    kw.setdefault("exchange_scores", False)
+    kw.setdefault("include_wasserstein", False)
+    return dt.DistSampler(
+        num_shards, lambda th, _: gmm_logp(th), None,
+        init_particles_per_shard(seed, n, D, num_shards), **kw)
+
+
+def factory(num_shards):
+    return make_dist(num_shards)
+
+
+def supervise(sampler, tmp_path, name, steps=12, every=4, seg=2, **kw):
+    kw.setdefault("segment_steps", seg)
+    kw.setdefault("sleep", lambda s: None)
+    return RunSupervisor(sampler, steps, 0.05,
+                         checkpoint_dir=os.path.join(str(tmp_path), name),
+                         checkpoint_every=every, **kw)
+
+
+def diag_stats(particles, num_shards):
+    import jax
+
+    from dist_svgd_tpu.telemetry import MetricsRegistry
+    from dist_svgd_tpu.telemetry.diagnostics import (
+        DiagnosticsConfig,
+        PosteriorDiagnostics,
+    )
+
+    diag = PosteriorDiagnostics(
+        DiagnosticsConfig(every_steps=1, score_fn=jax.grad(gmm_logp),
+                          row_chunk=64, max_points=64),
+        registry=MetricsRegistry())
+    return diag.compute(particles, num_shards=num_shards, step=0)
+
+
+# --------------------------------------------------------------------- #
+# topology manifest + TopologyMismatch (satellite 1)
+
+
+def test_state_dict_carries_manifest_and_rng_root():
+    ds = make_dist(4)
+    st = ds.state_dict()
+    man = ck.read_manifest(st)
+    assert man["n_shards"] == 4
+    assert man["n_particles"] == N and man["d"] == D
+    assert man["data_rows_per_shard"] == 0
+    np.testing.assert_array_equal(man["particles_per_shard"],
+                                  np.full(4, N // 4))
+    np.testing.assert_array_equal(np.asarray(st["rng_batch_key"]),
+                                  np.asarray(ds._batch_key))
+
+
+def test_manifest_survives_save_load_and_expect_check(tmp_path):
+    ds = make_dist(8)
+    ds.run_steps(4, 0.05)
+    path = ck.save_state(os.path.join(str(tmp_path), "cp"), ds.state_dict(),
+                         backend="npz")
+    # matching expectation loads fine
+    st = ck.load_state(path, expect_topology={"n_shards": 8,
+                                              "n_particles": N, "d": D})
+    assert ck.read_manifest(st)["n_shards"] == 8
+    # a mismatch raises BEFORE any array op, naming both shapes and the fix
+    with pytest.raises(ck.TopologyMismatch, match="n_shards=8.*n_shards=4"):
+        ck.load_state(path, expect_topology={"n_shards": 4})
+    with pytest.raises(ck.TopologyMismatch, match="reshard_state"):
+        ck.load_state(path, expect_topology={"n_shards": 4})
+
+
+def test_assemble_full_state_checks_topology_before_concat(tmp_path):
+    ds = make_dist(2)
+    p = ck.save_state(os.path.join(str(tmp_path), "cp"), ds.state_dict(),
+                      backend="npz")
+    with pytest.raises(ck.TopologyMismatch, match="n_particles"):
+        ck.assemble_full_state([p], expect_topology={"n_particles": N * 2})
+    out = ck.assemble_full_state([p], expect_topology={"n_particles": N})
+    assert out["particles"].shape == (N, D)
+
+
+def test_load_state_dict_topology_mismatch_one_line():
+    """A wrong-n load used to die with a raw reshape/shape error — now a
+    one-line TopologyMismatch naming both topologies fires first."""
+    big = make_dist(4, n=2 * N)
+    small = make_dist(4)
+    with pytest.raises(ck.TopologyMismatch,
+                       match=rf"n_particles={2 * N}.*n_particles={N}"):
+        small.load_state_dict(big.state_dict())
+    # the single-device harness checks the same manifest
+    from dist_svgd_tpu.resilience.supervisor import _SamplerHarness
+
+    s = dt.Sampler(D, gmm_logp)
+    h16 = _SamplerHarness(s, 16)
+    h32 = _SamplerHarness(s, 32)
+    with pytest.raises(ck.TopologyMismatch, match="n_particles"):
+        h16.load_state_dict(h32.state_dict())
+
+
+def test_corrupt_manifest_reads_as_none():
+    ds = make_dist(4)
+    st = ds.state_dict()
+    st["topo_particles_per_shard"] = np.asarray([1, 2, 3])  # wrong S, sum
+    assert ck.read_manifest(st) is None
+    st2 = ds.state_dict()
+    st2["topo_n_shards"] = np.asarray("eight")
+    assert ck.read_manifest(st2) is None
+
+
+# --------------------------------------------------------------------- #
+# reshard_state (tentpole 1)
+
+
+def test_reshard_state_regroups_without_permutation():
+    ds = make_dist(8)
+    ds.run_steps(6, 0.05)
+    st = ds.state_dict()
+    rs = ck.reshard_state(st, 4)
+    # particles are a pure reinterpretation — same rows, same order
+    np.testing.assert_array_equal(np.asarray(st["particles"]),
+                                  np.asarray(rs["particles"]))
+    man = ck.read_manifest(rs)
+    assert man["n_shards"] == 4
+    np.testing.assert_array_equal(man["particles_per_shard"],
+                                  np.full(4, N // 4))
+    assert int(np.asarray(rs["topo_resharded_from"])) == 8
+    # replicated hyperparameters ride through bitwise
+    assert int(np.asarray(rs["t"])) == int(np.asarray(st["t"]))
+    np.testing.assert_array_equal(rs["rng_batch_key"], st["rng_batch_key"])
+
+
+def test_reshard_state_invalidates_duals_and_reshapes_previous():
+    ds = make_dist(4, include_wasserstein=True, wasserstein_solver="sinkhorn")
+    ds.run_steps(4, 0.05, h=1.0)
+    st = ds.state_dict()
+    assert st["w2_g"] is not None and st["previous"] is not None
+    rs = ck.reshard_state(st, 2)
+    assert "w2_g" not in rs  # explicitly invalidated: loader cold-starts
+    assert np.asarray(rs["previous"]).shape == (2, N, D)
+    ds2 = make_dist(2, include_wasserstein=True,
+                    wasserstein_solver="sinkhorn")
+    ds2.load_state_dict(rs)
+    assert ds2._w2_g is None
+    ds2.run_steps(4, 0.05, h=1.0)  # and the resumed solve runs
+
+
+def test_reshard_state_nondividing_takes_replicate_fallback():
+    """Satellite 2: an M that doesn't divide n takes Plan.shard_ensemble's
+    replicate-and-warn fallback (same warning text) instead of crashing."""
+    from dist_svgd_tpu.parallel.plan import nondividing_replicate_warning
+
+    ds = make_dist(8)
+    st = ds.state_dict()
+    with pytest.warns(UserWarning,
+                      match="replicating instead of sharding"):
+        rs = ck.reshard_state(st, 7)
+    assert ck.read_manifest(rs)["n_shards"] == 1
+    # and it IS the same warning shard_ensemble emits
+    assert "replicating instead of sharding" in nondividing_replicate_warning(
+        N, 7)
+
+
+def test_reshard_state_without_manifest_warns_and_infers():
+    ds = make_dist(8)
+    st = {k: v for k, v in ds.state_dict().items()
+          if not k.startswith("topo_")}
+    with pytest.warns(UserWarning, match="no readable topology manifest"):
+        rs = ck.reshard_state(st, 4)
+    assert ck.read_manifest(rs)["n_shards"] == 4
+    make_dist(4).load_state_dict(rs)
+
+
+def test_reshard_state_rejects_per_process_block():
+    ds = make_dist(4)
+    st = ds.state_dict()
+    st["particles_start"] = np.asarray(16, dtype=np.int64)
+    with pytest.raises(ValueError, match="assemble_full_state"):
+        ck.reshard_state(st, 2)
+
+
+# --------------------------------------------------------------------- #
+# reshard equivalence suite (satellite 3)
+
+
+def run_supervised(sampler, tmp_path, name, steps=12, **kw):
+    sup = supervise(sampler, tmp_path, name, steps=steps, **kw)
+    report = sup.run()
+    assert report["status"] == "completed"
+    return sup, report
+
+
+@pytest.mark.parametrize("m", [4, 2, 1])
+def test_reshard_equivalence_shrink(tmp_path, m):
+    """N=8 to step k, reshard to M at an injected shrink, continue to 2k:
+    KSD/ESS and the replicated hyperparameters pin bitwise against the
+    never-resharded run; particles to accumulation-order tolerance."""
+    base, rb = run_supervised(make_dist(8), tmp_path, "base")
+    want = np.asarray(base.particles)
+    sup, r = run_supervised(
+        make_dist(8), tmp_path, f"m{m}",
+        reshard=ReshardPolicy(factory),
+        faults=FaultPlan(MeshShrinkAt(6, m)))
+    assert r["num_shards"] == m and r["reshards"] == 1
+    ev = r["reshard_events"][0]
+    assert ev["from_shards"] == 8 and ev["to_shards"] == m
+    assert ev["t_detected"] == 6 and ev["resumed_from"] == 4
+    assert ev["steps_lost"] == 2
+    assert ev["reshard_wall_s"] >= 0 and ev["recovery_wall_s"] is not None
+    got = np.asarray(sup.particles)
+    np.testing.assert_allclose(want, got, rtol=0, atol=ATOL)
+    # replicated hyperparameters: bitwise
+    assert r["t"] == rb["t"]
+    assert sup.step_size == base.step_size
+    st_b, st_e = base._harness.state_dict(), sup._harness.state_dict()
+    np.testing.assert_array_equal(st_b["rng_batch_key"], st_e["rng_batch_key"])
+    np.testing.assert_array_equal(st_b["w2_pairing"], st_e["w2_pairing"])
+    # posterior stats: KSD/ESS over the (tolerance-equal) finals
+    db = diag_stats(want, 8)
+    de = diag_stats(got, m)
+    assert np.isclose(db["ksd"], de["ksd"], rtol=1e-4)
+    assert np.isclose(db["ess"], de["ess"], rtol=1e-4)
+
+
+def test_reshard_equivalence_grow(tmp_path):
+    base, rb = run_supervised(make_dist(2), tmp_path, "gbase")
+    want = np.asarray(base.particles)
+    sup, r = run_supervised(
+        make_dist(2), tmp_path, "grow",
+        reshard=ReshardPolicy(factory),
+        faults=FaultPlan(MeshGrowAt(6, 8)))
+    assert r["num_shards"] == 8 and r["reshards"] == 1
+    got = np.asarray(sup.particles)
+    np.testing.assert_allclose(want, got, rtol=0, atol=ATOL)
+    db, de = diag_stats(want, 2), diag_stats(got, 8)
+    assert np.isclose(db["ksd"], de["ksd"], rtol=1e-4)
+    assert np.isclose(db["ess"], de["ess"], rtol=1e-4)
+
+
+def test_reshard_equivalence_corrupt_manifest_fallback(tmp_path):
+    """A checkpoint whose manifest was corrupted still reshards (with the
+    inference warning) and reproduces the baseline within tolerance."""
+    base, _ = run_supervised(make_dist(8), tmp_path, "cbase")
+    want = np.asarray(base.particles)
+    st = ck.load_state(os.path.join(str(tmp_path), "cbase", "step_4"))
+    st["topo_particles_per_shard"] = np.asarray([1, 2, 3])  # corrupt
+    assert ck.read_manifest(st) is None
+    with pytest.warns(UserWarning, match="no readable topology manifest"):
+        rs = ck.reshard_state(st, 4)
+    ds = make_dist(4)
+    ds.load_state_dict(rs)
+    for _ in range(4):
+        ds.run_steps(2, float(np.asarray(st["sup_step_size"])))
+    np.testing.assert_allclose(want, np.asarray(ds.particles),
+                               rtol=0, atol=ATOL)
+
+
+# --------------------------------------------------------------------- #
+# elastic supervisor (tentpole 3)
+
+
+def test_device_loss_picks_largest_divisor(tmp_path):
+    """Losing 1 of 8 devices leaves 7, which doesn't divide n=64: the
+    default policy lands on 4 (largest divisor ≤ 7), keeping every
+    particle sharded."""
+    sup, r = run_supervised(
+        make_dist(8), tmp_path, "loss",
+        reshard=ReshardPolicy(factory),
+        faults=FaultPlan(DeviceLossAt(6)))
+    assert r["num_shards"] == 4
+    assert r["reshard_events"][0]["requested_shards"] == 4
+
+
+def test_device_loss_surviving_strategy_replicates(tmp_path):
+    """The 'surviving' strategy asks for the raw survivor count (7), which
+    takes the replicate-and-warn fallback down to 1 shard."""
+    with pytest.warns(UserWarning, match="replicating instead of sharding"):
+        sup, r = run_supervised(
+            make_dist(8), tmp_path, "surv",
+            reshard=ReshardPolicy(factory,
+                                  device_loss_strategy="surviving"),
+            faults=FaultPlan(DeviceLossAt(6)))
+    assert r["num_shards"] == 1
+    assert r["reshard_events"][0]["requested_shards"] == 7
+
+
+def test_back_to_back_topology_faults_close_superseded_window(tmp_path):
+    """A second transition firing before the first replay regains its
+    detection step supersedes the first recovery window: the first event
+    honestly reports recovery_wall_s=None (and no internal clock leaks
+    into the report)."""
+    sup, r = run_supervised(
+        make_dist(8), tmp_path, "double", every=4, seg=4,
+        reshard=ReshardPolicy(factory),
+        faults=FaultPlan(MeshShrinkAt(6, 4), MeshShrinkAt(8, 2)))
+    assert r["reshards"] == 2 and r["num_shards"] == 2
+    first, second = r["reshard_events"]
+    assert first["to_shards"] == 4 and second["to_shards"] == 2
+    assert first["recovery_wall_s"] is None  # superseded before regaining
+    assert second["recovery_wall_s"] is not None
+    for ev in (first, second):
+        assert "_clock0" not in ev
+
+
+def test_same_count_reshard_keeps_duals():
+    """reshard_state to the SAME shard count is not a layout change: the
+    warm-start duals stay valid and must survive."""
+    ds = make_dist(4, include_wasserstein=True, wasserstein_solver="sinkhorn")
+    ds.run_steps(4, 0.05, h=1.0)
+    st = ds.state_dict()
+    rs = ck.reshard_state(st, 4)
+    np.testing.assert_array_equal(np.asarray(rs["w2_g"]),
+                                  np.asarray(st["w2_g"]))
+    assert ck.read_manifest(rs)["n_shards"] == 4
+
+
+def test_topology_fault_without_policy_propagates(tmp_path):
+    sup = supervise(make_dist(8), tmp_path, "nopol",
+                    faults=FaultPlan(MeshShrinkAt(6, 4)))
+    with pytest.raises(TopologyFault):
+        sup.run()
+
+
+def test_reshard_spends_shared_restart_budget(tmp_path):
+    """Topology transitions draw on the SAME budget as transient retries:
+    with max_restarts=0 the first shrink exhausts it."""
+    sup = supervise(make_dist(8), tmp_path, "budget",
+                    reshard=ReshardPolicy(factory),
+                    retry=RetryPolicy(max_restarts=0, backoff_base_s=0),
+                    faults=FaultPlan(MeshShrinkAt(6, 4)))
+    with pytest.raises(RestartBudgetExhausted):
+        sup.run()
+
+
+def test_elastic_telemetry_and_flight_record(tmp_path):
+    from dist_svgd_tpu.telemetry import MetricsRegistry
+    from dist_svgd_tpu.telemetry.trace import FlightRecorder
+
+    reg = MetricsRegistry()
+    rec = FlightRecorder(capacity=32)
+    sup, r = run_supervised(
+        make_dist(8), tmp_path, "telem", registry=reg, recorder=rec,
+        reshard=ReshardPolicy(factory),
+        faults=FaultPlan(MeshShrinkAt(6, 4)))
+    assert reg.counter("svgd_elastic_reshards_total").value(
+        direction="shrink") == 1
+    assert reg.counter("svgd_elastic_steps_lost_total").value() == 2
+    assert reg.gauge("svgd_elastic_shards").value() == 4
+    assert reg.counter("svgd_train_restarts_total").value(
+        kind="topology") == 1
+    kinds = [e["kind"] for e in rec.events()]
+    assert "topology_transition" in kinds
+
+
+def test_post_reshard_zero_steady_state_recompiles(tmp_path):
+    """After the one reshard compile, steady-state segments at the new
+    topology compile nothing (the retrace-sentry contract the drill and
+    perf_regress gate)."""
+    from tools.jaxlint.sentry import retrace_sentry
+
+    sup, _ = run_supervised(
+        make_dist(8), tmp_path, "steady",
+        reshard=ReshardPolicy(factory),
+        faults=FaultPlan(MeshShrinkAt(6, 4)))
+    cont = RunSupervisor(sup.sampler, 16, 0.05, segment_steps=2,
+                         sleep=lambda s: None)
+    with retrace_sentry("post-reshard steady state") as sentry:
+        assert cont.run()["status"] == "completed"
+    if not sentry.supported:
+        pytest.skip("jax.monitoring events unavailable")
+    assert sentry.compiles == 0, sentry.report()
+
+
+def test_reshard_policy_validation():
+    with pytest.raises(ValueError, match="device_loss_strategy"):
+        ReshardPolicy(factory, device_loss_strategy="bogus")
+    pol = ReshardPolicy(factory)
+    assert pol.target_for_device_loss(7, 64) == 4
+    assert pol.target_for_device_loss(0, 64) == 1
+    assert pol.target_for_device_loss(6, 60) == 6
+    with pytest.raises(TypeError, match="DistSampler"):
+        ReshardPolicy(lambda s: dt.Sampler(D, gmm_logp)).build(2)
+    with pytest.raises(ValueError, match="honour"):
+        ReshardPolicy(lambda s: make_dist(2)).build(4)
+
+
+def test_serve_from_resharded_checkpoint(tmp_path):
+    """The serving engine cold-starts from a post-reshard manager root (the
+    manifest rides the same dict) and serves the full ensemble."""
+    from dist_svgd_tpu.serving.engine import PredictiveEngine
+
+    sup, _ = run_supervised(
+        make_dist(8), tmp_path, "serve",
+        reshard=ReshardPolicy(factory),
+        faults=FaultPlan(MeshShrinkAt(6, 4)))
+    eng = PredictiveEngine.from_checkpoint(
+        os.path.join(str(tmp_path), "serve"), model="gmm")
+    assert eng.n_particles == N and eng.checkpoint_step == 12
+    out = eng.predict(np.asarray(sup.particles)[:4])
+    assert np.isfinite(out["log_density"]).all()
+
+
+# --------------------------------------------------------------------- #
+# drill row (tentpole 4)
+
+
+def test_elastic_drill_row_schema(tmp_path):
+    from tools import elastic_drill
+
+    row = elastic_drill.run_drill(
+        n=N, shards_from=8, shards_to=4, num_steps=12, checkpoint_every=4,
+        segment_steps=2, shards_grow_from=2, root=str(tmp_path))
+    assert row["metric"] == "elastic_resume"
+    for key in ("steps_lost", "reshard_wall_s", "recovery_wall_s",
+                "elastic_final_max_dev", "ksd_baseline", "ksd_elastic",
+                "ess_frac_baseline", "post_reshard_recompiles",
+                "grow_ok", "fallback_ok", "serve_ok",
+                "resumed_within_tolerance", "hyperparams_bitwise"):
+        assert key in row, key
+    assert row["shards_from"] == 8 and row["shards_to"] == 4
+    assert row["steps_lost"] == 2
+    assert elastic_drill.drill_ok(row), row
+
+
+@pytest.mark.slow
+def test_elastic_drill_default_shape(tmp_path):
+    from tools import elastic_drill
+
+    row = elastic_drill.run_drill(n=1024, root=str(tmp_path))
+    assert elastic_drill.drill_ok(row), row
+    assert row["post_reshard_recompiles"] == 0 or not row["sentry_supported"]

@@ -45,6 +45,15 @@ A/B timing protocol those notes derived:
   window unchanged, and the row reports ``vs_single_device`` (the ISSUE-7
   ≥4× acceptance ratio) alongside per-lane fairness counts.
 
+- **elastic-capacity rows (round 13)** — ``elastic_resume``
+  (``tools/elastic_drill.py``: device-loss → reshard-to-smaller-mesh →
+  resume → serve) is gated on correctness unconditionally (resharded resume
+  pinned to the uninterrupted run, grow-back and non-dividing fallback and
+  serve-from-resharded-checkpoint all green, and ZERO steady-state
+  recompiles after the one reshard compile — retrace-sentry enforced), and
+  its ``elastic_reshard_wall_s`` / ``elastic_recovery_wall_s`` walls gate
+  against their own median+MAD incumbent windows.
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -95,7 +104,10 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
               # the serving rows measure host thread scheduling + the
               # batcher's wait window as much as the chip — wider band
               "serve_throughput": 2.0, "serve_latency_p99": 2.0,
-              "serve_sharded": 2.0, "serve_sharded_p99": 2.0}
+              "serve_sharded": 2.0, "serve_sharded_p99": 2.0,
+              # the elastic walls are dominated by host checkpoint I/O and
+              # one-off XLA compiles — as scheduling-noisy as the serve rows
+              "elastic_reshard_wall_s": 2.0, "elastic_recovery_wall_s": 2.0}
 
 #: Hard ceiling on the span tracer's measured serve-bench cost (round 10):
 #: the interleaved tracer-off/on A/B (``serve_bench.
@@ -626,6 +638,54 @@ def main():
     else:
         row["status"] = "PASS"
     print(json.dumps(row), flush=True)
+
+    # elastic-capacity gates (round 13): the elastic_resume drill — kill at
+    # step k, reshard the checkpoint to a smaller mesh, resume, serve.  Two
+    # unconditional correctness gates (any steady-state recompile AFTER the
+    # one reshard compile is a retrace bug; a drill whose resharded resume
+    # diverges, fails to grow back, crashes on a non-dividing target, or
+    # cannot serve the resharded checkpoint is broken regardless of speed)
+    # plus two windowed wall gates: reshard wall (restore+reshard+rebuild)
+    # and recovery wall (reshard+backoff+replay to the detection step).
+    import elastic_drill
+
+    erow = elastic_drill.run_drill()
+    correct = elastic_drill.drill_ok(erow)
+    row = {"bench": "elastic_resume",
+           "shards": f"{erow['shards_from']}->{erow['shards_to']}",
+           "steps_lost": erow["steps_lost"],
+           "post_reshard_recompiles": erow["post_reshard_recompiles"],
+           "sentry_supported": erow["sentry_supported"],
+           "elastic_final_max_dev": erow["elastic_final_max_dev"],
+           "ksd_delta_frac": erow["ksd_delta_frac"],
+           "grow_ok": erow["grow_ok"], "fallback_ok": erow["fallback_ok"],
+           "serve_ok": erow["serve_ok"]}
+    if not correct:
+        row["status"] = "FAIL"
+        failures += 1
+    else:
+        row["status"] = "PASS"
+    print(json.dumps(row), flush=True)
+    if correct:
+        for key, field in (("elastic_reshard_wall_s", "reshard_wall_s"),
+                           ("elastic_recovery_wall_s", "recovery_wall_s")):
+            value = erow[field]
+            row = {"bench": key, "value": value, "unit": "s"}
+            if value is None:
+                row["status"] = "FAIL"
+                row["error"] = f"drill row carried no {field}"
+                failures += 1
+            else:
+                tol = min(args.tol * TOL_FACTOR.get(key, 1.0), 0.9)
+                status, info = judge_row(
+                    value, incumbent_history(incumbents, key), tol, False,
+                )
+                row.update(info)
+                row["status"] = status
+                if status == "FAIL":
+                    failures += 1
+                results[key] = value
+            print(json.dumps(row), flush=True)
 
     print(json.dumps({
         "summary": "FAIL" if failures else "PASS",
