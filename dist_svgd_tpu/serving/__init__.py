@@ -23,7 +23,15 @@ turns a checkpointed ensemble into a low-latency prediction service:
   serializing behind one in-flight device call;
 - :mod:`server`  — a thin stdlib HTTP front end (``/predict``, ``/healthz``,
   ``/metrics``, ``/slo``) with graceful drain and structured per-request
-  records.
+  records;
+- :mod:`registry` — :class:`ModelRegistry`: **multi-tenant serving** —
+  many heterogeneous posteriors (logreg/BNN/GMM, different shapes, steps,
+  dtypes, plans) hosted as named tenants behind ONE process: one shared
+  micro-batcher with per-tenant quotas and shed priorities, one scanner
+  thread over every tenant's checkpoint root, one process-wide
+  :class:`KernelBucketLRU` bounding compiled kernel buckets across
+  tenants, and a ``tenant=`` label on every serving metric.  The server
+  routes ``/predict`` on a ``tenant`` field and lists ``/tenants``.
 
 Reload admission: an engine built with a ``telemetry.diagnostics.
 ReloadPolicy`` health-checks every hot-reload candidate (kernel ESS,
@@ -41,13 +49,21 @@ from dist_svgd_tpu.serving.engine import (
     EnsembleRejected,
     PredictiveEngine,
 )
+from dist_svgd_tpu.serving.registry import (
+    KernelBucketLRU,
+    ModelRegistry,
+    Tenant,
+)
 from dist_svgd_tpu.serving.server import PredictionServer
 
 __all__ = [
     "PredictiveEngine",
     "CheckpointHotReloader",
     "EnsembleRejected",
+    "KernelBucketLRU",
     "MicroBatcher",
+    "ModelRegistry",
     "Overloaded",
     "PredictionServer",
+    "Tenant",
 ]

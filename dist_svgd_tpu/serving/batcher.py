@@ -42,6 +42,26 @@ all devices).  Each lane is labelled in telemetry
 (``svgd_serve_lane_batches_total{lane=...}``, the per-lane in-flight gauge)
 and tagged on its request lane trees, so a stuck lane is visible instead of
 averaged away.
+
+Multi-tenant requests (round 14): ``submit(x, tenant=name)`` queues the
+request under a tenant identity.  One bounded queue carries every tenant's
+chunks; a batch only ever coalesces chunks of ONE tenant (different
+tenants hit different engines with different shapes — fusing them would be
+wrong, not just slow), and the dispatch callable is invoked as
+``dispatch(x, tenant)`` for tenant requests (``dispatch(x)`` unchanged for
+tenant-less ones).  ``quotas={tenant: max_inflight_rows}`` (a live mapping
+— the :class:`~dist_svgd_tpu.serving.registry.ModelRegistry` shares its
+own) arms **shed priorities**: while the queue has room, quotas are inert;
+when an arriving request would overflow ``max_queue_rows``, tenants over
+their quota shed FIRST — an over-quota submitter is refused outright, and
+otherwise the newest queued requests of over-quota tenants are shed (whole
+requests, ``Overloaded`` on their futures) to make room for the under-
+quota arrival.  A hog tenant degrades itself; polite tenants keep their
+admission.  Every serving metric/histogram/lane tree carries a ``tenant``
+label for tenant requests (tenant-less series stay unlabelled — the
+single-tenant deployment is byte-identical), plus
+``svgd_serve_quota_sheds_total{tenant=...}`` and the per-tenant queued-
+rows gauge.
 """
 
 from __future__ import annotations
@@ -84,16 +104,18 @@ class _Request:
     the epoch, so a timestamp is only meaningful against the same tracer."""
 
     __slots__ = ("future", "n_chunks", "parts", "enqueued", "trace_enq",
-                 "trace_src")
+                 "trace_src", "tenant")
 
     def __init__(self, n_chunks: int, enqueued: float,
-                 trace_enq: Optional[float] = None, trace_src=None):
+                 trace_enq: Optional[float] = None, trace_src=None,
+                 tenant: Optional[str] = None):
         self.future: Future = Future()
         self.n_chunks = n_chunks
         self.parts: List[Optional[Dict[str, np.ndarray]]] = [None] * n_chunks
         self.enqueued = enqueued
         self.trace_enq = trace_enq
         self.trace_src = trace_src
+        self.tenant = tenant
 
 
 class _Chunk:
@@ -126,6 +148,11 @@ class MicroBatcher:
             the old serialized behavior).  More lanes overlap device
             dispatch with coalescing and with other dispatches; pair with
             a mesh-sharded engine to keep every device busy.
+        quotas: live ``{tenant: max_inflight_rows}`` mapping (``None``
+            values exempt a tenant) read under the batcher lock on every
+            overflow — mutate it to retune quotas without rebuilding the
+            batcher.  Quotas only bite when the bounded queue fills: see
+            the module docstring's shed-priority contract.
         max_wait_ms: how long the oldest queued request may wait for
             co-travellers before a partial batch is flushed.
         max_queue_rows: bound on queued (not-yet-dispatched) rows; beyond it
@@ -151,6 +178,7 @@ class MicroBatcher:
         lanes: int = 1,
         max_wait_ms: float = 2.0,
         max_queue_rows: int = 8192,
+        quotas: Optional[Dict[str, Optional[int]]] = None,
         clock: Callable[[], float] = time.monotonic,
         wait: Callable[[threading.Condition, Optional[float]], bool] = _default_wait,
         logger=None,
@@ -178,6 +206,17 @@ class MicroBatcher:
         self._queue: deque = deque()  # of _Chunk
         self._queued_rows = 0
         self._open = True
+        # multi-tenant state (round 14): live quota mapping (shared with
+        # the ModelRegistry that mutates it), queued rows and quota-shed
+        # counts per tenant — all guarded by _cond's lock
+        self._quotas = quotas if quotas is not None else {}
+        self._tenant_queued: Dict[str, int] = {}
+        # rows collected into a batch but not yet resolved: the drain
+        # condition on tenant removal is queued AND inflight == 0 (a
+        # tenant popped while its last batch is between _collect and
+        # dispatch would KeyError in the router)
+        self._tenant_inflight: Dict[str, int] = {}
+        self._quota_sheds: Dict[str, int] = {}
 
         # metrics (guarded by _cond's lock)
         self._n_requests = 0
@@ -244,6 +283,14 @@ class MicroBatcher:
             "svgd_serve_lane_inflight_rows",
             "rows currently inside a lane's dispatch (0 when idle; a lane "
             "stuck in a hung device call stays nonzero)")
+        # multi-tenant series (round 14)
+        self._m_quota_shed = reg.counter(
+            "svgd_serve_quota_sheds_total",
+            "requests shed by quota priority (tenant over its "
+            "inflight-rows quota when the bounded queue filled)")
+        self._m_tenant_queued = reg.gauge(
+            "svgd_serve_tenant_queued_rows",
+            "rows queued per tenant, not yet dispatched")
 
         self._threads: List[threading.Thread] = []
         if autostart:
@@ -252,9 +299,14 @@ class MicroBatcher:
     # ------------------------------------------------------------------ #
     # client side
 
-    def submit(self, x) -> Future:
+    def submit(self, x, tenant: Optional[str] = None) -> Future:
         """Enqueue one request; returns a ``Future`` resolving to the dispatch
         output dict sliced back to this request's rows.
+
+        ``tenant`` tags the request with a tenant identity: it rides the
+        same bounded queue but only coalesces with its own tenant's chunks,
+        dispatches as ``dispatch(x, tenant)``, and participates in the
+        quota shed priorities (module docstring).
 
         Raises :class:`Overloaded` when accepting the request would push the
         queue past ``max_queue_rows`` (all-or-nothing: a request is never
@@ -265,29 +317,125 @@ class MicroBatcher:
             raise ValueError(f"expected a non-empty (rows, features) array, got {x.shape}")
         rows = x.shape[0]
         tracer = _trace.get_tracer()
-        with self._cond:
-            if not self._open:
-                raise RuntimeError("batcher is closed")
-            if self._queued_rows + rows > self.max_queue_rows:
-                self._n_shed += 1
-                self._m_shed.inc()
-                raise Overloaded(
-                    f"queue full ({self._queued_rows} rows queued, request "
-                    f"of {rows} would exceed max_queue_rows="
-                    f"{self.max_queue_rows}); retry with backoff"
-                )
-            n_chunks = -(-rows // self.max_batch)
-            req = _Request(n_chunks, self._clock(),
-                           tracer.now() if tracer is not None else None,
-                           tracer)
-            for i in range(n_chunks):
-                chunk = x[i * self.max_batch : (i + 1) * self.max_batch]
-                self._queue.append(_Chunk(chunk, req, i))
-            self._queued_rows += rows
-            self._m_queue_depth.set(self._queued_rows,
-                                    batcher=self.metrics_instance)
-            self._cond.notify_all()
-            return req.future
+        tl = {} if tenant is None else {"tenant": tenant}
+        shed_futures: List[Future] = []
+        shed_err: Optional[Overloaded] = None
+        try:
+            with self._cond:
+                if not self._open:
+                    raise RuntimeError("batcher is closed")
+                if self._queued_rows + rows > self.max_queue_rows:
+                    quota = self._quota_for(tenant)
+                    if (quota is not None
+                            and self._tenant_queued.get(tenant, 0) + rows
+                            > quota):
+                        # the submitter is itself over quota while the
+                        # queue is full: IT is the first shed victim
+                        self._n_shed += 1
+                        self._quota_sheds[tenant] = (
+                            self._quota_sheds.get(tenant, 0) + 1)
+                        self._m_shed.inc(**tl)
+                        self._m_quota_shed.inc(tenant=tenant)
+                        raise Overloaded(
+                            f"queue full and tenant {tenant!r} is over its "
+                            f"inflight-rows quota ({quota}); retry with "
+                            "backoff"
+                        )
+                    shed_futures, shed_err = self._shed_over_quota_locked(
+                        self._queued_rows + rows - self.max_queue_rows)
+                    if self._queued_rows + rows > self.max_queue_rows:
+                        self._n_shed += 1
+                        self._m_shed.inc(**tl)
+                        raise Overloaded(
+                            f"queue full ({self._queued_rows} rows queued, "
+                            f"request of {rows} would exceed max_queue_rows="
+                            f"{self.max_queue_rows}); retry with backoff"
+                        )
+                n_chunks = -(-rows // self.max_batch)
+                req = _Request(n_chunks, self._clock(),
+                               tracer.now() if tracer is not None else None,
+                               tracer, tenant)
+                for i in range(n_chunks):
+                    chunk = x[i * self.max_batch : (i + 1) * self.max_batch]
+                    self._queue.append(_Chunk(chunk, req, i))
+                self._queued_rows += rows
+                if tenant is not None:
+                    self._tenant_queued[tenant] = (
+                        self._tenant_queued.get(tenant, 0) + rows)
+                    self._m_tenant_queued.set(
+                        self._tenant_queued[tenant],
+                        batcher=self.metrics_instance, tenant=tenant)
+                self._m_queue_depth.set(self._queued_rows,
+                                        batcher=self.metrics_instance)
+                self._cond.notify_all()
+                return req.future
+        finally:
+            # resolve priority-shed victims OUTSIDE the condition lock:
+            # their done-callbacks (client retry logic) may re-enter
+            # submit(), which would deadlock on the non-reentrant lock
+            for fut in shed_futures:
+                try:
+                    fut.set_exception(shed_err)
+                except InvalidStateError:
+                    pass
+
+    def _quota_for(self, tenant: Optional[str]) -> Optional[int]:
+        if tenant is None or not self._quotas:
+            return None
+        return self._quotas.get(tenant)
+
+    def _shed_over_quota_locked(self, needed: int):
+        """Free ≥ ``needed`` queued rows by shedding whole queued requests
+        of over-quota tenants, newest first (they waited least), each
+        tenant only down to its quota.  Call under the condition lock;
+        returns ``(victim futures, the Overloaded to fail them with)`` —
+        the caller resolves them after releasing the lock."""
+        if needed <= 0 or not self._quotas:
+            return [], None
+        victims: List[_Request] = []
+        victim_ids = set()
+        freed = 0
+        for chunk in reversed(self._queue):
+            if freed >= needed:
+                break
+            req = chunk.req
+            t = req.tenant
+            if t is None or id(req) in victim_ids:
+                continue
+            quota = self._quotas.get(t)
+            if quota is None or self._tenant_queued.get(t, 0) <= quota:
+                continue
+            req_rows = sum(c.x.shape[0] for c in self._queue if c.req is req)
+            victim_ids.add(id(req))
+            victims.append(req)
+            self._tenant_queued[t] = max(
+                0, self._tenant_queued.get(t, 0) - req_rows)
+            freed += req_rows
+        if not victims:
+            return [], None
+        # _locked contract: submit() holds self._cond for this whole
+        # helper (the Condition lock is non-reentrant, so re-taking it
+        # here would deadlock) — the bare writes are lock-guarded by the
+        # caller, which the lexical analyzer cannot see
+        self._queue = deque(  # jaxlint: disable=JL004
+            c for c in self._queue if id(c.req) not in victim_ids)
+        self._queued_rows -= freed  # jaxlint: disable=JL004
+        for req in victims:
+            self._n_shed += 1  # jaxlint: disable=JL004
+            self._quota_sheds[req.tenant] = (
+                self._quota_sheds.get(req.tenant, 0) + 1)
+            self._m_shed.inc(tenant=req.tenant)
+            self._m_quota_shed.inc(tenant=req.tenant)
+            self._m_tenant_queued.set(
+                self._tenant_queued.get(req.tenant, 0),
+                batcher=self.metrics_instance, tenant=req.tenant)
+        self._m_queue_depth.set(self._queued_rows,
+                                batcher=self.metrics_instance)
+        err = Overloaded(
+            "shed by quota priority: tenant over its inflight-rows quota "
+            "when the bounded queue filled; retry with backoff"
+        )
+        return [r.future for r in victims], err
 
     # ------------------------------------------------------------------ #
     # worker side
@@ -321,11 +469,25 @@ class MicroBatcher:
                     continue  # drained under us (close(drain=False))
                 batch: List[_Chunk] = []
                 rows = 0
-                while self._queue and rows + self._queue[0].x.shape[0] <= self.max_batch:
+                # one batch = one tenant: different tenants hit different
+                # engines/shapes, so a foreign chunk ends the batch (the
+                # next _collect — or another lane — picks it up)
+                head_tenant = self._queue[0].req.tenant
+                while (self._queue
+                       and rows + self._queue[0].x.shape[0] <= self.max_batch
+                       and self._queue[0].req.tenant == head_tenant):
                     chunk = self._queue.popleft()
                     batch.append(chunk)
                     rows += chunk.x.shape[0]
                 self._queued_rows -= rows
+                if head_tenant is not None:
+                    self._tenant_queued[head_tenant] = max(
+                        0, self._tenant_queued.get(head_tenant, 0) - rows)
+                    self._tenant_inflight[head_tenant] = (
+                        self._tenant_inflight.get(head_tenant, 0) + rows)
+                    self._m_tenant_queued.set(
+                        self._tenant_queued[head_tenant],
+                        batcher=self.metrics_instance, tenant=head_tenant)
                 self._m_queue_depth.set(self._queued_rows,
                                         batcher=self.metrics_instance)
                 return batch
@@ -333,22 +495,31 @@ class MicroBatcher:
     def _run_batch(self, batch: List[_Chunk], lane: int = 0) -> None:
         rows = sum(c.x.shape[0] for c in batch)
         lane_label = f"l{lane}"
+        # _collect guarantees a single-tenant batch; tenant-less batches
+        # keep the unlabelled metric series (single-tenant deployments
+        # are byte-identical)
+        tenant = batch[0].req.tenant
+        tl = {} if tenant is None else {"tenant": tenant}
         tracer = _trace.get_tracer()
         t0 = self._clock()
         t_pop = tracer.now() if tracer is not None else 0.0
         queue_wait_ms = (t0 - min(c.req.enqueued for c in batch)) * 1e3
         x = np.concatenate([c.x for c in batch], axis=0)
         self._m_lane_inflight.set(rows, batcher=self.metrics_instance,
-                                  lane=lane_label)
+                                  lane=lane_label, **tl)
         t_disp0 = tracer.now() if tracer is not None else 0.0
         try:
-            out = self._dispatch(x)
+            out = (self._dispatch(x) if tenant is None
+                   else self._dispatch(x, tenant))
         except Exception as e:
             with self._cond:
                 self._n_errors += 1
-            self._m_errors.inc()
+                if tenant is not None:
+                    self._tenant_inflight[tenant] = max(
+                        0, self._tenant_inflight.get(tenant, 0) - rows)
+            self._m_errors.inc(**tl)
             self._m_lane_inflight.set(0, batcher=self.metrics_instance,
-                                      lane=lane_label)
+                                      lane=lane_label, **tl)
             for c in batch:
                 try:
                     c.req.future.set_exception(e)
@@ -361,7 +532,7 @@ class MicroBatcher:
             return
         t_disp1 = tracer.now() if tracer is not None else 0.0
         self._m_lane_inflight.set(0, batcher=self.metrics_instance,
-                                  lane=lane_label)
+                                  lane=lane_label, **tl)
         device_ms = (self._clock() - t0) * 1e3
         now = self._clock()
         with self._cond:
@@ -380,6 +551,9 @@ class MicroBatcher:
                 offset += n
                 if all(p is not None for p in c.req.parts):
                     done_requests.append(c.req)
+            if tenant is not None:
+                self._tenant_inflight[tenant] = max(
+                    0, self._tenant_inflight.get(tenant, 0) - rows)
             self._n_batches += 1
             self._occupancy.append(rows)
             self._requests_per_batch.append(len(batch))
@@ -396,22 +570,22 @@ class MicroBatcher:
                 self._latency_ms.append(lat_ms)
                 latencies.append((req, n_rows, lat_ms))
             self._lane_requests[lane] += len(latencies)
-        self._m_batches.inc()
-        self._m_batch_rows.observe(rows)
-        self._m_queue_wait.observe(queue_wait_ms / 1e3)
-        self._m_device.observe(device_ms / 1e3)
+        self._m_batches.inc(**tl)
+        self._m_batch_rows.observe(rows, **tl)
+        self._m_queue_wait.observe(queue_wait_ms / 1e3, **tl)
+        self._m_device.observe(device_ms / 1e3, **tl)
         self._m_lane_batches.inc(batcher=self.metrics_instance,
-                                 lane=lane_label)
+                                 lane=lane_label, **tl)
         self._m_lane_rows.inc(rows, batcher=self.metrics_instance,
-                              lane=lane_label)
+                              lane=lane_label, **tl)
         if latencies:
             self._m_lane_requests.inc(len(latencies),
                                       batcher=self.metrics_instance,
-                                      lane=lane_label)
+                                      lane=lane_label, **tl)
         for req, n_rows, lat_ms in latencies:
-            self._m_requests.inc()
-            self._m_rows.inc(n_rows)
-            self._m_latency.observe(lat_ms / 1e3)
+            self._m_requests.inc(**tl)
+            self._m_rows.inc(n_rows, **tl)
+            self._m_latency.observe(lat_ms / 1e3, **tl)
         if tracer is not None:
             # one lane tree per completed request: the cross-thread
             # enqueue→reply lifetime with the queue-wait / coalesce /
@@ -425,11 +599,13 @@ class MicroBatcher:
                 enq = (req.trace_enq
                        if req.trace_src is tracer and req.trace_enq is not None
                        else t_pop)
+                attrs = {"rows": n_rows, "n_chunks": req.n_chunks,
+                         "batch_rows": rows, "batch_requests": len(batch),
+                         "lane": lane_label}
+                if tenant is not None:
+                    attrs["tenant"] = tenant
                 tracer.lane_tree(
-                    "serve.request", enq, t_reply,
-                    {"rows": n_rows, "n_chunks": req.n_chunks,
-                     "batch_rows": rows, "batch_requests": len(batch),
-                     "lane": lane_label},
+                    "serve.request", enq, t_reply, attrs,
                     children=[
                         ("serve.queue_wait", enq, t_pop, None),
                         ("serve.coalesce", t_pop, t_disp0,
@@ -446,6 +622,7 @@ class MicroBatcher:
                 requests=len(batch),
                 queue_wait_ms=round(queue_wait_ms, 3),
                 device_ms=round(device_ms, 3),
+                **({"tenant": tenant} if tenant is not None else {}),
             )
         for req, _rows, _lat in latencies:
             keys = req.parts[0].keys()
@@ -480,12 +657,67 @@ class MicroBatcher:
                 cancelled = {c.req for c in self._queue}
                 self._queue.clear()
                 self._queued_rows = 0
+                # zero the per-tenant gauges BEFORE dropping the state:
+                # a stale nonzero queued-rows series on the shared
+                # registry would outlive the batcher
+                for t in self._tenant_queued:
+                    self._m_tenant_queued.set(
+                        0, batcher=self.metrics_instance, tenant=t)
+                self._m_queue_depth.set(0, batcher=self.metrics_instance)
+                self._tenant_queued.clear()
                 for req in cancelled:
                     if not req.future.done():
                         req.future.set_exception(CancelledError("batcher closed"))
             self._cond.notify_all()
         for t in self._threads:
             t.join(timeout=timeout)
+
+    def tenant_queued_rows(self, tenant: str) -> int:
+        """Rows of ``tenant`` queued and not yet collected into a batch."""
+        with self._cond:
+            return self._tenant_queued.get(tenant, 0)
+
+    def tenant_pending_rows(self, tenant: str) -> int:
+        """Rows of ``tenant`` still owed a result: queued PLUS collected-
+        but-unresolved (the registry's drain condition on tenant removal —
+        queued alone goes to zero while the last batch is between
+        ``_collect`` and its dispatch, and removing the tenant in that
+        window would fail the batch in the router)."""
+        with self._cond:
+            return (self._tenant_queued.get(tenant, 0)
+                    + self._tenant_inflight.get(tenant, 0))
+
+    def cancel_tenant(self, tenant: str) -> int:
+        """Drop every queued chunk of ``tenant``; their futures fail with
+        ``CancelledError``.  In-flight dispatches finish normally (their
+        engine closure stays alive).  Returns the number of requests
+        cancelled — the registry's ``remove_tenant(drain=False)`` path."""
+        victims: List[_Request] = []
+        with self._cond:
+            victim_ids = set()
+            dropped_rows = 0
+            for c in self._queue:
+                if c.req.tenant == tenant:
+                    if id(c.req) not in victim_ids:
+                        victim_ids.add(id(c.req))
+                        victims.append(c.req)
+                    dropped_rows += c.x.shape[0]
+            if victim_ids:
+                self._queue = deque(
+                    c for c in self._queue if id(c.req) not in victim_ids)
+                self._queued_rows -= dropped_rows
+            self._tenant_queued.pop(tenant, None)
+            self._m_tenant_queued.set(0, batcher=self.metrics_instance,
+                                      tenant=tenant)
+            self._m_queue_depth.set(self._queued_rows,
+                                    batcher=self.metrics_instance)
+        for req in victims:
+            try:
+                req.future.set_exception(
+                    CancelledError(f"tenant {tenant!r} removed"))
+            except InvalidStateError:
+                pass
+        return len(victims)
 
     def __enter__(self):
         return self
@@ -519,6 +751,8 @@ class MicroBatcher:
                                   for i, v in enumerate(self._lane_requests)},
                 "lane_rows": {f"l{i}": v
                               for i, v in enumerate(self._lane_rows)},
+                "quota_sheds": dict(self._quota_sheds),
+                "tenant_queued": dict(self._tenant_queued),
             }
         lat.sort()
         qw.sort()

@@ -132,6 +132,22 @@ class PredictiveEngine:
         registry: ``telemetry.MetricsRegistry`` for the compile-cache
             hit/miss/reload counters (default: the process-wide registry).
             :meth:`stats` keeps per-instance counts alongside.
+        tenant: multi-tenant identity (round 14).  When set (the
+            :class:`~dist_svgd_tpu.serving.registry.ModelRegistry` sets
+            it), every engine metric carries a ``tenant=`` label so one
+            Prometheus scrape separates the tenants; unset engines keep
+            the unlabelled series — single-tenant deployments are
+            unchanged.
+        kernel_cache: optional shared
+            :class:`~dist_svgd_tpu.serving.registry.KernelBucketLRU` —
+            the process-wide bound on compiled kernel buckets across
+            tenants.  Every bucket use is reported to it; when the bound
+            overflows, the least-recently-used bucket anywhere in the
+            process is dropped (this engine's :meth:`_evict_bucket`
+            callback), so a cold tenant cannot permanently pin compile
+            cache while a hot tenant's buckets, touched every request,
+            are never the LRU victim.  ``None`` (default) keeps the
+            engine's own cache unbounded, exactly as before.
         reload_policy: optional :class:`~dist_svgd_tpu.telemetry.
             diagnostics.ReloadPolicy` — every :meth:`reload` candidate is
             health-checked (score-free ensemble diagnostics: kernel ESS,
@@ -160,6 +176,8 @@ class PredictiveEngine:
         donate: bool = True,
         registry: Optional[_metrics.MetricsRegistry] = None,
         reload_policy=None,
+        tenant: Optional[str] = None,
+        kernel_cache=None,
     ):
         if model not in MODELS:
             raise ValueError(f"unknown model {model!r}; expected one of {MODELS}")
@@ -221,6 +239,12 @@ class PredictiveEngine:
         self._hits = 0
         self._misses = 0
         self._reloads = 0
+        self._evictions = 0
+        #: Tenant identity on every metric series (empty dict = unlabelled,
+        #: the single-tenant series — backward compatible).
+        self.tenant = tenant
+        self._tlabels = {} if tenant is None else {"tenant": str(tenant)}
+        self._kernel_cache = kernel_cache
         reg = registry if registry is not None else _metrics.default_registry()
         self.registry = reg
         self._m_hits = reg.counter(
@@ -233,6 +257,9 @@ class PredictiveEngine:
         self._m_reload_rejects = reg.counter(
             "svgd_engine_reload_rejected_total",
             "hot reloads refused by the ensemble-health policy")
+        self._m_evictions = reg.counter(
+            "svgd_registry_evictions_total",
+            "compiled kernel buckets evicted by the shared LRU")
         self._reload_policy = reload_policy
         self._reload_rejects = 0
         # served ensemble's health baseline (computed lazily at the first
@@ -419,8 +446,28 @@ class PredictiveEngine:
                 miss = False
             dtype = self._input_dtype(self._particles.dtype)
         # registry write outside the engine lock (its own lock suffices)
-        (self._m_misses if miss else self._m_hits).inc()
+        (self._m_misses if miss else self._m_hits).inc(**self._tlabels)
+        if self._kernel_cache is not None:
+            # report the use outside the engine lock: the shared LRU may
+            # evict another engine's bucket (its _evict_bucket takes THAT
+            # engine's lock) — lock order is always cache -> engine, never
+            # engine -> cache, so tenants cannot deadlock each other
+            self._kernel_cache.touch(self, bucket)
         return fn, dtype
+
+    def _evict_bucket(self, bucket: int) -> bool:
+        """Shared-LRU eviction callback: drop one compiled bucket kernel.
+        The NEXT request on that bucket recompiles (a counted miss) — by
+        construction only a least-recently-used bucket lands here, so a
+        hot tenant's steady-state traffic never recompiles (regression-
+        pinned under the retrace sentry in tests/test_registry.py)."""
+        with self._lock:
+            existed = self._kernels.pop(bucket, None) is not None
+            if existed:
+                self._evictions += 1
+        if existed:
+            self._m_evictions.inc(**self._tlabels)
+        return existed
 
     # ------------------------------------------------------------------ #
     # serving
@@ -535,7 +582,7 @@ class PredictiveEngine:
             if reasons:
                 with self._lock:
                     self._reload_rejects += 1
-                self._m_reload_rejects.inc()
+                self._m_reload_rejects.inc(**self._tlabels)
                 _trace.instant("engine.reload_rejected", {"tag": tag})
                 rec = _trace.flight_recorder()
                 if rec is not None:
@@ -586,7 +633,7 @@ class PredictiveEngine:
                         self._health_report = new_report
                     break
                 buckets = missing
-        self._m_reloads.inc()
+        self._m_reloads.inc(**self._tlabels)
         _trace.instant("engine.reload", {"tag": tag})
         return {"n_particles": int(particles.shape[0]),
                 "warmed_buckets": sorted(new_kernels), "tag": tag}
@@ -596,6 +643,7 @@ class PredictiveEngine:
         with self._lock:
             return {
                 "model": self.model,
+                "tenant": self.tenant,
                 "n_particles": self.n_particles,
                 "feature_dim": self._feature_dim,
                 "dtype": str(self._particles.dtype),
@@ -603,6 +651,11 @@ class PredictiveEngine:
                 "plan": self._plan.describe(),
                 "bucket_hits": self._hits,
                 "bucket_misses": self._misses,
+                # bounded-cache visibility (round 14): how many compiled
+                # bucket kernels this engine holds right now, and how many
+                # the shared LRU has taken back from it
+                "bucket_cache_size": len(self._kernels),
+                "bucket_evictions": self._evictions,
                 "compiled_buckets": sorted(self._kernels),
                 "reloads": self._reloads,
                 "reload_rejects": self._reload_rejects,

@@ -1,10 +1,19 @@
-"""Thin stdlib HTTP front end over the engine + batcher.
+"""Thin stdlib HTTP front end over the engine + batcher — or over a
+multi-tenant :class:`~dist_svgd_tpu.serving.registry.ModelRegistry`.
 
-JSON in/out, five routes:
+JSON in/out:
 
 - ``POST /predict``      — ``{"inputs": [[...], ...]}`` → the engine's
-  output dict as lists, plus this request's latency split;
-- ``GET  /healthz``      — liveness + ensemble identity;
+  output dict as lists, plus this request's latency split.  Against a
+  registry, the body's ``"tenant"`` field routes to that tenant's engine
+  (404 for an unknown tenant; omitted, it defaults to the registry's
+  single tenant when there is exactly one, else 400);
+- ``GET  /healthz``      — liveness + ensemble identity; against a
+  registry, the aggregate plus one row per tenant, and
+  ``GET /healthz/<tenant>`` the per-tenant detail (engine stats, cache
+  counters, loaded step);
+- ``GET  /tenants``      — registry mode only: the tenant listing
+  (model, shapes, state, quota, watched step);
 - ``GET  /metrics``      — **Prometheus text exposition** of the shared
   telemetry registry (request/row/batch/shed counters, queue-depth gauge,
   latency histograms, engine bucket-cache counters — scrape it);
@@ -33,12 +42,13 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Union
 
 import numpy as np
 
 from dist_svgd_tpu.serving.batcher import MicroBatcher, Overloaded
 from dist_svgd_tpu.serving.engine import PredictiveEngine
+from dist_svgd_tpu.serving.registry import ModelRegistry
 from dist_svgd_tpu.telemetry import metrics as _metrics
 from dist_svgd_tpu.telemetry import trace as _trace
 
@@ -46,14 +56,20 @@ from dist_svgd_tpu.telemetry import trace as _trace
 class PredictionServer:
     """HTTP serving front end.  ``port=0`` binds an ephemeral port (tests).
 
-    The server owns its batcher unless one is passed in; :meth:`shutdown`
-    drains it either way (stop accepting → finish in-flight handlers →
-    dispatch everything still queued).
+    The first argument is either a single :class:`PredictiveEngine`
+    (single-tenant, unchanged behavior) or a :class:`ModelRegistry`
+    (multi-tenant: the server rides the registry's shared batcher and
+    routes ``/predict`` on the body's ``tenant`` field).
+
+    The server owns its batcher unless one is passed in (single-tenant)
+    or the registry owns it (multi-tenant); :meth:`shutdown` drains it
+    either way (stop accepting → finish in-flight handlers → dispatch
+    everything still queued).
     """
 
     def __init__(
         self,
-        engine: PredictiveEngine,
+        engine: Union[PredictiveEngine, ModelRegistry],
         *,
         host: str = "127.0.0.1",
         port: int = 8000,
@@ -68,18 +84,34 @@ class PredictionServer:
         slo=None,
         slo_p99_ms: float = 100.0,
     ):
-        self.engine = engine
-        self.registry = (registry if registry is not None
-                         else _metrics.default_registry())
-        self.batcher = batcher or MicroBatcher(
-            engine.predict,
-            max_batch=max_batch,
-            lanes=lanes,
-            max_wait_ms=max_wait_ms,
-            max_queue_rows=max_queue_rows,
-            logger=None,  # batch records would interleave with request records
-            registry=self.registry,
-        )
+        if isinstance(engine, ModelRegistry):
+            self.model_registry: Optional[ModelRegistry] = engine
+            self.engine = None
+            if batcher is not None:
+                raise ValueError(
+                    "a ModelRegistry brings its own shared batcher; "
+                    "don't pass batcher="
+                )
+            # share the registry's metrics sink so /metrics exposes the
+            # tenant-labelled series the tenants actually write
+            self.registry = (registry if registry is not None
+                             else engine.metrics)
+            self.batcher = engine.batcher
+        else:
+            self.model_registry = None
+            self.engine = engine
+            self.registry = (registry if registry is not None
+                             else _metrics.default_registry())
+            self.batcher = batcher or MicroBatcher(
+                engine.predict,
+                max_batch=max_batch,
+                lanes=lanes,
+                max_wait_ms=max_wait_ms,
+                max_queue_rows=max_queue_rows,
+                logger=None,  # batch records would interleave with request
+                              # records
+                registry=self.registry,
+            )
         self._logger = logger
         self._request_timeout_s = request_timeout_s
         self._lock = threading.Lock()
@@ -127,17 +159,34 @@ class PredictionServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
                     self._reply(200, server.health())
-                elif self.path == "/metrics":
+                elif path.startswith("/healthz/"):
+                    name = path[len("/healthz/"):]
+                    detail = server.tenant_health(name)
+                    if detail is None:
+                        self._reply(404, {"error": f"no tenant {name!r}"})
+                    else:
+                        self._reply(200, detail)
+                elif path == "/tenants":
+                    if server.model_registry is None:
+                        self._reply(404, {"error": "single-tenant server: "
+                                          "no /tenants route"})
+                    else:
+                        self._reply(
+                            200,
+                            {"tenants":
+                             server.model_registry.health()["tenants"]})
+                elif path == "/metrics":
                     # Prometheus text format 0.0.4 — what scrapers expect
                     self._reply_text(
                         200, server.registry.exposition(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
-                elif self.path == "/metrics.json":
+                elif path == "/metrics.json":
                     self._reply(200, server.metrics())
-                elif self.path == "/slo":
+                elif path == "/slo":
                     self._reply(200, server.slo_engine.evaluate())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
@@ -148,18 +197,21 @@ class PredictionServer:
                     return
                 t0 = time.perf_counter()
                 with _trace.span("http.predict"):
-                    code, payload, rows = server._predict(self._read_body())
+                    code, payload, rows, tenant = server._predict(
+                        self._read_body())
                 wall = time.perf_counter() - t0
                 payload.setdefault("latency_ms", round(wall * 1e3, 3))
                 self._reply(code, payload)
-                server._m_http.inc(route="/predict", status=code)
-                server._m_http_latency.observe(wall)
+                tl = {} if tenant is None else {"tenant": tenant}
+                server._m_http.inc(route="/predict", status=code, **tl)
+                server._m_http_latency.observe(wall, **tl)
                 if server._logger is not None:
                     server._logger.log(
                         route="/predict",
                         status=code,
                         rows=rows,
                         latency_ms=payload["latency_ms"],
+                        **tl,
                     )
 
             def _read_body(self) -> bytes:
@@ -182,7 +234,11 @@ class PredictionServer:
         return f"http://{host}:{port}"
 
     def _predict(self, body: bytes):
-        """Returns ``(status_code, payload, rows)``; never raises."""
+        """Returns ``(status_code, payload, rows, tenant)``; never raises."""
+        from concurrent.futures import CancelledError
+
+        tenant = None
+        # phase 1 — parse and validate the request (client errors → 400)
         try:
             doc = json.loads(body or b"null")
             inputs = doc["inputs"] if isinstance(doc, dict) else None
@@ -191,25 +247,71 @@ class PredictionServer:
             x = np.asarray(inputs, dtype=np.float32)
             if x.ndim == 1:  # single row shorthand
                 x = x[None, :]
-            future = self.batcher.submit(x)
+            if self.model_registry is not None:
+                tenant = doc.get("tenant")
+                if tenant is None:
+                    names = self.model_registry.tenant_names()
+                    if len(names) != 1:
+                        raise ValueError(
+                            'multi-tenant server: body needs a "tenant" '
+                            f"field (hosted: {names})"
+                        )
+                    tenant = names[0]
+            elif isinstance(doc, dict) and doc.get("tenant") is not None:
+                raise ValueError(
+                    "single-tenant server: drop the \"tenant\" field"
+                )
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            with self._lock:
+                self._errors += 1
+            return 400, {"error": str(e)}, 0, tenant
+        # phase 2 — submit and resolve (server-side failures are NOT the
+        # client's fault: 404 unknown tenant, 503 retryable, 500 bugs)
+        try:
+            if self.model_registry is not None:
+                try:
+                    future = self.model_registry.submit(tenant, x)
+                except KeyError as e:
+                    with self._lock:
+                        self._errors += 1
+                    return 404, {"error": str(e)}, 0, tenant
+            else:
+                future = self.batcher.submit(x)
             out = future.result(timeout=self._request_timeout_s)
         except Overloaded as e:
             with self._lock:
                 self._errors += 1
-            return 503, {"error": str(e)}, 0
-        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            return 503, {"error": str(e)}, 0, tenant
+        except (KeyError, CancelledError) as e:
+            # the tenant was removed (or the batcher cancelled) while the
+            # request was queued: retryable server-side condition, not a
+            # malformed request
             with self._lock:
                 self._errors += 1
-            return 400, {"error": str(e)}, 0
+            return 503, {"error": f"request dropped: {e}"}, 0, tenant
+        except ValueError as e:
+            # the engine rejected the batch (e.g. feature-width mismatch
+            # discovered at dispatch) — the request itself was bad
+            with self._lock:
+                self._errors += 1
+            return 400, {"error": str(e)}, 0, tenant
         except Exception as e:  # dispatch failure / timeout
             with self._lock:
                 self._errors += 1
-            return 500, {"error": f"{type(e).__name__}: {e}"}, 0
+            return 500, {"error": f"{type(e).__name__}: {e}"}, 0, tenant
         with self._lock:
             self._requests += 1
-        return 200, {"outputs": {k: v.tolist() for k, v in out.items()}}, x.shape[0]
+        payload = {"outputs": {k: v.tolist() for k, v in out.items()}}
+        if tenant is not None:
+            payload["tenant"] = tenant
+        return 200, payload, x.shape[0], tenant
 
     def health(self) -> Dict[str, Any]:
+        if self.model_registry is not None:
+            doc = self.model_registry.health()
+            doc.update(lanes=self.batcher.lanes,
+                       uptime_s=round(time.time() - self._started, 1))
+            return doc
         st = self.engine.stats()
         return {
             "status": "ok",
@@ -221,9 +323,22 @@ class PredictionServer:
             "uptime_s": round(time.time() - self._started, 1),
         }
 
+    def tenant_health(self, name: str) -> Optional[Dict[str, Any]]:
+        """Per-tenant ``/healthz/<name>`` detail (None when unknown or on
+        a single-tenant server — the route 404s)."""
+        if self.model_registry is None:
+            return None
+        try:
+            stats = self.model_registry.stats()["tenants"][name]
+        except KeyError:
+            return None
+        return {"status": "ok", "tenant": name, **stats}
+
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
             server_side = {"http_requests": self._requests, "http_errors": self._errors}
+        if self.model_registry is not None:
+            return {**server_side, "registry": self.model_registry.stats()}
         return {**server_side, "batcher": self.batcher.stats(),
                 "engine": self.engine.stats()}
 
@@ -249,13 +364,17 @@ class PredictionServer:
 
     def shutdown(self) -> None:
         """Graceful drain: stop accepting, finish in-flight handlers, flush
-        the batcher queue."""
+        the batcher queue (and, in registry mode, stop the checkpoint
+        scanner and close the registry)."""
         self._httpd.shutdown()
         self._httpd.server_close()  # joins non-daemon handler threads
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=10)
             self._serve_thread = None
-        self.batcher.close(drain=True)
+        if self.model_registry is not None:
+            self.model_registry.close(drain=True)
+        else:
+            self.batcher.close(drain=True)
 
     def __enter__(self):
         return self.start()
@@ -269,10 +388,22 @@ def main(argv=None):
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--checkpoint", action="append", required=True,
+    ap.add_argument("--checkpoint", action="append", default=None,
                     help="checkpoint dir, CheckpointManager root, or repeat "
                          "the flag with every per-process path of one "
-                         "multi-host save")
+                         "multi-host save (single-tenant mode)")
+    ap.add_argument("--tenants-config", default=None, metavar="PATH",
+                    help="multi-tenant mode: JSON list of tenant specs "
+                         '[{"name": ..., "model": ..., "checkpoint": ..., '
+                         '"quota_rows": ..., "watch": true, ...}]; extra '
+                         "keys go to the tenant's engine. Mutually "
+                         "exclusive with --checkpoint")
+    ap.add_argument("--max-total-buckets", type=int, default=64,
+                    help="multi-tenant mode: process-wide LRU bound on "
+                         "compiled kernel buckets across tenants")
+    ap.add_argument("--scan-interval-s", type=float, default=5.0,
+                    help="multi-tenant mode: shared checkpoint-scanner "
+                         "cadence over the watched tenant roots")
     ap.add_argument("--model", choices=("logreg", "bnn", "gmm"), default="logreg")
     ap.add_argument("--n-features", type=int, default=None,
                     help="BNN input width (required for --model bnn)")
@@ -308,22 +439,45 @@ def main(argv=None):
 
     from dist_svgd_tpu.parallel.plan import make_plan
 
-    source = args.checkpoint[0] if len(args.checkpoint) == 1 else args.checkpoint
-    plan = make_plan(args.shards if args.shards else None)
-    engine = PredictiveEngine.from_checkpoint(
-        source, args.model, n_features=args.n_features, n_hidden=args.n_hidden,
-        kde_bandwidth=args.kde_bandwidth, max_bucket=args.max_batch,
-        plan=plan, dtype=args.dtype,
-    )
-    if args.warmup:
-        compiled = engine.warmup()
-        print(json.dumps({"warmup_buckets": compiled}), flush=True)
+    if (args.checkpoint is None) == (args.tenants_config is None):
+        ap.error("pass exactly one of --checkpoint or --tenants-config")
     logger = JsonlLogger(path=args.request_log) if args.request_log else None
-    srv = PredictionServer(
-        engine, host=args.host, port=args.port, max_batch=args.max_batch,
-        lanes=args.lanes, max_wait_ms=args.max_wait_ms,
-        max_queue_rows=args.max_queue_rows, logger=logger,
-    )
+    if args.tenants_config:
+        with open(args.tenants_config) as fh:
+            specs = json.load(fh)
+        reg = ModelRegistry(
+            max_total_buckets=args.max_total_buckets,
+            max_batch=args.max_batch, lanes=args.lanes,
+            max_wait_ms=args.max_wait_ms,
+            max_queue_rows=args.max_queue_rows,
+            scan_interval_s=args.scan_interval_s,
+        )
+        for spec in specs:
+            spec = dict(spec)
+            reg.add_tenant(spec.pop("name"), spec.pop("model"), **spec)
+        if args.warmup:
+            warmed = reg.warm()
+            print(json.dumps({"warmup_buckets": warmed}), flush=True)
+        reg.start_scanner()
+        srv = PredictionServer(reg, host=args.host, port=args.port,
+                               logger=logger)
+    else:
+        source = (args.checkpoint[0] if len(args.checkpoint) == 1
+                  else args.checkpoint)
+        plan = make_plan(args.shards if args.shards else None)
+        engine = PredictiveEngine.from_checkpoint(
+            source, args.model, n_features=args.n_features,
+            n_hidden=args.n_hidden, kde_bandwidth=args.kde_bandwidth,
+            max_bucket=args.max_batch, plan=plan, dtype=args.dtype,
+        )
+        if args.warmup:
+            compiled = engine.warmup()
+            print(json.dumps({"warmup_buckets": compiled}), flush=True)
+        srv = PredictionServer(
+            engine, host=args.host, port=args.port, max_batch=args.max_batch,
+            lanes=args.lanes, max_wait_ms=args.max_wait_ms,
+            max_queue_rows=args.max_queue_rows, logger=logger,
+        )
     print(json.dumps({"serving": srv.url, **srv.health()}), flush=True)
     srv.serve_forever()
 

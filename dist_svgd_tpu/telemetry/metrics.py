@@ -18,6 +18,17 @@ by ONE registry lock (the write path is a dict upsert — at serving rates the
 lock is uncontended; the exposition path snapshots under the lock and formats
 outside it, the same discipline as ``MicroBatcher.stats``).
 
+**Label-cardinality guard** (round 14): per-tenant labels make unbounded
+cardinality a real leak — a buggy or adversarial label value (a request id,
+a timestamp) would grow a metric's series dict and its exposition without
+bound.  Every metric therefore bounds its distinct label sets
+(``max_label_sets``, default :data:`DEFAULT_MAX_LABEL_SETS`, configurable
+per registry and per metric); once the bound is reached, *new* label sets
+aggregate into a reserved rollup series whose label values are all
+:data:`OTHER_LABEL_VALUE` (``{tenant="other"}``) with a one-time
+``RuntimeWarning`` per metric.  Already-admitted series keep updating —
+the guard caps growth, it never drops data.
+
 Exposition is Prometheus text format 0.0.4 (:meth:`MetricsRegistry.
 exposition`) — the serving server's ``/metrics`` serves it directly — plus a
 JSON-friendly :meth:`~MetricsRegistry.snapshot` for BENCH-style rows.
@@ -33,16 +44,28 @@ from __future__ import annotations
 import math
 import re
 import threading
+import warnings
 from typing import Dict, Iterable, Optional, Tuple
 
 __all__ = [
+    "DEFAULT_MAX_LABEL_SETS",
     "LATENCY_BUCKETS_S",
+    "OTHER_LABEL_VALUE",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "default_registry",
 ]
+
+#: Default per-metric bound on distinct label sets — generous for the
+#: repo's own labels (tenants × lanes × routes stay well under it) while
+#: capping a genuine cardinality leak at a fixed exposition size.
+DEFAULT_MAX_LABEL_SETS = 128
+
+#: Reserved label value the overflow rollup series carries for every label
+#: name of the set that overflowed (``{tenant="other"}``).
+OTHER_LABEL_VALUE = "other"
 
 #: Fixed log-spaced latency buckets (seconds): powers of two from 0.1 ms up
 #: to ~26 s, 19 buckets.  One shared lattice for every latency histogram so
@@ -94,11 +117,42 @@ class _Metric:
 
     kind = "untyped"
 
-    def __init__(self, name: str, help: str, lock: threading.Lock):
+    def __init__(self, name: str, help: str, lock: threading.Lock,
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
+        if max_label_sets < 1:
+            raise ValueError(
+                f"metric {name!r} needs max_label_sets >= 1, "
+                f"got {max_label_sets}"
+            )
         self.name = name
         self.help = help
+        self.max_label_sets = int(max_label_sets)
         self._lock = lock
         self._series: Dict[_LabelKey, object] = {}
+        self._overflowed = False
+
+    def _admit(self, key: _LabelKey) -> Tuple[_LabelKey, bool]:
+        """Cardinality guard (call under the lock): an already-known label
+        set or one under the bound is admitted as-is; a NEW set past the
+        bound maps to the reserved rollup key (same label names, every
+        value :data:`OTHER_LABEL_VALUE`).  Returns ``(key, warn)`` where
+        ``warn`` is True exactly once per metric — the caller emits the
+        warning after releasing the lock."""
+        if key in self._series or len(self._series) < self.max_label_sets:
+            return key, False
+        rollup = tuple((k, OTHER_LABEL_VALUE) for k, _ in key)
+        warn = not self._overflowed
+        self._overflowed = True
+        return rollup, warn
+
+    def _warn_overflow(self) -> None:
+        warnings.warn(
+            f"metric {self.name!r} exceeded max_label_sets="
+            f"{self.max_label_sets}: further new label sets aggregate into "
+            f'the reserved {{...="{OTHER_LABEL_VALUE}"}} rollup series',
+            RuntimeWarning,
+            stacklevel=3,
+        )
 
     def _header(self) -> list:
         lines = []
@@ -123,9 +177,11 @@ class Counter(_Metric):
     def inc(self, amount: float = 1, **labels) -> None:
         if amount < 0:
             raise ValueError(f"counter {self.name} cannot decrease ({amount})")
-        key = _label_key(labels)
         with self._lock:
+            key, warn = self._admit(_label_key(labels))
             self._series[key] = self._series.get(key, 0) + amount
+        if warn:
+            self._warn_overflow()
 
     def value(self, **labels) -> float:
         with self._lock:
@@ -151,12 +207,17 @@ class Gauge(_Metric):
 
     def set(self, value: float, **labels) -> None:
         with self._lock:
-            self._series[_label_key(labels)] = float(value)
+            key, warn = self._admit(_label_key(labels))
+            self._series[key] = float(value)
+        if warn:
+            self._warn_overflow()
 
     def inc(self, amount: float = 1, **labels) -> None:
-        key = _label_key(labels)
         with self._lock:
+            key, warn = self._admit(_label_key(labels))
             self._series[key] = self._series.get(key, 0.0) + amount
+        if warn:
+            self._warn_overflow()
 
     def dec(self, amount: float = 1, **labels) -> None:
         self.inc(-amount, **labels)
@@ -194,8 +255,9 @@ class Histogram(_Metric):
     kind = "histogram"
 
     def __init__(self, name: str, help: str, lock: threading.Lock,
-                 buckets: Optional[Iterable[float]] = None):
-        super().__init__(name, help, lock)
+                 buckets: Optional[Iterable[float]] = None,
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
+        super().__init__(name, help, lock, max_label_sets=max_label_sets)
         bounds = tuple(buckets) if buckets is not None else LATENCY_BUCKETS_S
         if not bounds or any(b <= a for a, b in zip(bounds, bounds[1:])):
             raise ValueError(
@@ -205,8 +267,8 @@ class Histogram(_Metric):
         self.buckets = bounds  # upper bounds; +Inf is implicit
 
     def observe(self, value: float, **labels) -> None:
-        key = _label_key(labels)
         with self._lock:
+            key, warn = self._admit(_label_key(labels))
             series = self._series.get(key)
             if series is None:
                 series = self._series[key] = _HistSeries(len(self.buckets) + 1)
@@ -219,6 +281,8 @@ class Histogram(_Metric):
             series.counts[i] += 1
             series.sum += value
             series.count += 1
+        if warn:
+            self._warn_overflow()
 
     def _snapshot(self, labels: dict) -> Optional[_HistSeries]:
         with self._lock:
@@ -306,19 +370,35 @@ class MetricsRegistry:
     can be constructed many times per process — a second ``MicroBatcher``
     aggregates into the same counters, the Prometheus convention); asking
     for the same name as a different metric kind raises.
+
+    ``max_label_sets`` is the registry-wide default cardinality bound per
+    metric (see the module docstring); the per-metric ``max_label_sets=``
+    on :meth:`counter`/:meth:`gauge`/:meth:`histogram` overrides it **at
+    creation** — a later get-or-create of the same name returns the
+    existing metric with its original bound.
     """
 
-    def __init__(self):
+    def __init__(self, max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
+        if max_label_sets < 1:
+            raise ValueError(
+                f"max_label_sets must be >= 1, got {max_label_sets}"
+            )
+        self.max_label_sets = int(max_label_sets)
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
 
-    def _get_or_create(self, cls, name: str, help: str, **kwargs) -> _Metric:
+    def _get_or_create(self, cls, name: str, help: str,
+                       max_label_sets: Optional[int] = None,
+                       **kwargs) -> _Metric:
         if not _NAME_OK.match(name):
             raise ValueError(f"invalid metric name {name!r}")
+        bound = (self.max_label_sets if max_label_sets is None
+                 else max_label_sets)
         with self._lock:
             metric = self._metrics.get(name)
             if metric is None:
                 metric = self._metrics[name] = cls(name, help, self._lock,
+                                                   max_label_sets=bound,
                                                    **kwargs)
             elif type(metric) is not cls:
                 raise ValueError(
@@ -327,15 +407,21 @@ class MetricsRegistry:
                 )
             return metric
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                max_label_sets: Optional[int] = None) -> Counter:
+        return self._get_or_create(Counter, name, help,
+                                   max_label_sets=max_label_sets)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              max_label_sets: Optional[int] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help,
+                                   max_label_sets=max_label_sets)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Optional[Iterable[float]] = None) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+                  buckets: Optional[Iterable[float]] = None,
+                  max_label_sets: Optional[int] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets,
+                                   max_label_sets=max_label_sets)
 
     def exposition(self) -> str:
         """Prometheus text format 0.0.4; one block per metric, names sorted

@@ -1,0 +1,659 @@
+"""Multi-tenant model registry (dist_svgd_tpu/serving/registry.py):
+KernelBucketLRU bounds + hot-tenant protection, quota shed priorities,
+tenant lifecycle (add / remove-under-load / corrupt-checkpoint and
+rejected-reload isolation), the shared scanner, HTTP routing on the
+tenant field, and the serve_multitenant bench row schema.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dist_svgd_tpu.serving import (
+    KernelBucketLRU,
+    MicroBatcher,
+    ModelRegistry,
+    Overloaded,
+    PredictionServer,
+    PredictiveEngine,
+)
+from dist_svgd_tpu.telemetry import MetricsRegistry
+from dist_svgd_tpu.utils.checkpoint import CheckpointManager
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _registry(**kw):
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("max_wait_ms", 0.5)
+    return ModelRegistry(**kw)
+
+
+def _add_logreg(reg, name, rng, n=16, k=4, **kw):
+    parts = rng.normal(size=(n, 1 + k)).astype(np.float32)
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("max_bucket", 16)
+    tenant = reg.add_tenant(name, "logreg", particles=parts, **kw)
+    return tenant, parts
+
+
+# --------------------------------------------------------------------- #
+# KernelBucketLRU
+
+
+def test_lru_bounds_total_buckets_and_counts_evictions(rng):
+    met = MetricsRegistry()
+    cache = KernelBucketLRU(max_buckets=3)
+    engines = [
+        PredictiveEngine(
+            "logreg", rng.normal(size=(8, 5)).astype(np.float32),
+            min_bucket=4, max_bucket=32, registry=met,
+            tenant=f"t{i}", kernel_cache=cache)
+        for i in range(2)
+    ]
+    x4 = rng.normal(size=(4, 4)).astype(np.float32)
+    x8 = rng.normal(size=(8, 4)).astype(np.float32)
+    x16 = rng.normal(size=(16, 4)).astype(np.float32)
+    engines[0].predict(x4)
+    engines[0].predict(x8)
+    engines[1].predict(x4)
+    assert cache.stats() == {"size": 3, "max_buckets": 3, "evictions": 0}
+    # a 4th distinct bucket evicts the LRU entry: engine0's bucket 4
+    engines[1].predict(x8)
+    st = cache.stats()
+    assert st["size"] == 3 and st["evictions"] == 1
+    e0 = engines[0].stats()
+    assert e0["bucket_evictions"] == 1
+    assert e0["compiled_buckets"] == [8]
+    assert e0["bucket_cache_size"] == 1
+    # tenant-labelled eviction counter
+    assert met.counter("svgd_registry_evictions_total").value(
+        tenant="t0") == 1
+    # the evicted bucket recompiles on next use (a counted miss), and the
+    # pressure rolls on to the new LRU victim
+    before = engines[0].stats()["bucket_misses"]
+    engines[0].predict(x4)
+    assert engines[0].stats()["bucket_misses"] == before + 1
+    # predictions still correct after eviction round-trips
+    direct = PredictiveEngine(
+        "logreg", engines[0].particles, min_bucket=4, max_bucket=32,
+        registry=MetricsRegistry())
+    np.testing.assert_array_equal(engines[0].predict(x16)["mean"],
+                                  direct.predict(x16)["mean"])
+
+
+def test_lru_forget_drops_without_counting(rng):
+    cache = KernelBucketLRU(max_buckets=8)
+    eng = PredictiveEngine(
+        "logreg", rng.normal(size=(8, 5)).astype(np.float32),
+        min_bucket=4, max_bucket=16, registry=MetricsRegistry(),
+        kernel_cache=cache)
+    eng.warmup()
+    assert cache.stats()["size"] == 3
+    assert cache.forget(eng) == 3
+    assert cache.stats() == {"size": 0, "max_buckets": 8, "evictions": 0}
+
+
+def test_lru_validates_capacity():
+    with pytest.raises(ValueError, match="max_buckets"):
+        KernelBucketLRU(max_buckets=0)
+
+
+def test_hot_tenant_never_recompiles_while_cold_tenants_churn(rng):
+    """The satellite regression pin: under cache pressure, eviction must
+    never cost a HOT tenant a steady-state recompile.  Cold tenants churn
+    compiles (evicting each other), the hot tenant is touched every
+    round; its bucket is therefore never the LRU victim, verified by the
+    retrace sentry over a hot-only window."""
+    from tools.jaxlint.sentry import retrace_sentry
+
+    met = MetricsRegistry()
+    cache = KernelBucketLRU(max_buckets=3)
+    hot = PredictiveEngine(
+        "logreg", rng.normal(size=(8, 5)).astype(np.float32),
+        min_bucket=8, max_bucket=8, registry=met, tenant="hot",
+        kernel_cache=cache)
+    colds = [
+        PredictiveEngine(
+            "logreg", rng.normal(size=(8, 3 + i)).astype(np.float32),
+            min_bucket=8, max_bucket=8, registry=met, tenant=f"cold{i}",
+            kernel_cache=cache)
+        for i in range(4)
+    ]
+    xh = rng.normal(size=(5, 4)).astype(np.float32)
+    hot.warmup([5])
+    # churn: each cold predict compiles (4 cold engines rotating through
+    # 2 free slots), but the hot bucket is re-touched between every one
+    for round_i in range(8):
+        hot.predict(xh)
+        cold = colds[round_i % len(colds)]
+        cold.predict(rng.normal(
+            size=(3, cold.feature_dim)).astype(np.float32))
+    assert cache.stats()["evictions"] >= 4  # pressure was real
+    assert hot.stats()["bucket_evictions"] == 0
+    misses_before = hot.stats()["bucket_misses"]
+    with retrace_sentry("hot tenant steady state") as sentry:
+        for _ in range(16):
+            hot.predict(xh)
+    assert hot.stats()["bucket_misses"] == misses_before
+    if sentry.supported:
+        assert sentry.compiles == 0
+
+
+# --------------------------------------------------------------------- #
+# quota shed priorities (deterministic: paused batcher)
+
+
+def test_quota_priority_shed_hog_before_polite(rng):
+    reg = _registry(max_batch=8, max_queue_rows=32,
+                    batcher_autostart=False)
+    _add_logreg(reg, "hog", rng, quota_rows=8,
+                min_bucket=8, max_bucket=8)
+    _add_logreg(reg, "polite", rng, min_bucket=8, max_bucket=8)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    hog_futs = [reg.submit("hog", x) for _ in range(4)]  # 32 rows queued
+    # the polite arrival overflows the bounded queue: the hog (4x over
+    # its quota of 8) sheds its NEWEST queued request, the polite request
+    # is admitted
+    polite_fut = reg.submit("polite", x)
+    stats = reg.batcher.stats()
+    assert stats["quota_sheds"] == {"hog": 1}
+    assert stats["tenant_queued"] == {"hog": 24, "polite": 8}
+    assert isinstance(hog_futs[3].exception(timeout=1), Overloaded)
+    assert "quota" in str(hog_futs[3].exception())
+    # an over-quota SUBMITTER is refused outright while the queue is full
+    with pytest.raises(Overloaded, match="over its inflight-rows quota"):
+        reg.submit("hog", x)
+    assert reg.batcher.stats()["quota_sheds"] == {"hog": 2}
+    met = reg.metrics
+    assert met.counter("svgd_serve_quota_sheds_total").value(
+        tenant="hog") == 2
+    assert met.counter("svgd_serve_quota_sheds_total").value(
+        tenant="polite") == 0
+    # drain: everything still queued resolves, including the polite one
+    reg.batcher.start()
+    assert polite_fut.result(timeout=30)["mean"].shape == (8,)
+    for fut in hog_futs[:3]:
+        assert fut.result(timeout=30)["mean"].shape == (8,)
+    reg.close()
+
+
+def test_quotas_inert_while_queue_has_room(rng):
+    reg = _registry(max_batch=8, max_queue_rows=64,
+                    batcher_autostart=False)
+    _add_logreg(reg, "hog", rng, quota_rows=8, min_bucket=8, max_bucket=8)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    # 4x over quota, but the queue is not full: no shedding
+    futs = [reg.submit("hog", x) for _ in range(4)]
+    assert reg.batcher.stats()["quota_sheds"] == {}
+    reg.batcher.start()
+    for fut in futs:
+        assert fut.result(timeout=30)["mean"].shape == (8,)
+    reg.close()
+
+
+def test_batches_never_mix_tenants(rng):
+    """One coalesced batch = one tenant: the dispatch sees single-tenant
+    batches even when both tenants' chunks are interleaved in the queue."""
+    seen = []
+
+    def dispatch(x, tenant):
+        seen.append((tenant, x.shape[0]))
+        return {"v": np.zeros(x.shape[0], np.float32)}
+
+    bat = MicroBatcher(dispatch, max_batch=64, max_wait_ms=0.0,
+                       registry=MetricsRegistry(), autostart=False)
+    xa = np.zeros((2, 3), np.float32)
+    futs = []
+    for i in range(6):
+        futs.append(bat.submit(xa, tenant="a" if i % 2 == 0 else "b"))
+    bat.start()
+    for fut in futs:
+        assert fut.result(timeout=10)["v"].shape == (2,)
+    bat.close()
+    assert sum(rows for _, rows in seen) == 12
+    # interleaved a/b/a/b... submits can never share a batch
+    assert all(t in ("a", "b") for t, _ in seen)
+    assert len(seen) == 6  # every flush stopped at the tenant boundary
+
+
+# --------------------------------------------------------------------- #
+# registry lifecycle
+
+
+def test_registry_validates_names_and_args(rng):
+    reg = _registry()
+    with pytest.raises(ValueError, match="invalid tenant name"):
+        reg.add_tenant("bad name!", "logreg",
+                       particles=np.zeros((4, 3), np.float32))
+    # "other" is the metrics cardinality-rollup value: a tenant by that
+    # name would alias the rollup series
+    with pytest.raises(ValueError, match="reserved"):
+        reg.add_tenant("other", "logreg",
+                       particles=np.zeros((4, 3), np.float32))
+    with pytest.raises(ValueError, match="exactly one of"):
+        reg.add_tenant("t", "logreg")
+    _add_logreg(reg, "t", rng)
+    with pytest.raises(ValueError, match="already registered"):
+        _add_logreg(reg, "t", rng)
+    with pytest.raises(KeyError, match="unknown tenant"):
+        reg.submit("ghost", np.zeros((1, 4), np.float32))
+    with pytest.raises(KeyError, match="unknown tenant"):
+        reg.remove_tenant("ghost")
+    reg.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        _add_logreg(reg, "late", rng)
+
+
+def test_ten_tenants_mixed_shapes_concurrent_zero_churn(rng):
+    """The ISSUE acceptance core: 10+ tenants of mixed model kinds and
+    shapes serve concurrently from one process with ZERO cross-tenant
+    recompile churn (sentry-verified), and every tenant's answers are
+    bitwise those of a standalone engine on the same ensemble."""
+    from dist_svgd_tpu.models.bnn import num_params
+    from tools.jaxlint.sentry import retrace_sentry
+
+    met = MetricsRegistry()
+    reg = _registry(metrics=met, max_batch=32, max_wait_ms=0.2)
+    specs = []
+    for i in range(12):
+        kind = ("logreg", "bnn", "gmm")[i % 3]
+        name = f"{kind}-{i}"
+        if kind == "logreg":
+            k = 3 + (i % 4)
+            parts = rng.normal(size=(12 + i, 1 + k)).astype(np.float32)
+            reg.add_tenant(name, "logreg", particles=parts,
+                           min_bucket=4, max_bucket=8)
+            ref = PredictiveEngine("logreg", parts, min_bucket=4,
+                                   max_bucket=8, registry=MetricsRegistry())
+        elif kind == "bnn":
+            nf = 3 + (i % 2)
+            parts = rng.normal(size=(8, num_params(nf, 8))).astype(
+                np.float32)
+            reg.add_tenant(name, "bnn", particles=parts, n_features=nf,
+                           n_hidden=8, min_bucket=4, max_bucket=8)
+            ref = PredictiveEngine("bnn", parts, n_features=nf, n_hidden=8,
+                                   min_bucket=4, max_bucket=8,
+                                   registry=MetricsRegistry())
+        else:
+            d = 2 + (i % 3)
+            parts = rng.normal(size=(10 + i, d)).astype(np.float32)
+            reg.add_tenant(name, "gmm", particles=parts,
+                           min_bucket=4, max_bucket=8)
+            ref = PredictiveEngine("gmm", parts, min_bucket=4, max_bucket=8,
+                                   registry=MetricsRegistry())
+        x = rng.normal(size=(3, ref.feature_dim)).astype(np.float32)
+        specs.append((name, ref, x))
+    assert len(reg) == 12
+    reg.warm([3])
+    misses = {n: reg.tenant(n).engine.stats()["bucket_misses"]
+              for n, _, _ in specs}
+
+    errors = []
+
+    def hammer(name, x):
+        try:
+            for _ in range(6):
+                reg.predict(name, x, timeout=60)
+        except Exception as e:  # surfaced after join
+            errors.append((name, e))
+
+    with retrace_sentry("12-tenant concurrent window") as sentry:
+        threads = [threading.Thread(target=hammer, args=(n, x))
+                   for n, _, x in specs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert errors == []
+    if sentry.supported:
+        assert sentry.compiles == 0
+    for n, _, _ in specs:
+        assert reg.tenant(n).engine.stats()["bucket_misses"] == misses[n]
+    # served values bitwise-match standalone engines per tenant
+    for n, ref, x in specs:
+        got = reg.predict(n, x)
+        want = ref.predict(x)
+        assert sorted(got) == sorted(want)
+        for key in got:
+            np.testing.assert_array_equal(got[key], want[key])
+    # every serving metric carries the tenant label
+    expo = met.exposition()
+    for n, _, _ in specs:
+        assert f'tenant="{n}"' in expo
+    reg.close()
+
+
+def test_corrupt_newest_checkpoint_isolated_to_its_tenant(tmp_path, rng):
+    """One tenant's half-written newest step must leave every other
+    tenant's hot reload working — the shared-scanner isolation pin."""
+    import os
+
+    roots = {}
+    gens = {}
+    for name in ("alpha", "beta"):
+        root = str(tmp_path / name)
+        mgr = CheckpointManager(root, every=1, backend="npz")
+        parts = rng.normal(size=(12, 5)).astype(np.float32)
+        mgr.save(1, {"particles": parts})
+        roots[name] = (root, mgr)
+        gens[name] = parts
+    reg = _registry()
+    for name, (root, _) in roots.items():
+        reg.add_tenant(name, "logreg", checkpoint=root, watch=True,
+                       min_bucket=4, max_bucket=8)
+    # beta's newest is corrupt; alpha has a clean newer step
+    alpha_new = rng.normal(size=(12, 5)).astype(np.float32)
+    roots["alpha"][1].save(2, {"particles": alpha_new})
+    bad = os.path.join(roots["beta"][0], "step_2")
+    os.makedirs(bad)
+    with open(os.path.join(bad, "junk"), "w") as fh:
+        fh.write("partial write")
+    with pytest.warns(UserWarning, match="skipping unloadable"):
+        swapped = reg.poll_once()
+    assert swapped["alpha"] == 2
+    assert swapped["beta"] is None
+    x = rng.normal(size=(2, 4)).astype(np.float32)
+    # alpha serves the new generation, beta keeps serving the old one
+    ref_a = PredictiveEngine("logreg", alpha_new, min_bucket=4,
+                             max_bucket=8, registry=MetricsRegistry())
+    np.testing.assert_array_equal(reg.predict("alpha", x)["mean"],
+                                  ref_a.predict(x)["mean"])
+    ref_b = PredictiveEngine("logreg", gens["beta"], min_bucket=4,
+                             max_bucket=8, registry=MetricsRegistry())
+    np.testing.assert_array_equal(reg.predict("beta", x)["mean"],
+                                  ref_b.predict(x)["mean"])
+    reg.close()
+
+
+def test_rejected_reload_isolated_to_its_tenant(tmp_path, rng):
+    """A health-rejected generation in one tenant (EnsembleRejected) is
+    absorbed by its reloader; the other tenant still swaps and serves."""
+    from dist_svgd_tpu.telemetry import ReloadPolicy
+
+    roots = {}
+    for name in ("guarded", "plain"):
+        root = str(tmp_path / name)
+        mgr = CheckpointManager(root, every=1, backend="npz")
+        mgr.save(1, {"particles":
+                     rng.normal(size=(32, 5)).astype(np.float32)})
+        roots[name] = (root, mgr)
+    reg = _registry()
+    reg.add_tenant("guarded", "logreg", checkpoint=roots["guarded"][0],
+                   watch=True, min_bucket=4, max_bucket=8,
+                   reload_policy=ReloadPolicy(min_ess_frac=0.05,
+                                              max_points=32))
+    reg.add_tenant("plain", "logreg", checkpoint=roots["plain"][0],
+                   watch=True, min_bucket=4, max_bucket=8)
+    # guarded gets a collapsed (rejectable) step 2; plain a healthy one
+    collapsed = np.tile(rng.normal(size=(1, 5)).astype(np.float32),
+                        (32, 1))
+    roots["guarded"][1].save(2, {"particles": collapsed})
+    plain_new = rng.normal(size=(32, 5)).astype(np.float32)
+    roots["plain"][1].save(2, {"particles": plain_new})
+    swapped = reg.poll_once()
+    assert swapped["plain"] == 2
+    assert swapped["guarded"] is None  # rejected, absorbed
+    st = reg.stats()["tenants"]
+    assert st["guarded"]["reload_rejects"] == 1
+    assert st["guarded"]["loaded_step"] == 2  # seen, not re-judged forever
+    assert st["guarded"]["reloads"] == 0
+    assert st["plain"]["reloads"] == 1
+    assert st["guarded"]["reload_errors"] == 0
+    # both keep serving
+    x = rng.normal(size=(2, 4)).astype(np.float32)
+    assert reg.predict("guarded", x)["mean"].shape == (2,)
+    assert reg.predict("plain", x)["mean"].shape == (2,)
+    reg.close()
+
+
+def test_scanner_error_isolated_and_counted(tmp_path, rng):
+    """A poll that raises for one tenant (missing ensemble key) is counted
+    against that tenant only; other tenants still poll and swap."""
+    root_ok = str(tmp_path / "ok")
+    mgr_ok = CheckpointManager(root_ok, every=1, backend="npz")
+    mgr_ok.save(1, {"particles": rng.normal(size=(8, 5)).astype(np.float32)})
+    root_bad = str(tmp_path / "bad")
+    mgr_bad = CheckpointManager(root_bad, every=1, backend="npz")
+    mgr_bad.save(1, {"particles":
+                     rng.normal(size=(8, 5)).astype(np.float32)})
+    reg = _registry()
+    reg.add_tenant("ok", "logreg", checkpoint=root_ok, watch=True,
+                   min_bucket=4, max_bucket=8)
+    reg.add_tenant("bad", "logreg", checkpoint=root_bad, watch=True,
+                   min_bucket=4, max_bucket=8)
+    mgr_ok.save(2, {"particles": rng.normal(size=(8, 5)).astype(np.float32)})
+    mgr_bad.save(2, {"wrong_key": np.zeros((8, 5), np.float32)})
+    swapped = reg.poll_once()
+    assert swapped == {"ok": 2, "bad": None}
+    st = reg.stats()["tenants"]
+    assert st["bad"]["reload_errors"] == 1
+    assert st["ok"]["reload_errors"] == 0
+    assert reg.metrics.counter("svgd_registry_reload_errors_total").value(
+        tenant="bad") == 1
+    reg.close()
+
+
+def test_add_remove_under_load_drains_cleanly(rng):
+    """Tenants come and go while traffic flows: a removed tenant's queued
+    work flushes (drain=True), in-flight work resolves, other tenants
+    never error, and post-removal submits fail cleanly."""
+    reg = _registry(max_batch=16, max_wait_ms=0.2)
+    _add_logreg(reg, "stay", rng)
+    _add_logreg(reg, "go", rng)
+    x = rng.normal(size=(2, 4)).astype(np.float32)
+    reg.warm([2])
+    stop = threading.Event()
+    errors = []
+
+    def stay_traffic():
+        while not stop.is_set():
+            try:
+                reg.predict("stay", x, timeout=30)
+            except Exception as e:
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=stay_traffic)
+    t.start()
+    futs = [reg.submit("go", x) for _ in range(20)]
+    reg.remove_tenant("go", drain=True, timeout=30)
+    # drained: every pre-removal future resolves with real results
+    for fut in futs:
+        assert fut.result(timeout=30)["mean"].shape == (2,)
+    assert "go" not in reg
+    with pytest.raises(KeyError, match="unknown tenant"):
+        reg.submit("go", x)
+    # a NEW tenant joins under the same load
+    _, parts = _add_logreg(reg, "late", rng)
+    ref = PredictiveEngine("logreg", parts, min_bucket=4, max_bucket=16,
+                           registry=MetricsRegistry())
+    np.testing.assert_array_equal(reg.predict("late", x)["mean"],
+                                  ref.predict(x)["mean"])
+    stop.set()
+    t.join(timeout=30)
+    assert errors == []
+    assert reg.tenant_names() == ["late", "stay"]
+    reg.close()
+
+
+def test_tenant_pending_rows_covers_collected_batches(rng):
+    """The drain condition counts collected-but-unresolved rows, not just
+    queued ones: a tenant's queue hitting zero while its last batch is
+    inside dispatch must keep the tenant routable."""
+    import threading as _threading
+
+    release = _threading.Event()
+    entered = _threading.Event()
+
+    def slow_dispatch(x, tenant):
+        entered.set()
+        release.wait(10)
+        return {"v": np.zeros(x.shape[0], np.float32)}
+
+    bat = MicroBatcher(slow_dispatch, max_batch=8, max_wait_ms=0.0,
+                       registry=MetricsRegistry())
+    fut = bat.submit(np.zeros((4, 3), np.float32), tenant="t")
+    assert entered.wait(10)
+    # the batch was collected (queued -> 0) but is still in flight
+    assert bat.tenant_queued_rows("t") == 0
+    assert bat.tenant_pending_rows("t") == 4
+    release.set()
+    assert fut.result(timeout=10)["v"].shape == (4,)
+    assert bat.tenant_pending_rows("t") == 0
+    bat.close()
+
+
+def test_remove_without_drain_cancels_queued(rng):
+    from concurrent.futures import CancelledError
+
+    reg = _registry(max_batch=8, batcher_autostart=False)
+    _add_logreg(reg, "doomed", rng, min_bucket=8, max_bucket=8)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    futs = [reg.submit("doomed", x) for _ in range(3)]
+    reg.remove_tenant("doomed", drain=False)
+    for fut in futs:
+        assert isinstance(fut.exception(timeout=1), CancelledError)
+    assert reg.kernel_cache.stats()["size"] == 0
+    reg.batcher.start()
+    reg.close()
+
+
+def test_set_quota_live(rng):
+    reg = _registry(batcher_autostart=False, max_batch=8,
+                    max_queue_rows=16)
+    _add_logreg(reg, "t", rng, min_bucket=8, max_bucket=8)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    reg.submit("t", x)
+    reg.submit("t", x)  # queue now full (16 rows), no quota -> no shed
+    with pytest.raises(Overloaded, match="queue full \\("):
+        reg.submit("t", x)
+    reg.set_quota("t", 8)
+    with pytest.raises(Overloaded, match="over its inflight-rows quota"):
+        reg.submit("t", x)
+    with pytest.raises(KeyError):
+        reg.set_quota("ghost", 1)
+    reg.batcher.start()
+    reg.close()
+
+
+# --------------------------------------------------------------------- #
+# HTTP front end over a registry
+
+
+def _post(url, body, timeout=10):
+    req = urllib.request.Request(
+        url + "/predict", json.dumps(body).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        return 200, json.loads(urllib.request.urlopen(
+            req, timeout=timeout).read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, path, timeout=10):
+    try:
+        return 200, json.loads(urllib.request.urlopen(
+            url + path, timeout=timeout).read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_server_routes_tenants(rng):
+    met = MetricsRegistry()
+    reg = _registry(metrics=met)
+    _, parts_a = _add_logreg(reg, "a", rng)
+    _add_logreg(reg, "b", rng)
+    reg.warm([1])
+    with PredictionServer(reg, port=0) as srv:
+        url = srv.url
+        code, body = _post(url, {"tenant": "a", "inputs": [[0.1] * 4]})
+        assert code == 200 and body["tenant"] == "a"
+        ref = PredictiveEngine("logreg", parts_a, min_bucket=4,
+                               max_bucket=16, registry=MetricsRegistry())
+        want = ref.predict(np.asarray([[0.1] * 4], np.float32))["mean"][0]
+        assert body["outputs"]["mean"][0] == pytest.approx(want, abs=0)
+        # unknown tenant -> 404; missing tenant with 2 hosted -> 400
+        code, body = _post(url, {"tenant": "ghost", "inputs": [[0.1] * 4]})
+        assert code == 404 and "unknown tenant" in body["error"]
+        code, body = _post(url, {"inputs": [[0.1] * 4]})
+        assert code == 400 and "tenant" in body["error"]
+        # /tenants listing
+        code, body = _get(url, "/tenants")
+        assert code == 200 and sorted(body["tenants"]) == ["a", "b"]
+        assert body["tenants"]["a"]["model"] == "logreg"
+        # /healthz aggregate + per-tenant detail
+        code, body = _get(url, "/healthz")
+        assert code == 200 and sorted(body["tenants"]) == ["a", "b"]
+        code, body = _get(url, "/healthz/a")
+        assert code == 200 and body["tenant"] == "a"
+        assert body["bucket_cache_size"] >= 1
+        code, _ = _get(url, "/healthz/ghost")
+        assert code == 404
+        # tenant-labelled http + serving series on /metrics
+        text = urllib.request.urlopen(url + "/metrics",
+                                      timeout=10).read().decode()
+        assert 'svgd_http_requests_total{route="/predict",status="200",' \
+               'tenant="a"}' in text
+        assert 'tenant="a"' in text and 'tenant="b"' in text
+
+
+def test_server_single_tenant_default_and_guard(rng):
+    reg = _registry()
+    _add_logreg(reg, "only", rng)
+    with PredictionServer(reg, port=0) as srv:
+        # exactly one tenant: the tenant field may be omitted
+        code, body = _post(srv.url, {"inputs": [[0.1] * 4]})
+        assert code == 200 and body["tenant"] == "only"
+    # single-tenant (engine) servers refuse the tenant field loudly
+    eng = PredictiveEngine(
+        "logreg", rng.normal(size=(8, 5)).astype(np.float32),
+        min_bucket=4, max_bucket=16, registry=MetricsRegistry())
+    with PredictionServer(eng, port=0,
+                          registry=MetricsRegistry()) as srv:
+        code, body = _post(srv.url, {"tenant": "x", "inputs": [[0.1] * 4]})
+        assert code == 400 and "single-tenant" in body["error"]
+
+
+# --------------------------------------------------------------------- #
+# serve_multitenant bench row
+
+
+def test_multitenant_bench_row_schema():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import serve_bench
+
+    row = serve_bench.run_multitenant_bench(
+        tenants=3, clients=4, requests=48, rows=(1, 2), max_batch=32,
+        max_wait_ms=0.5)
+    assert row["metric"] == "serve_multitenant"
+    assert row["tenants"] == 3
+    assert row["completed"] == 48
+    assert row["value"] > 0
+    assert sorted(row["per_tenant"]) == ["bnn-1", "gmm-2", "logreg-0"]
+    for pt in row["per_tenant"].values():
+        assert {"model", "rps", "p50_ms", "p99_ms", "hist_p99_ms",
+                "requests"} <= set(pt)
+        assert pt["requests"] == 16
+    assert 0 < row["tenant_fairness"] <= 1.0
+    # the steady-state contract and both machinery probes
+    assert row["recompiles"] == 0
+    assert row["sentry_compiles"] in (0, None)
+    assert row["evictions"] >= 1
+    assert row["eviction_probe"]["evictions_after"] > \
+        row["eviction_probe"]["evictions_before"]
+    assert row["quota_sheds"] >= 1
+    assert row["quota_probe"]["polite_served"] is True
+    assert row["p99_worst_tenant_ms"] >= row["p50_ms"]

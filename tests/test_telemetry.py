@@ -176,6 +176,70 @@ def test_registry_thread_safety_exact_counts():
 
 
 # --------------------------------------------------------------------- #
+# label-cardinality guard (round 14): per-tenant labels must not become
+# an unbounded series leak
+
+
+def test_label_cardinality_bound_pins_and_rolls_up():
+    import warnings
+
+    reg = MetricsRegistry(max_label_sets=3)
+    c = reg.counter("t_card_total", "bounded")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for i in range(8):
+            c.inc(tenant=f"t{i}")
+    # the first three sets are admitted; the five overflow increments all
+    # land in ONE reserved rollup series
+    for i in range(3):
+        assert c.value(tenant=f"t{i}") == 1
+    assert c.value(tenant="other") == 5
+    assert c.value(tenant="t5") == 0  # never admitted as its own series
+    # one-time warning per metric, not per overflowing write
+    card_warns = [w for w in caught
+                  if issubclass(w.category, RuntimeWarning)
+                  and "max_label_sets" in str(w.message)]
+    assert len(card_warns) == 1
+    # admitted series keep updating after the bound is hit
+    c.inc(tenant="t0")
+    assert c.value(tenant="t0") == 2
+
+
+def test_label_cardinality_histogram_and_per_metric_override():
+    reg = MetricsRegistry()  # generous registry default...
+    h = reg.histogram("t_card_seconds", "bounded", max_label_sets=2)
+    with pytest.warns(RuntimeWarning, match="max_label_sets"):
+        for i in range(4):
+            h.observe(0.001 * (i + 1), tenant=f"t{i}")
+    assert h.summary(tenant="t0")["count"] == 1
+    # t2 and t3 aggregated into the rollup
+    assert h.summary(tenant="other")["count"] == 2
+    # default-bound metrics on the same registry are unaffected
+    c = reg.counter("t_card_free_total")
+    for i in range(10):
+        c.inc(tenant=f"t{i}")
+    assert c.value(tenant="other") == 0
+
+
+def test_label_cardinality_rollup_exposition():
+    reg = MetricsRegistry(max_label_sets=1)
+    c = reg.counter("t_card_expo_total", "rollup exposition")
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        c.inc(tenant="real")
+        c.inc(2, tenant="leaky-1")
+        c.inc(3, tenant="leaky-2")
+    text = reg.exposition()
+    assert 't_card_expo_total{tenant="real"} 1' in text
+    # the reserved rollup series is a first-class Prometheus series with
+    # the SAME label name and the reserved value
+    assert 't_card_expo_total{tenant="other"} 5' in text
+    assert "leaky" not in text
+
+
+# --------------------------------------------------------------------- #
 # span tracer
 
 

@@ -45,6 +45,18 @@ A/B timing protocol those notes derived:
   window unchanged, and the row reports ``vs_single_device`` (the ISSUE-7
   ≥4× acceptance ratio) alongside per-lane fairness counts.
 
+- **multi-tenant registry rows (round 14)** — ``serve_multitenant``
+  (``serve_bench.run_multitenant_bench``: 10 heterogeneous tenants —
+  mixed logreg/BNN/GMM shapes — behind ONE ``ModelRegistry``, round-robin
+  closed-loop load) gates its total rps and worst-tenant p99 against
+  their own median+MAD windows, FAILs unconditionally on ANY cross-tenant
+  steady-state recompile in the timed window (bucket misses or sentry
+  compiles — tenants must not churn each other's kernels), and FAILs
+  when either protective-machinery probe comes back empty (the LRU
+  eviction probe must observe ≥ 1 eviction, the quota probe ≥ 1
+  priority shed) — a bench that cannot exercise its own safety rails is
+  broken, not lucky.  ``tenant_fairness`` is reported for the record.
+
 - **elastic-capacity rows (round 13)** — ``elastic_resume``
   (``tools/elastic_drill.py``: device-loss → reshard-to-smaller-mesh →
   resume → serve) is gated on correctness unconditionally (resharded resume
@@ -105,6 +117,7 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
               # batcher's wait window as much as the chip — wider band
               "serve_throughput": 2.0, "serve_latency_p99": 2.0,
               "serve_sharded": 2.0, "serve_sharded_p99": 2.0,
+              "serve_multitenant": 2.0, "serve_multitenant_p99": 2.0,
               # the elastic walls are dominated by host checkpoint I/O and
               # one-off XLA compiles — as scheduling-noisy as the serve rows
               "elastic_reshard_wall_s": 2.0, "elastic_recovery_wall_s": 2.0}
@@ -134,6 +147,14 @@ SERVE_BENCH_KW = dict(model="logreg", n_particles=10_000, n_features=54,
 #: the ensemble particle-sharded across every device on the host and the
 #: batcher running multiple dispatch lanes over the shared engine.
 SERVE_SHARDED_LANES = 4
+
+#: serve_multitenant row config (round 14): 10 heterogeneous tenants
+#: (mixed logreg/BNN/GMM shapes) behind one registry, the same client /
+#: request-size load shape as serve_throughput split round-robin across
+#: tenants.  The LRU bound defaults to exactly the working set inside
+#: run_multitenant_bench, so the eviction probe is deterministic.
+MULTITENANT_KW = dict(tenants=10, clients=16, requests=1500,
+                      rows=(1, 4, 16), max_batch=256, max_wait_ms=2.0)
 
 #: Band widening factor: a row's effective shortfall tolerance is
 #: ``max(tol, MAD_SCALE · MAD/median)`` over its incumbent window.  3×MAD ≈
@@ -603,6 +624,82 @@ def main():
                 failures += 1
             results[sharded_lat_key] = sharded_lat
         print(json.dumps(row), flush=True)
+
+    # multi-tenant registry rows (round 14): 10 heterogeneous tenants
+    # behind one ModelRegistry — cross-tenant recompile churn is an
+    # unconditional FAIL (summed over every round, like the serve rows),
+    # and so is a protective-machinery probe that observed nothing (the
+    # LRU eviction and quota-priority-shed drills are deterministic by
+    # construction; zero means the rail itself broke)
+    mt_key = "serve_multitenant"
+    mt_best = None
+    mt_recompiles = 0
+    mt_sentry_compiles = 0
+    mt_sentry_supported = True
+    for _ in range(args.rounds):
+        mrow = serve_bench.run_multitenant_bench(**MULTITENANT_KW)
+        mt_recompiles += mrow["recompiles"]
+        sc = mrow.get("sentry_compiles")
+        if sc is None:
+            mt_sentry_supported = False
+        else:
+            mt_sentry_compiles += sc
+        if mt_best is None or mrow["value"] > mt_best["value"]:
+            mt_best = mrow
+    row = {"bench": mt_key, "value": mt_best["value"],
+           "unit": "requests/sec",
+           "tenants": mt_best["tenants"],
+           "tenant_fairness": mt_best["tenant_fairness"],
+           "p99_worst_tenant_ms": mt_best["p99_worst_tenant_ms"],
+           "evictions": mt_best["evictions"],
+           "quota_sheds": mt_best["quota_sheds"],
+           "recompiles": mt_recompiles,
+           "sentry_compiles": (mt_sentry_compiles if mt_sentry_supported
+                               else None)}
+    if mt_recompiles or mt_sentry_compiles:
+        # cross-tenant steady-state recompile churn in ANY round's timed
+        # window: the multi-tenant contract broke regardless of throughput
+        row["status"] = "FAIL"
+        failures += 1
+    elif mt_best["evictions"] < 1 or mt_best["quota_sheds"] < 1:
+        row["status"] = "FAIL"
+        row["error"] = ("protective machinery unobserved: eviction probe "
+                        f"saw {mt_best['evictions']} evictions, quota "
+                        f"probe {mt_best['quota_sheds']} priority sheds")
+        failures += 1
+    else:
+        tol = min(args.tol * TOL_FACTOR.get(mt_key, 1.0), 0.9)
+        status, info = judge_row(
+            mt_best["value"], incumbent_history(incumbents, mt_key),
+            tol, True,
+        )
+        row.update(info)
+        row["status"] = status
+        if status == "FAIL":
+            failures += 1
+        results[mt_key] = mt_best["value"]
+    print(json.dumps(row), flush=True)
+
+    mt_lat_key = "serve_multitenant_p99"
+    mt_lat = mt_best.get("p99_worst_tenant_ms")
+    row = {"bench": mt_lat_key, "value": mt_lat,
+           "unit": "ms (worst tenant)"}
+    if not mt_lat:
+        row["status"] = "FAIL"
+        row["error"] = ("empty multi-tenant latency distribution: the "
+                        "serve_multitenant row carried no per-tenant p99")
+        failures += 1
+    else:
+        tol = min(args.tol * TOL_FACTOR.get(mt_lat_key, 1.0), 0.9)
+        status, info = judge_row(
+            mt_lat, incumbent_history(incumbents, mt_lat_key), tol, False,
+        )
+        row.update(info)
+        row["status"] = status
+        if status == "FAIL":
+            failures += 1
+        results[mt_lat_key] = mt_lat
+    print(json.dumps(row), flush=True)
 
     # telemetry-overhead gate (round 10): tracer-off vs tracer-on A/B on
     # the serve bench (interleaved rounds, best-of each arm) — a fixed

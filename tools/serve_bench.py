@@ -35,6 +35,21 @@ batcher worker lanes over the shared engine (meaningful with or without a
 mesh); ``--dtype bfloat16`` serves the low-precision kernels and stamps the
 same-session ``dtype_speedup`` vs an f32 reference loop.
 
+Multi-tenant registry (round 14): ``--tenants N`` hosts N heterogeneous
+tenants (mixed logreg/BNN/GMM shapes, cycled) behind ONE
+``serving.registry.ModelRegistry`` and emits the ``serve_multitenant`` row:
+per-tenant rps/p50/p99 (read back from the tenant-labelled telemetry
+histograms — the same series a Prometheus scrape shows), ``tenant_fairness``
+(min over max per-tenant completion rate), sentry-verified ZERO cross-tenant
+steady-state recompiles in the timed window, plus two deterministic
+off-window drills of the protective machinery: an **eviction probe** (a cold
+tenant added past the LRU bucket bound must evict exactly the
+least-recently-used bucket — ``evictions`` ≥ 1) and a **quota probe** (a hog
+tenant over its inflight-rows quota must shed before a polite tenant when
+the bounded queue fills — ``quota_sheds`` ≥ 1).  ``perf_regress.py`` FAILs
+the row on any in-window recompile and on either probe not observing its
+event.
+
 Output: one JSON row, e.g.::
 
     {"metric": "serve_throughput", "value": 1234.5, "unit": "requests/sec",
@@ -507,6 +522,240 @@ def measure_telemetry_overhead(rounds=3, **kw):
     }
 
 
+#: Mixed-shape tenant cycle for --tenants N: model kind, ensemble size, and
+#: feature width all vary so no two neighbouring tenants share an XLA
+#: program (the cross-tenant-churn test is only honest on heterogeneous
+#: shapes).
+def _tenant_specs(n_tenants):
+    from dist_svgd_tpu.models.bnn import num_params
+
+    specs = []
+    for i in range(n_tenants):
+        kind = ("logreg", "bnn", "gmm")[i % 3]
+        if kind == "logreg":
+            nf = (54, 24, 96)[(i // 3) % 3]
+            specs.append(dict(name=f"logreg-{i}", model="logreg",
+                              n_particles=2048 + 512 * ((i // 3) % 3),
+                              d=1 + nf, feature_dim=nf))
+        elif kind == "bnn":
+            nf = (8, 16)[(i // 3) % 2]
+            specs.append(dict(name=f"bnn-{i}", model="bnn",
+                              n_particles=192 + 64 * ((i // 3) % 2),
+                              d=num_params(nf), feature_dim=nf,
+                              engine_kw=dict(n_features=nf)))
+        else:
+            dim = (8, 16, 32)[(i // 3) % 3]
+            specs.append(dict(name=f"gmm-{i}", model="gmm",
+                              n_particles=1024 + 256 * ((i // 3) % 3),
+                              d=dim, feature_dim=dim))
+    return specs
+
+
+def _quota_probe(seed=3):
+    """Deterministic drill of the quota shed-priority path on a paused
+    registry batcher: a hog tenant fills the bounded queue past its
+    inflight-rows quota, then a polite tenant's arrival must shed the
+    hog's newest queued request (not the polite one).  Untimed and
+    isolated (own metrics registry) — the machinery check the
+    ``serve_multitenant`` row records as ``quota_sheds``."""
+    import numpy as np
+
+    from dist_svgd_tpu import telemetry
+    from dist_svgd_tpu.serving import ModelRegistry
+
+    rng = np.random.default_rng(seed)
+    probe = ModelRegistry(
+        metrics=telemetry.MetricsRegistry(), max_total_buckets=4,
+        max_batch=8, max_queue_rows=32, batcher_autostart=False,
+    )
+    nf = 4
+    parts = rng.normal(size=(32, 1 + nf)).astype(np.float32)
+    probe.add_tenant("hog", "logreg", particles=parts, min_bucket=8,
+                     max_bucket=8, quota_rows=8)
+    probe.add_tenant("polite", "logreg", particles=parts.copy(),
+                     min_bucket=8, max_bucket=8)
+    x = rng.normal(size=(8, nf)).astype(np.float32)
+    hog_futs = [probe.batcher.submit(x, tenant="hog") for _ in range(4)]
+    polite_fut = probe.batcher.submit(x, tenant="polite")
+    stats = probe.batcher.stats()
+    probe.batcher.start()
+    polite_ok = polite_fut.result(timeout=30) is not None
+    hog_shed = sum(1 for f in hog_futs
+                   if f.done() and f.exception() is not None)
+    probe.close(drain=True)
+    return {
+        "quota_sheds": int(sum(stats["quota_sheds"].values())),
+        "per_tenant": stats["quota_sheds"],
+        "hog_requests_shed": hog_shed,
+        "polite_served": polite_ok,
+    }
+
+
+def run_multitenant_bench(tenants=10, clients=16, requests=2000,
+                          rows=(1, 4, 16), max_batch=256, max_wait_ms=2.0,
+                          max_queue_rows=8192, lanes=1, seed=0,
+                          max_total_buckets=None):
+    """Measure the multi-tenant registry and return the
+    ``serve_multitenant`` JSON row (importable — perf_regress uses this).
+
+    ``max_total_buckets`` defaults to EXACTLY the working set (tenants ×
+    buckets the request sizes touch): the timed window then runs with a
+    full-but-not-overflowing LRU — zero steady-state recompiles — and the
+    post-window eviction probe (one cold tenant added past the bound)
+    deterministically observes the first eviction.
+    """
+    import jax
+    import numpy as np
+
+    from dist_svgd_tpu import telemetry
+    from dist_svgd_tpu.serving import ModelRegistry
+    from dist_svgd_tpu.serving.engine import bucket_for
+    from tools.jaxlint.sentry import retrace_sentry
+
+    rows = tuple(rows)
+    min_bucket = 8
+    working_buckets = len({bucket_for(r, min_bucket) for r in rows})
+    cap = (max_total_buckets if max_total_buckets is not None
+           else tenants * working_buckets)
+    metrics = telemetry.MetricsRegistry()
+    rng = np.random.default_rng(seed)
+    reg = ModelRegistry(
+        metrics=metrics, max_total_buckets=cap, max_batch=max_batch,
+        lanes=lanes, max_wait_ms=max_wait_ms, max_queue_rows=max_queue_rows,
+    )
+    specs = _tenant_specs(tenants)
+    pools = {}
+    for spec in specs:
+        parts = rng.normal(size=(spec["n_particles"], spec["d"]))
+        reg.add_tenant(
+            spec["name"], spec["model"],
+            particles=parts.astype(np.float32),
+            min_bucket=min_bucket, max_bucket=max_batch,
+            **spec.get("engine_kw", {}),
+        )
+        pools[spec["name"]] = _request_pool(
+            spec["feature_dim"], list(rows), pool=64,
+            seed=seed + 1 + len(pools))
+    names = [s["name"] for s in specs]
+    reg.warm(rows)  # steady state: every reachable bucket pre-traced
+    misses_before = {
+        n: reg.tenant(n).engine.stats()["bucket_misses"] for n in names}
+
+    # closed loop, tenants round-robin: every tenant sees the same offered
+    # load, so per-tenant completion rates measure fairness, not the
+    # generator's bias
+    lock = threading.Lock()
+    issued = [0]
+    lats = {n: [] for n in names}
+    shed = [0]
+
+    from dist_svgd_tpu.serving.batcher import Overloaded
+
+    def worker():
+        while True:
+            with lock:
+                if issued[0] >= requests:
+                    return
+                i = issued[0]
+                issued[0] += 1
+            name = names[i % len(names)]
+            pool = pools[name]
+            t0 = time.perf_counter()
+            try:
+                reg.submit(name, pool[i % len(pool)]).result(timeout=60)
+            except Overloaded:
+                with lock:
+                    shed[0] += 1
+                continue
+            lat = (time.perf_counter() - t0) * 1e3
+            with lock:
+                lats[name].append(lat)
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    with retrace_sentry("serve_multitenant timed window") as sentry:
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+    recompiles = sum(
+        reg.tenant(n).engine.stats()["bucket_misses"] - misses_before[n]
+        for n in names)
+    lat_hist = metrics.histogram("svgd_serve_request_latency_seconds")
+    per_tenant = {}
+    tenant_rps = {}
+    for spec in specs:
+        n = spec["name"]
+        tl = sorted(lats[n])
+        hist = lat_hist.summary(scale=1e3, tenant=n)
+        rps = len(tl) / wall if wall > 0 else 0.0
+        tenant_rps[n] = rps
+        per_tenant[n] = {
+            "model": spec["model"],
+            "n_particles": spec["n_particles"],
+            "feature_dim": spec["feature_dim"],
+            "requests": len(tl),
+            "rps": round(rps, 1),
+            "p50_ms": round(_percentile(tl, 0.50), 3),
+            "p99_ms": round(_percentile(tl, 0.99), 3),
+            "hist_p99_ms": hist["p99"],
+        }
+    all_lats = sorted(v for ls in lats.values() for v in ls)
+    completed = len(all_lats)
+    fairness = (min(tenant_rps.values()) / max(tenant_rps.values())
+                if tenant_rps and max(tenant_rps.values()) > 0 else 0.0)
+
+    # --- eviction probe (off-window): one cold tenant past the LRU bound
+    # must evict exactly one least-recently-used bucket; the window above
+    # already proved the hot working set never recompiled
+    evictions_before = reg.kernel_cache.stats()["evictions"]
+    probe_parts = rng.normal(size=(64, 9)).astype(np.float32)
+    reg.add_tenant("evict-probe", "logreg", particles=probe_parts,
+                   min_bucket=min_bucket, max_bucket=max_batch)
+    reg.predict("evict-probe", rng.normal(size=(1, 8)).astype(np.float32))
+    cache_stats = reg.kernel_cache.stats()
+    eviction_probe = {
+        "evictions_before": evictions_before,
+        "evictions_after": cache_stats["evictions"],
+        "cache_size": cache_stats["size"],
+    }
+    reg.close(drain=True)
+
+    quota_probe = _quota_probe(seed=seed + 7)
+
+    return {
+        "metric": "serve_multitenant",
+        "unit": "requests/sec",
+        "platform": jax.devices()[0].platform,
+        "tenants": tenants,
+        "clients": clients,
+        "requests": requests,
+        "rows_per_request": list(rows),
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        "lanes": lanes,
+        "value": round(completed / wall, 1) if wall > 0 else 0.0,
+        "wall_s": round(wall, 3),
+        "completed": completed,
+        "shed": shed[0],
+        "p50_ms": round(_percentile(all_lats, 0.50), 3),
+        "p99_ms": round(_percentile(all_lats, 0.99), 3),
+        "p99_worst_tenant_ms": max(
+            (pt["p99_ms"] for pt in per_tenant.values()), default=0.0),
+        "tenant_fairness": round(fairness, 4),
+        "per_tenant": per_tenant,
+        "recompiles": recompiles,
+        "sentry_compiles": sentry.compiles if sentry.supported else None,
+        "kernel_cache": cache_stats,
+        "evictions": cache_stats["evictions"],
+        "eviction_probe": eviction_probe,
+        "quota_sheds": quota_probe["quota_sheds"],
+        "quota_probe": quota_probe,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", choices=("logreg", "bnn", "gmm"), default="logreg")
@@ -522,6 +771,16 @@ def main():
                          "CPU host the devices are emulated "
                          "(--xla_force_host_platform_device_count, the "
                          "MULTICHIP bench pattern)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="host this many mixed-shape tenants behind one "
+                         "ModelRegistry and emit the serve_multitenant "
+                         "row instead (ignores --model/--n-particles/"
+                         "--devices/--dtype)")
+    ap.add_argument("--max-total-buckets", type=int, default=None,
+                    help="multi-tenant LRU bound on compiled kernel "
+                         "buckets across tenants (default: exactly the "
+                         "working set, so the eviction probe evicts "
+                         "deterministically)")
     ap.add_argument("--lanes", type=int, default=1,
                     help="batcher dispatch worker lanes over the shared "
                          "engine")
@@ -583,7 +842,15 @@ def main():
         checkpoint=args.checkpoint, seed=args.seed,
         devices=args.devices, lanes=args.lanes, dtype=args.dtype,
     )
-    if args.ab_telemetry:
+    if args.tenants:
+        out = run_multitenant_bench(
+            tenants=args.tenants, clients=args.clients,
+            requests=args.requests, rows=rows, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms, max_queue_rows=args.max_queue_rows,
+            lanes=args.lanes, seed=args.seed,
+            max_total_buckets=args.max_total_buckets,
+        )
+    elif args.ab_telemetry:
         out = measure_telemetry_overhead(rounds=args.ab_telemetry, **kw)
     else:
         out = run_bench(url=args.url, trace=args.trace,
