@@ -14,8 +14,13 @@ already has for overload:
   with a rollback + step-size-backoff policy;
 - :mod:`faults` — deterministic fault injection (raise-on-step-k, NaN into
   the carry, simulated preemption, simulated hard kill, artificial slow
-  dispatch, device loss / mesh shrink / mesh grow) so every recovery path
-  runs in tier-1 on CPU;
+  dispatch, device loss / mesh shrink / mesh grow, plus the round-15
+  process-level fleet faults: replica kill / hang / slowdown / network
+  partition, consumed by ``serving/fleet.py``'s fake transport) so every
+  recovery path runs in tier-1 on CPU;
+- :mod:`backoff` — the ONE capped-exponential-backoff implementation
+  (jitter optional, RNG injectable) shared by the supervisor's
+  :class:`RetryPolicy` and the serving fleet's router;
 - **elastic capacity** — ``RunSupervisor(reshard=ReshardPolicy(factory))``
   survives topology faults by resharding the latest checkpoint onto the
   surviving mesh (``utils/checkpoint.py:reshard_state``) inside the same
@@ -29,16 +34,22 @@ overhead as one BENCH-style JSON row, and
 ``experiments/resilient_covertype.py`` demonstrates kill → resume → serve.
 """
 
+from dist_svgd_tpu.resilience.backoff import Backoff, capped_delay
 from dist_svgd_tpu.resilience.faults import (
     DeviceLossAt,
     FaultPlan,
+    FleetFault,
     HardKillAt,
     InjectNaNAt,
     MeshGrowAt,
     MeshShrinkAt,
+    PartitionAt,
     PreemptAt,
     RaiseAt,
+    ReplicaHangAt,
+    ReplicaKillAt,
     SimulatedHardKill,
+    SlowReplicaAt,
     SlowSegmentAt,
     TopologyFault,
     TransientDispatchError,
@@ -71,4 +82,11 @@ __all__ = [
     "TopologyFault",
     "TransientDispatchError",
     "SimulatedHardKill",
+    "Backoff",
+    "capped_delay",
+    "FleetFault",
+    "ReplicaKillAt",
+    "ReplicaHangAt",
+    "PartitionAt",
+    "SlowReplicaAt",
 ]

@@ -187,6 +187,85 @@ class SlowSegmentAt(Fault):
         ctx.advance_clock(self.seconds)
 
 
+# --------------------------------------------------------------------- #
+# fleet faults (round 15): process-level failures of a serving replica,
+# consumed by serving/fleet.py's injectable FakeTransport rather than the
+# supervisor — the unit of failure is a whole replica process, and the
+# schedule is keyed by the transport's request ordinal (every probe or
+# forward through the fake increments it) so failover tests are
+# deterministic without real sockets, signals, or sleeps.
+
+
+class FleetFault:
+    """One scheduled replica-level fault window: active for transport
+    request ordinals in ``[at, until)`` (``until=None`` → forever, i.e.
+    until a runtime override like ``FakeTransport.restore`` lifts it).
+    Unlike the training faults above these do not "fire once" — a dead
+    process stays dead for every request in the window."""
+
+    kind = "abstract"
+
+    def __init__(self, at: int, replica: str, until: Optional[int] = None):
+        if at < 0:
+            raise ValueError(f"at must be >= 0, got {at}")
+        if until is not None and until <= at:
+            raise ValueError(f"until ({until}) must be > at ({at})")
+        self.at = int(at)
+        self.replica = str(replica)
+        self.until = None if until is None else int(until)
+
+    def active(self, ordinal: int) -> bool:
+        return self.at <= ordinal and (self.until is None
+                                       or ordinal < self.until)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(at={self.at}, "
+                f"replica={self.replica!r}, until={self.until})")
+
+
+class ReplicaKillAt(FleetFault):
+    """The replica process is gone (SIGKILL / OOM / node loss): every
+    connection from the router is refused — probes and forwards alike.
+    ``until=`` models the restart (the process comes back and the router
+    must re-admit it through the half-open circuit)."""
+
+    kind = "kill"
+
+
+class ReplicaHangAt(FleetFault):
+    """The replica process accepts connections but never responds (a
+    wedged GIL, a stuck device call): the router's request times out after
+    its per-try budget.  The fake transport charges the full timeout to
+    the injected clock so hang cost is measured, not waited for."""
+
+    kind = "hang"
+
+
+class PartitionAt(FleetFault):
+    """Network partition: the replica is **alive and healthy** — it keeps
+    serving anyone who can reach it, its own flight recorder records
+    nothing — but the router cannot reach it.  Must trip the same ejection
+    path as a crash (from the router's seat they are indistinguishable)
+    without any replica-side effect; ``until=`` heals the partition."""
+
+    kind = "partition"
+
+
+class SlowReplicaAt(FleetFault):
+    """Degraded replica: every response is delayed by ``seconds`` (GC
+    storms, a noisy neighbor).  The tail-hedging path exists for exactly
+    this shape — the request completes, just slowly."""
+
+    kind = "slow"
+
+    def __init__(self, at: int, replica: str, seconds: float,
+                 until: Optional[int] = None):
+        super().__init__(at, replica, until=until)
+        if seconds < 0:
+            raise ValueError(f"seconds must be >= 0, got {seconds}")
+        self.seconds = float(seconds)
+
+
 class FaultPlan:
     """An ordered schedule of faults, consumed by the supervisor at every
     segment boundary.  ``fire_due`` fires every not-yet-fired fault whose

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dist_svgd_tpu.resilience.backoff import capped_delay
 from dist_svgd_tpu.resilience.faults import (
     FaultPlan,
     TopologyFault,
@@ -92,7 +93,10 @@ class RetryPolicy:
 
     ``backoff_base_s · backoff_factor^(k-1)`` seconds before the k-th
     *consecutive* retry, capped at ``max_backoff_s``; a successful segment
-    resets the consecutive counter but not the total budget."""
+    resets the consecutive counter but not the total budget.  The schedule
+    is :func:`resilience.backoff.capped_delay` — the one shared backoff
+    implementation (the fleet router jitters the same schedule; the
+    supervisor stays jitter-free so recovery tests pin exact delays)."""
 
     def __init__(
         self,
@@ -113,10 +117,8 @@ class RetryPolicy:
 
     def delay_s(self, consecutive_failures: int) -> float:
         """Backoff before retry number ``consecutive_failures`` (1-based)."""
-        d = self.backoff_base_s * self.backoff_factor ** max(
-            consecutive_failures - 1, 0
-        )
-        return min(d, self.max_backoff_s)
+        return capped_delay(consecutive_failures, self.backoff_base_s,
+                            self.backoff_factor, self.max_backoff_s)
 
 
 class ReshardPolicy:

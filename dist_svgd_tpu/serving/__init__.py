@@ -24,6 +24,15 @@ turns a checkpointed ensemble into a low-latency prediction service:
 - :mod:`server`  — a thin stdlib HTTP front end (``/predict``, ``/healthz``,
   ``/metrics``, ``/slo``) with graceful drain and structured per-request
   records;
+- :mod:`fleet`   — the **shared-nothing serving fleet**: a pure-stdlib
+  :class:`FleetRouter` (no jax in the router process) consistent-hashes
+  tenants over N replica servers with bounded-load overflow, health-gates
+  each replica behind a circuit breaker (active ``/healthz``+``/slo``
+  probes, passive request scoring, half-open readmission), and forwards
+  with deadline propagation, idempotency-aware jittered retries,
+  429-backpressure honoring, optional tail hedging, and graceful 503
+  degradation — the unit of failure becomes a whole process and the
+  system keeps serving (``tools/fleet_drill.py`` measures it);
 - :mod:`registry` — :class:`ModelRegistry`: **multi-tenant serving** —
   many heterogeneous posteriors (logreg/BNN/GMM, different shapes, steps,
   dtypes, plans) hosted as named tenants behind ONE process: one shared
@@ -49,6 +58,13 @@ from dist_svgd_tpu.serving.engine import (
     EnsembleRejected,
     PredictiveEngine,
 )
+from dist_svgd_tpu.serving.fleet import (
+    FakeTransport,
+    FleetRouter,
+    HttpTransport,
+    LoopbackReplica,
+    ReplicaSet,
+)
 from dist_svgd_tpu.serving.registry import (
     KernelBucketLRU,
     ModelRegistry,
@@ -66,4 +82,9 @@ __all__ = [
     "Overloaded",
     "PredictionServer",
     "Tenant",
+    "FleetRouter",
+    "ReplicaSet",
+    "HttpTransport",
+    "FakeTransport",
+    "LoopbackReplica",
 ]

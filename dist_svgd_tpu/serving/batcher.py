@@ -87,7 +87,19 @@ _INSTANCE_IDS = itertools.count()
 
 
 class Overloaded(RuntimeError):
-    """Raised by :meth:`MicroBatcher.submit` when the bounded queue is full."""
+    """Raised by :meth:`MicroBatcher.submit` when the bounded queue is full.
+
+    ``retry_after_s`` (round 15) is the batcher's own estimate of when the
+    backlog will have drained enough to admit a retry — derived from the
+    coalescing window and the queue depth at shed time (one ``max_batch``
+    batch drains per ``max_wait_ms`` window at worst, plus one window for
+    the retry itself).  The HTTP layer surfaces it as a 429
+    ``Retry-After`` and the fleet router honors it instead of its generic
+    backoff — the replica knows its queue better than the caller does."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 def _default_wait(cond: threading.Condition, timeout: Optional[float]) -> bool:
@@ -339,7 +351,8 @@ class MicroBatcher:
                         raise Overloaded(
                             f"queue full and tenant {tenant!r} is over its "
                             f"inflight-rows quota ({quota}); retry with "
-                            "backoff"
+                            "backoff",
+                            retry_after_s=self._retry_after_s_locked(),
                         )
                     shed_futures, shed_err = self._shed_over_quota_locked(
                         self._queued_rows + rows - self.max_queue_rows)
@@ -349,7 +362,8 @@ class MicroBatcher:
                         raise Overloaded(
                             f"queue full ({self._queued_rows} rows queued, "
                             f"request of {rows} would exceed max_queue_rows="
-                            f"{self.max_queue_rows}); retry with backoff"
+                            f"{self.max_queue_rows}); retry with backoff",
+                            retry_after_s=self._retry_after_s_locked(),
                         )
                 n_chunks = -(-rows // self.max_batch)
                 req = _Request(n_chunks, self._clock(),
@@ -378,6 +392,15 @@ class MicroBatcher:
                     fut.set_exception(shed_err)
                 except InvalidStateError:
                     pass
+
+    def _retry_after_s_locked(self) -> float:
+        """Estimated seconds until the current backlog admits a retry:
+        ``(1 + ceil(queued_rows / max_batch)) · max_wait_s`` — the queue
+        drains at worst one ``max_batch`` batch per coalescing window, and
+        the retry itself waits one more window.  Floored at 1 ms so a
+        zero-wait batcher still emits a positive hint."""
+        batches = -(-self._queued_rows // self.max_batch)
+        return (1 + batches) * max(self._max_wait_s, 1e-3)
 
     def _quota_for(self, tenant: Optional[str]) -> Optional[int]:
         if tenant is None or not self._quotas:
@@ -433,7 +456,8 @@ class MicroBatcher:
                                 batcher=self.metrics_instance)
         err = Overloaded(
             "shed by quota priority: tenant over its inflight-rows quota "
-            "when the bounded queue filled; retry with backoff"
+            "when the bounded queue filled; retry with backoff",
+            retry_after_s=self._retry_after_s_locked(),
         )
         return [r.future for r in victims], err
 

@@ -117,6 +117,7 @@ class PredictionServer:
         self._lock = threading.Lock()
         self._requests = 0
         self._errors = 0
+        self._draining = False
         self._m_http = self.registry.counter(
             "svgd_http_requests_total", "HTTP requests by route and status")
         self._m_http_latency = self.registry.histogram(
@@ -134,17 +135,17 @@ class PredictionServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
-            # join in-flight handler threads on server_close — the drain
-            # guarantee (ThreadingHTTPServer defaults them to daemons)
-            daemon_threads = False
 
             def log_message(self, fmt, *args):  # stderr chatter off
                 pass
 
-            def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+            def _reply(self, code: int, payload: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -161,14 +162,19 @@ class PredictionServer:
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
                 if path == "/healthz":
-                    self._reply(200, server.health())
+                    doc = server.health()
+                    # a draining server answers 503 so a fleet router stops
+                    # routing here BEFORE the socket disappears
+                    self._reply(503 if doc["status"] == "draining" else 200,
+                                doc)
                 elif path.startswith("/healthz/"):
                     name = path[len("/healthz/"):]
                     detail = server.tenant_health(name)
                     if detail is None:
                         self._reply(404, {"error": f"no tenant {name!r}"})
                     else:
-                        self._reply(200, detail)
+                        self._reply(503 if detail["status"] == "draining"
+                                    else 200, detail)
                 elif path == "/tenants":
                     if server.model_registry is None:
                         self._reply(404, {"error": "single-tenant server: "
@@ -196,12 +202,22 @@ class PredictionServer:
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 t0 = time.perf_counter()
+                # a fleet router propagates its remaining per-request
+                # budget downstream — cap our own future-wait with it so a
+                # doomed request releases its handler thread on time
+                deadline_s = None
+                raw = self.headers.get("X-Fleet-Deadline-S")
+                if raw:
+                    try:
+                        deadline_s = max(float(raw), 1e-3)
+                    except ValueError:
+                        pass
                 with _trace.span("http.predict"):
-                    code, payload, rows, tenant = server._predict(
-                        self._read_body())
+                    code, payload, rows, tenant, extra = server._predict(
+                        self._read_body(), timeout_s=deadline_s)
                 wall = time.perf_counter() - t0
                 payload.setdefault("latency_ms", round(wall * 1e3, 3))
-                self._reply(code, payload)
+                self._reply(code, payload, extra)
                 tl = {} if tenant is None else {"tenant": tenant}
                 server._m_http.inc(route="/predict", status=code, **tl)
                 server._m_http_latency.observe(wall, **tl)
@@ -219,6 +235,11 @@ class PredictionServer:
                 return self.rfile.read(length) if length else b""
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # ThreadingMixIn reads daemon_threads off the SERVER instance (a
+        # class attribute on the handler is a no-op): non-daemon handler
+        # threads are what makes server_close() join in-flight requests —
+        # the drain guarantee shutdown() documents
+        self._httpd.daemon_threads = False
         self._serve_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ #
@@ -233,9 +254,12 @@ class PredictionServer:
         host, port = self.address[:2]
         return f"http://{host}:{port}"
 
-    def _predict(self, body: bytes):
-        """Returns ``(status_code, payload, rows, tenant)``; never raises."""
+    def _predict(self, body: bytes, timeout_s: Optional[float] = None):
+        """Returns ``(status_code, payload, rows, tenant, headers)``;
+        never raises.  ``timeout_s`` (a router-propagated deadline) caps
+        the future wait below the server's own ``request_timeout_s``."""
         from concurrent.futures import CancelledError
+        from concurrent.futures import TimeoutError as FuturesTimeout
 
         tenant = None
         # phase 1 — parse and validate the request (client errors → 400)
@@ -264,9 +288,10 @@ class PredictionServer:
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             with self._lock:
                 self._errors += 1
-            return 400, {"error": str(e)}, 0, tenant
+            return 400, {"error": str(e)}, 0, tenant, None
         # phase 2 — submit and resolve (server-side failures are NOT the
-        # client's fault: 404 unknown tenant, 503 retryable, 500 bugs)
+        # client's fault: 404 unknown tenant, 429 shed with Retry-After,
+        # 503 retryable, 500 bugs)
         try:
             if self.model_registry is not None:
                 try:
@@ -274,47 +299,74 @@ class PredictionServer:
                 except KeyError as e:
                     with self._lock:
                         self._errors += 1
-                    return 404, {"error": str(e)}, 0, tenant
+                    return 404, {"error": str(e)}, 0, tenant, None
             else:
                 future = self.batcher.submit(x)
-            out = future.result(timeout=self._request_timeout_s)
+            wait_s = self._request_timeout_s
+            if timeout_s is not None:
+                wait_s = min(wait_s, timeout_s)
+            out = future.result(timeout=wait_s)
         except Overloaded as e:
+            # a shed is load, not failure: 429 (not 503) so callers — the
+            # fleet router above all — don't burn retries on it, with the
+            # batcher's computed drain estimate as Retry-After
             with self._lock:
                 self._errors += 1
-            return 503, {"error": str(e)}, 0, tenant
+            from dist_svgd_tpu.serving.fleet import format_retry_after
+
+            payload = {"error": str(e)}
+            headers = None
+            ra = getattr(e, "retry_after_s", None)
+            if ra:
+                payload["retry_after_s"] = round(ra, 3)
+                headers = {"Retry-After": format_retry_after(ra)}
+            return 429, payload, 0, tenant, headers
         except (KeyError, CancelledError) as e:
             # the tenant was removed (or the batcher cancelled) while the
             # request was queued: retryable server-side condition, not a
             # malformed request
             with self._lock:
                 self._errors += 1
-            return 503, {"error": f"request dropped: {e}"}, 0, tenant
+            return 503, {"error": f"request dropped: {e}"}, 0, tenant, None
         except ValueError as e:
             # the engine rejected the batch (e.g. feature-width mismatch
             # discovered at dispatch) — the request itself was bad
             with self._lock:
                 self._errors += 1
-            return 400, {"error": str(e)}, 0, tenant
-        except Exception as e:  # dispatch failure / timeout
+            return 400, {"error": str(e)}, 0, tenant, None
+        except FuturesTimeout:
+            # the wait budget (usually a router-propagated deadline) ran
+            # out: the CALLER's condition, not a replica fault — 504, so a
+            # fleet router doesn't score it into ejecting a healthy
+            # replica the way a 500 would
             with self._lock:
                 self._errors += 1
-            return 500, {"error": f"{type(e).__name__}: {e}"}, 0, tenant
+            return 504, {"error": f"deadline exceeded after {wait_s:.3f}s "
+                         "waiting for the batch"}, 0, tenant, None
+        except Exception as e:  # dispatch failure
+            with self._lock:
+                self._errors += 1
+            return 500, {"error": f"{type(e).__name__}: {e}"}, 0, tenant, None
         with self._lock:
             self._requests += 1
         payload = {"outputs": {k: v.tolist() for k, v in out.items()}}
         if tenant is not None:
             payload["tenant"] = tenant
-        return 200, payload, x.shape[0], tenant
+        return 200, payload, x.shape[0], tenant, None
 
     def health(self) -> Dict[str, Any]:
+        with self._lock:
+            draining = self._draining
         if self.model_registry is not None:
             doc = self.model_registry.health()
             doc.update(lanes=self.batcher.lanes,
                        uptime_s=round(time.time() - self._started, 1))
+            if draining:
+                doc["status"] = "draining"
             return doc
         st = self.engine.stats()
         return {
-            "status": "ok",
+            "status": "draining" if draining else "ok",
             "model": st["model"],
             "n_particles": st["n_particles"],
             "feature_dim": st["feature_dim"],
@@ -332,7 +384,10 @@ class PredictionServer:
             stats = self.model_registry.stats()["tenants"][name]
         except KeyError:
             return None
-        return {"status": "ok", "tenant": name, **stats}
+        with self._lock:
+            draining = self._draining
+        return {"status": "draining" if draining else "ok",
+                "tenant": name, **stats}
 
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
@@ -362,10 +417,21 @@ class PredictionServer:
         finally:
             self.shutdown()
 
+    def begin_drain(self) -> None:
+        """Flip ``/healthz`` to 503 ``"draining"`` without closing anything
+        — the drain *signal*, separable from the drain itself so a fleet
+        router (probing health) stops routing here before the socket
+        disappears."""
+        with self._lock:
+            self._draining = True
+
     def shutdown(self) -> None:
-        """Graceful drain: stop accepting, finish in-flight handlers, flush
-        the batcher queue (and, in registry mode, stop the checkpoint
-        scanner and close the registry)."""
+        """Graceful drain: advertise draining on ``/healthz`` FIRST (a
+        router must see the 503 while the socket still answers — ordering
+        pinned by test), then stop accepting, finish in-flight handlers,
+        flush the batcher queue (and, in registry mode, stop the
+        checkpoint scanner and close the registry)."""
+        self.begin_drain()
         self._httpd.shutdown()
         self._httpd.server_close()  # joins non-daemon handler threads
         if self._serve_thread is not None:

@@ -510,6 +510,84 @@ def test_ratio_gauge_and_staleness_objectives():
     json.dumps(doc)  # unbounded burn serialises as null, not Infinity
 
 
+def test_slo_empty_window_reads_no_data_never_ok():
+    """Round-15 edge case the fleet router depends on: a quiet evaluation
+    window (replica idle between probes) must read no_data — which the
+    router classifies as *unknown*, never healthy — and must not breach
+    either."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", buckets=(0.001, 0.01, 0.1))
+    obj = slo_mod.LatencyObjective("p99", "t_lat_seconds", threshold_s=0.1)
+    eng = slo_mod.SloEngine(reg, [obj], clock=lambda: 1.0)
+    for _ in range(20):
+        h.observe(0.005)
+    assert eng.evaluate()["objectives"]["p99"]["status"] == "ok"
+    # the traffic stops: every later window is empty, and stays no_data
+    # forever — NOT a sticky "ok" from the last lucky window
+    for _ in range(3):
+        row = eng.evaluate()["objectives"]["p99"]
+        assert row["status"] == "no_data"
+        assert row["window_count"] == 0
+        assert row["burn_rate"] == 0.0
+
+
+def test_slo_counter_reset_across_replica_restart():
+    """A replica restart resets its counters (and histogram buckets) to
+    zero; the windowed deltas must clamp at 0 and read no_data — never a
+    negative window, never a phantom breach, never a phantom ok."""
+    reg1 = MetricsRegistry()
+    reg1.counter("t_bad_total").inc(10)
+    reg1.counter("t_base_total").inc(100)
+    ratio = slo_mod.RatioObjective("errs", "t_bad_total", "t_base_total",
+                                   max_ratio=0.5)
+    assert ratio.evaluate(reg1, 1.0)["status"] == "ok"
+    # the restart: fresh process, same metric names, lower raw values
+    reg2 = MetricsRegistry()
+    reg2.counter("t_bad_total").inc(2)
+    reg2.counter("t_base_total").inc(3)
+    row = ratio.evaluate(reg2, 2.0)
+    assert row["status"] == "no_data"
+    assert row["window_den"] == 0
+    # the next window on the restarted replica judges fresh deltas again
+    reg2.counter("t_bad_total").inc(1)
+    reg2.counter("t_base_total").inc(10)
+    row = ratio.evaluate(reg2, 3.0)
+    assert row["status"] == "ok" and row["window_den"] == 10
+    # same discipline for histogram bucket counts
+    reg1.histogram("t_lat_seconds", buckets=(0.01, 0.1))
+    lat = slo_mod.LatencyObjective("p99", "t_lat_seconds", threshold_s=0.1)
+    for _ in range(50):
+        reg1._metrics["t_lat_seconds"].observe(0.005)
+    assert lat.evaluate(reg1, 1.0)["status"] == "ok"
+    reg3 = MetricsRegistry()
+    reg3.histogram("t_lat_seconds", buckets=(0.01, 0.1)).observe(0.005)
+    row = lat.evaluate(reg3, 2.0)
+    assert row["status"] == "no_data"  # 1 < 50: clamped to an empty window
+
+
+def test_slo_staleness_reads_unknown_never_healthy():
+    """Staleness, end to end: a never-written or stale freshness gauge is
+    no_data/breach at the SLO layer, and the fleet router's classifier
+    maps anything that is not a fresh verdict to 'unknown' — a stale
+    'ok' can never keep a replica admitted on old good news."""
+    from dist_svgd_tpu.serving.fleet import classify_slo
+
+    reg = MetricsRegistry()
+    obj = slo_mod.StalenessObjective("fresh", "t_ts", max_age_s=10.0)
+    eng = slo_mod.SloEngine(reg, [obj], clock=lambda: 100.0)
+    row = eng.evaluate()["objectives"]["fresh"]
+    assert row["status"] == "no_data"   # never written != healthy
+    assert row["status"] != "ok"
+    # the router-side mapping of every non-verdict shape
+    assert classify_slo({"status": "no_data"}) == "unknown"
+    assert classify_slo(None) == "unknown"
+    assert classify_slo({"status": "ok", "ts": 50.0},
+                        now_s=100.0, max_age_s=10.0) == "unknown"
+    # only a FRESH ok reads healthy
+    assert classify_slo({"status": "ok", "ts": 95.0},
+                        now_s=100.0, max_age_s=10.0) == "healthy"
+
+
 def test_default_slo_sets_and_duplicate_names():
     reg = MetricsRegistry()
     serving = slo_mod.default_serving_slos(reg, p99_ms=50.0)

@@ -1,0 +1,690 @@
+"""Serving fleet (dist_svgd_tpu/serving/fleet.py): consistent-hash
+routing with bounded load, the replica circuit breaker (active probes,
+passive scoring, SLO burn, half-open readmission), the forwarding
+robustness kit (deadline propagation, idempotency-aware retries, 429
+backpressure, tail hedging, graceful 503), and the process-level fault
+fakes — every failover path on CPU, clock-injectable, no real sockets
+except the two HTTP-front-door tests.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dist_svgd_tpu.resilience import (
+    Backoff,
+    PartitionAt,
+    ReplicaHangAt,
+    ReplicaKillAt,
+    SlowReplicaAt,
+)
+from dist_svgd_tpu.serving import fleet
+from dist_svgd_tpu.telemetry.metrics import MetricsRegistry
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _body(tenant="t0", rows=1):
+    return json.dumps({"inputs": [[0.1, 0.2]] * rows,
+                       "tenant": tenant}).encode()
+
+
+def make_fleet(n=3, *, clock=None, faults=(), predict=None, registry=None,
+               tenants=(), fail_threshold=2, passive_fail_threshold=3,
+               open_cooldown_s=2.0, **router_kw):
+    """3 loopback replicas + fake transport + clock-injected router.
+    Returns (router, replicas dict, transport, clock, sleeps list)."""
+    clock = clock or ManualClock()
+    reg = registry or MetricsRegistry()
+    replicas = {f"r{i}": fleet.LoopbackReplica(
+        f"r{i}", predict_fn=predict, tenants=tenants, clock=clock)
+        for i in range(n)}
+    transport = fleet.FakeTransport(replicas, faults=faults,
+                                    advance=clock.advance)
+    rs = fleet.ReplicaSet(
+        list(replicas), transport, fail_threshold=fail_threshold,
+        passive_fail_threshold=passive_fail_threshold,
+        open_cooldown_s=open_cooldown_s, probe_interval_s=0.05,
+        clock=clock, registry=reg)
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock.advance(s)
+
+    router_kw.setdefault("backoff", Backoff(base_s=0.01, factor=2.0,
+                                            max_s=0.1, jitter_frac=0.0))
+    router = fleet.FleetRouter(
+        list(replicas), transport=transport, replica_set=rs,
+        clock=clock, sleep=fake_sleep, registry=reg, **router_kw)
+    return router, replicas, transport, clock, sleeps
+
+
+# --------------------------------------------------------------------- #
+# consistent hashing
+
+
+def test_ring_deterministic_and_complete():
+    ring = fleet._HashRing(["a", "b", "c"], vnodes=16)
+    order1 = ring.order("tenant-42")
+    assert sorted(order1) == ["a", "b", "c"]
+    assert ring.order("tenant-42") == order1  # deterministic
+    # different tenants spread their homes over multiple replicas
+    homes = {ring.order(f"t{i}")[0] for i in range(50)}
+    assert len(homes) >= 2
+
+
+def test_ring_stable_failover_chain():
+    """Ring order is a property of the tenant, not of replica health —
+    a tenant returns to the same home after its replica recovers."""
+    ring = fleet._HashRing(["a", "b", "c"], vnodes=16)
+    for t in ("x", "y", "z"):
+        assert ring.order(t)[0] == ring.order(t)[0]
+
+
+def test_bounded_load_overflow():
+    """A replica past load_factor × fair share overflows to the next ring
+    candidate; the overflow is a preference, not a refusal."""
+    clock = ManualClock()
+    reg = MetricsRegistry()
+    reps = {r: fleet.LoopbackReplica(r) for r in ("a", "b")}
+    rs = fleet.ReplicaSet(list(reps), fleet.FakeTransport(reps),
+                          clock=clock, registry=reg)
+    # pile 4 in-flight requests onto a
+    for _ in range(4):
+        assert rs.begin_request("a")
+    # fair share at load_factor=1.0 is ceil((4+1)/2) = 3 < 5 -> a refuses
+    assert not rs.begin_request("a", load_factor=1.0)
+    assert rs.begin_request("b", load_factor=1.0)
+
+
+# --------------------------------------------------------------------- #
+# SLO classification (what the router reads off /slo)
+
+
+def test_classify_slo_verdicts():
+    assert fleet.classify_slo({"status": "ok", "ts": 10.0}) == "healthy"
+    assert fleet.classify_slo({"status": "breach"}) == "burning"
+    # no_data / unknown statuses, garbage, and missing docs are UNKNOWN —
+    # never healthy
+    assert fleet.classify_slo({"status": "no_data"}) == "unknown"
+    assert fleet.classify_slo({}) == "unknown"
+    assert fleet.classify_slo(None) == "unknown"
+    assert fleet.classify_slo("not a dict") == "unknown"
+
+
+def test_classify_slo_staleness_reads_unknown_never_healthy():
+    """A stale 'ok' (or a verdict with no timestamp at all) must read
+    unknown: stale good news is no news."""
+    fresh = {"status": "ok", "ts": 100.0}
+    assert fleet.classify_slo(fresh, now_s=105.0, max_age_s=30.0) == "healthy"
+    assert fleet.classify_slo(fresh, now_s=200.0, max_age_s=30.0) == "unknown"
+    no_ts = {"status": "ok"}
+    assert fleet.classify_slo(no_ts, now_s=200.0, max_age_s=30.0) == "unknown"
+    # a stale breach is also unknown (don't eject on old bad news either)
+    stale_bad = {"status": "breach", "ts": 0.0}
+    assert fleet.classify_slo(stale_bad, now_s=100.0,
+                              max_age_s=10.0) == "unknown"
+
+
+# --------------------------------------------------------------------- #
+# circuit breaker: active probes
+
+
+def test_probe_failures_eject_after_threshold():
+    router, reps, tr, clock, _ = make_fleet(fail_threshold=2)
+    rs = router.replica_set
+    tr.kill("r1")
+    rs.probe_once()
+    assert rs.state("r1") == fleet.CLOSED  # one strike is not an outage
+    rs.probe_once()
+    assert rs.state("r1") == fleet.OPEN
+    _, rid, _, to, reason = list(rs.state_changes)[-1]
+    assert (rid, to, reason) == ("r1", "open", "probe_failures")
+
+
+def test_slo_burn_ejects_immediately():
+    router, reps, tr, clock, _ = make_fleet()
+    reps["r2"].slo_status = "breach"
+    router.replica_set.probe_once()
+    assert router.replica_set.state("r2") == fleet.OPEN
+    assert list(router.replica_set.state_changes)[-1][4] == "slo_burn"
+    # unknown slo must NOT eject (and not re-admit)
+    reps["r1"].slo_status = "no_data"
+    router.replica_set.probe_once()
+    assert router.replica_set.state("r1") == fleet.CLOSED
+
+
+def test_draining_probe_ejects_in_one_sweep():
+    """Drain is a deliberate signal, not a flaky probe: one strike."""
+    router, reps, tr, clock, _ = make_fleet(fail_threshold=3)
+    reps["r0"].draining = True
+    router.replica_set.probe_once()
+    assert router.replica_set.state("r0") == fleet.OPEN
+    assert list(router.replica_set.state_changes)[-1][4] == "draining"
+
+
+def test_half_open_readmission_cycle():
+    router, reps, tr, clock, _ = make_fleet(fail_threshold=1,
+                                            open_cooldown_s=2.0)
+    rs = router.replica_set
+    tr.kill("r1")
+    rs.probe_once()
+    assert rs.state("r1") == fleet.OPEN
+    # cooldown not elapsed: stays open, probes skip it
+    clock.advance(1.0)
+    rs.probe_once()
+    assert rs.state("r1") == fleet.OPEN
+    # cooldown elapsed + still dead: half-open trial fails, re-opens
+    clock.advance(1.5)
+    rs.probe_once()
+    assert rs.state("r1") == fleet.OPEN
+    transitions = [(frm, to) for _, r, frm, to, _ in rs.state_changes
+                   if r == "r1"]
+    assert ("open", "half_open") in transitions
+    assert ("half_open", "open") in transitions
+    # replica restarts: next half-open trial re-admits
+    tr.restore("r1")
+    clock.advance(2.5)
+    rs.probe_once()
+    assert rs.state("r1") == fleet.CLOSED
+    assert rs.registry.counter(
+        "svgd_fleet_readmissions_total").value() == 1
+
+
+def test_probe_tenant_paths():
+    """/healthz/<tenant> probing: a replica missing a probed tenant fails
+    its sweep."""
+    clock = ManualClock()
+    reps = {"a": fleet.LoopbackReplica("a", tenants=("t0",)),
+            "b": fleet.LoopbackReplica("b", tenants=("t0", "t1"))}
+    rs = fleet.ReplicaSet(list(reps), fleet.FakeTransport(reps),
+                          fail_threshold=1, probe_tenants=("t1",),
+                          clock=clock, registry=MetricsRegistry())
+    rs.probe_once()
+    assert rs.state("a") == fleet.OPEN  # 404 on /healthz/t1
+    assert rs.state("b") == fleet.CLOSED
+
+
+# --------------------------------------------------------------------- #
+# circuit breaker: passive scoring
+
+
+def test_passive_failures_eject_without_probes():
+    router, reps, tr, clock, _ = make_fleet(passive_fail_threshold=2,
+                                            max_retries=2)
+    rs = router.replica_set
+    tenant = next(t for t in (f"t{i}" for i in range(50))
+                  if router.order_for(t)[0] == "r0")
+    tr.kill("r0")
+    res = router.route(tenant, _body(tenant))
+    assert res.status == 200 and res.replica != "r0"
+    res = router.route(tenant, _body(tenant))
+    assert res.status == 200
+    # two passive connect failures opened the circuit — no probe ran
+    assert rs.state("r0") == fleet.OPEN
+    assert "request_failures" in list(rs.state_changes)[-1][4]
+
+
+def test_shed_is_not_failure():
+    """429s release the in-flight slot but never advance failure counters
+    or open the circuit."""
+    clock = ManualClock()
+    reg = MetricsRegistry()
+    reps = {"a": fleet.LoopbackReplica("a")}
+    rs = fleet.ReplicaSet(["a"], fleet.FakeTransport(reps),
+                          passive_fail_threshold=1, clock=clock,
+                          registry=reg)
+    for _ in range(5):
+        assert rs.begin_request("a")
+        rs.record_shed("a", retry_after_s=3.0)
+    assert rs.state("a") == fleet.CLOSED
+    assert rs.backpressured("a")
+    clock.advance(4.0)
+    assert not rs.backpressured("a")
+
+
+# --------------------------------------------------------------------- #
+# router: retries, failover, deadline, 429, hedging, 503
+
+
+def test_retry_absorbs_connect_error_and_fails_over():
+    router, reps, tr, clock, _ = make_fleet()
+    tenant = "t-failover"
+    home = router.order_for(tenant)[0]
+    tr.kill(home)
+    res = router.route(tenant, _body(tenant))
+    assert res.status == 200
+    assert res.replica == router.order_for(tenant)[1]
+    assert res.attempts == 2
+    reg = router.registry
+    assert reg.counter("svgd_fleet_retries_total").value(reason="connect") >= 1
+    assert reg.counter("svgd_fleet_failovers_total").value(tenant=tenant) == 1
+
+
+def test_5xx_retries_to_next_replica():
+    calls = []
+
+    def predict(inputs, tenant, headers):
+        calls.append(tenant)
+        if len(calls) == 1:
+            raise RuntimeError("boom")  # -> 500 on the first replica
+        return {"mean": [0.0] * len(inputs)}
+
+    router, reps, tr, clock, sleeps = make_fleet(predict=predict)
+    res = router.route("t0", _body("t0"))
+    assert res.status == 200 and res.attempts == 2
+    assert router.registry.counter(
+        "svgd_fleet_retries_total").value(reason="5xx") == 1
+    # the crashing handler tripped exactly one flight recorder
+    assert sum(r.flight_trips for r in reps.values()) == 1
+
+
+def test_429_never_retried_and_retry_after_passes_through():
+    home_holder = {}
+
+    def predict(inputs, tenant, headers):
+        raise fleet.Shed("queue full", retry_after_s=7.0)
+
+    router, reps, tr, clock, sleeps = make_fleet(predict=predict)
+    home_holder["home"] = router.order_for("t0")[0]
+    res = router.route("t0", _body("t0"))
+    assert res.status == 429
+    assert res.attempts == 1          # a shed burns NO retries
+    assert res.headers["Retry-After"] == "7"
+    assert res.json()["retry_after_s"] == 7.0
+    assert sleeps == []               # and no generic backoff sleep either
+    assert res.outcome == "shed"
+
+
+def test_backpressure_steers_next_requests_away():
+    """After a 429, the shedding replica is deprioritized until its own
+    Retry-After window passes — the router honors the replica's number
+    instead of its generic backoff."""
+
+    def predict(inputs, tenant, headers):
+        raise fleet.Shed("busy", retry_after_s=5.0)
+
+    router, reps, tr, clock, _ = make_fleet()
+    tenant = "t-bp"
+    home = router.order_for(tenant)[0]
+    reps[home]._predict = predict  # only the home sheds
+    res = router.route(tenant, _body(tenant))
+    assert res.status == 429 and res.replica == home
+    # within the window: the very next request prefers another replica
+    res2 = router.route(tenant, _body(tenant))
+    assert res2.status == 200 and res2.replica != home
+    # after the window: the tenant returns home
+    clock.advance(6.0)
+    res3 = router.route(tenant, _body(tenant))
+    assert res3.replica == home
+
+
+def test_retry_after_on_503_overrides_generic_backoff():
+    """A retryable 5xx carrying Retry-After sets the inter-attempt sleep
+    (clamped to the deadline) instead of the exponential schedule."""
+
+    class Hinting503:
+        def handle(self, method, path, body, headers):
+            if path == "/predict":
+                return fleet.Reply(503, {"Retry-After": "0.07"},
+                                   b'{"error": "warming up"}')
+            return fleet.Reply(200, {}, b'{"status": "ok"}')
+
+    reg = MetricsRegistry()
+    clock = ManualClock()
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock.advance(s)
+
+    reps = {"a": Hinting503()}
+    tr = fleet.FakeTransport(reps, advance=clock.advance)
+    rs = fleet.ReplicaSet(["a"], tr, clock=clock, registry=reg)
+    router = fleet.FleetRouter(
+        ["a"], transport=tr, replica_set=rs, max_retries=2,
+        backoff=Backoff(base_s=1.0, factor=2.0, max_s=10.0, jitter_frac=0.0),
+        clock=clock, sleep=fake_sleep, registry=reg)
+    res = router.route("t0", _body("t0"))
+    assert res.status == 503
+    assert sleeps and all(s == pytest.approx(0.07) for s in sleeps)
+
+
+def test_deadline_propagated_downstream_and_504_on_expiry():
+    router, reps, tr, clock, sleeps = make_fleet(
+        n=1, per_try_timeout_s=1.0, default_deadline_s=1.5)
+    # healthy request: the replica sees the remaining budget + attempt id
+    res = router.route("t0", _body("t0"), deadline_s=0.8)
+    assert res.status == 200
+    hdrs = reps["r0"].last_headers
+    assert float(hdrs["x-fleet-deadline-s"]) <= 0.8
+    assert hdrs["x-fleet-attempt"] == "0"
+    # hang the only replica: each attempt burns its timeout on the fake
+    # clock until the deadline is gone -> 504, never a hung client
+    tr.hang("r0")
+    res = router.route("t0", _body("t0"))
+    assert res.status == 504
+    assert res.outcome == "deadline"
+    assert router.registry.counter(
+        "svgd_fleet_retries_total").value(reason="timeout") >= 1
+
+
+def test_downstream_504_is_deadline_not_replica_failure():
+    """A replica answering 504 (OUR propagated deadline ran out inside
+    its future-wait) is alive: the router passes the answer through
+    without burning retries and without scoring a failure that could
+    eject a healthy replica under short-deadline traffic."""
+
+    class Deadline504:
+        def handle(self, method, path, body, headers):
+            if path == "/predict":
+                return fleet.Reply(504, {}, b'{"error": "deadline"}')
+            return fleet.Reply(200, {}, b'{"status": "ok"}')
+
+    clock = ManualClock()
+    reg = MetricsRegistry()
+    reps = {"a": Deadline504(), "b": Deadline504()}
+    tr = fleet.FakeTransport(reps, advance=clock.advance)
+    rs = fleet.ReplicaSet(list(reps), tr, passive_fail_threshold=1,
+                          clock=clock, registry=reg)
+    router = fleet.FleetRouter(list(reps), transport=tr, replica_set=rs,
+                               clock=clock, sleep=clock.advance,
+                               registry=reg)
+    res = router.route("t0", _body("t0"))
+    assert res.status == 504 and res.outcome == "deadline"
+    assert res.attempts == 1                      # no retries burned
+    assert rs.state(res.replica) == fleet.CLOSED  # no failure scored
+    assert reg.counter("svgd_fleet_retries_total").value(reason="5xx") == 0
+
+
+def test_scheduled_fleet_faults_drive_transport():
+    """The resilience/faults.py schedule flavor: ordinal-keyed windows."""
+    clock = ManualClock()
+    reps = {"a": fleet.LoopbackReplica("a")}
+    tr = fleet.FakeTransport(
+        reps, faults=[ReplicaKillAt(2, "a", until=4),
+                      SlowReplicaAt(5, "a", seconds=0.5)],
+        advance=clock.advance)
+    assert tr.request("a", "GET", "/healthz").status == 200  # ordinal 1
+    with pytest.raises(fleet.ConnectError):
+        tr.request("a", "GET", "/healthz")                   # 2: killed
+    with pytest.raises(fleet.ConnectError):
+        tr.request("a", "GET", "/healthz")                   # 3: killed
+    assert tr.request("a", "GET", "/healthz").status == 200  # 4: restarted
+    t0 = clock.t
+    assert tr.request("a", "GET", "/healthz").status == 200  # 5: slow
+    assert clock.t - t0 == pytest.approx(0.5)
+    with pytest.raises(fleet.RequestTimeout):
+        fleet.FakeTransport(
+            reps, faults=[ReplicaHangAt(1, "a")], advance=clock.advance
+        ).request("a", "GET", "/healthz", timeout_s=2.0)
+
+
+def test_partition_is_not_a_crash():
+    """Acceptance: PartitionAt trips the SAME ejection path as a kill
+    while the replica itself stays alive, serving, and flight-clean."""
+    router, reps, tr, clock, _ = make_fleet(fail_threshold=2)
+    rs = router.replica_set
+    tr.partition("r1")
+    rs.probe_once()
+    rs.probe_once()
+    assert rs.state("r1") == fleet.OPEN  # ejected like a crash
+    rep = reps["r1"]
+    # ...but the process is untouched: direct (non-router) access works
+    direct = rep.handle("GET", "/healthz", None, {})
+    assert direct.status == 200
+    assert rep.handle("POST", "/predict", _body("t0"), {}).status == 200
+    assert rep.flight_trips == 0  # no postmortem, no crash record
+    # healing the partition re-admits through half-open like any recovery
+    tr.restore("r1")
+    clock.advance(rs.open_cooldown_s + 0.1)
+    rs.probe_once()
+    assert rs.state("r1") == fleet.CLOSED
+
+
+def test_all_replicas_out_degrades_gracefully():
+    router, reps, tr, clock, sleeps = make_fleet(fail_threshold=1)
+    rs = router.replica_set
+    for r in reps:
+        tr.kill(r)
+    rs.probe_once()
+    for r in reps:
+        assert rs.state(r) == fleet.OPEN
+    res = router.route("t0", _body("t0"))
+    assert res.status == 503
+    assert res.outcome == "unroutable"
+    assert int(res.headers["Retry-After"]) >= 1
+    doc = res.json()
+    assert doc["last_known_healthy"] is None or \
+        doc["last_known_healthy"]["replica"] in reps
+    assert doc["retry_after_s"] > 0
+    assert router.registry.counter(
+        "svgd_fleet_requests_total").value(outcome="unroutable") == 1
+
+
+def test_last_known_healthy_hint_carries_recency():
+    router, reps, tr, clock, _ = make_fleet(fail_threshold=1)
+    rs = router.replica_set
+    rs.probe_once()          # everyone sighted healthy at t=0
+    clock.advance(10.0)
+    for r in reps:
+        tr.kill(r)
+    rs.probe_once()
+    res = router.route("t0", _body("t0"))
+    hint = res.json()["last_known_healthy"]
+    assert hint["replica"] in reps
+    assert hint["age_s"] == pytest.approx(10.0, abs=0.5)
+
+
+def test_hedging_wins_over_slow_primary():
+    """Tail hedging: a slow (not failed) primary is raced by a second
+    replica after the hedge delay; first reply wins.  Real (small) waits —
+    hedging is genuinely concurrent."""
+    release = threading.Event()
+
+    def predict(inputs, tenant, headers):
+        return {"mean": [0.0] * len(inputs)}
+
+    reg = MetricsRegistry()
+    reps = {f"r{i}": fleet.LoopbackReplica(f"r{i}") for i in range(2)}
+    tenant = "t-hedge"
+
+    def slow_predict(inputs, tenant_, headers):
+        release.wait(timeout=5.0)
+        return {"mean": [9.9] * len(inputs)}
+
+    tr = fleet.FakeTransport(reps)
+    rs = fleet.ReplicaSet(list(reps), tr, registry=reg)
+    router = fleet.FleetRouter(
+        list(reps), transport=tr, replica_set=rs, registry=reg,
+        hedge=True, hedge_delay_s=0.02, per_try_timeout_s=5.0)
+    home, backup = router.order_for(tenant)
+    reps[home]._predict = slow_predict
+    reps[backup]._predict = predict
+    try:
+        res = router.route(tenant, _body(tenant))
+        assert res.status == 200
+        assert res.replica == backup
+        assert res.hedged
+        assert reg.counter("svgd_fleet_hedges_total").value() == 1
+    finally:
+        release.set()
+        router.shutdown()
+
+
+def test_misroutes_stay_zero_and_state_gauge_tracks():
+    router, reps, tr, clock, _ = make_fleet(fail_threshold=1)
+    rs = router.replica_set
+    reg = router.registry
+    gauge = reg.gauge("svgd_fleet_replica_state")
+    assert gauge.value(replica="r0") == 0
+    tr.kill("r0")
+    rs.probe_once()
+    assert gauge.value(replica="r0") == 2  # open
+    clock.advance(rs.open_cooldown_s + 0.1)
+    assert rs.state("r0") == fleet.HALF_OPEN
+    assert gauge.value(replica="r0") == 1
+    for _ in range(10):
+        router.route("t0", _body("t0"))
+    assert reg.counter("svgd_fleet_misroutes_total").value() == 0
+
+
+def test_route_lane_tree_emitted():
+    from dist_svgd_tpu.telemetry import trace as trace_mod
+
+    router, reps, tr, clock, _ = make_fleet()
+    tenant = "t-trace"
+    tr.kill(router.order_for(tenant)[0])  # force one retry into the tree
+    tracer = trace_mod.enable()
+    try:
+        res = router.route(tenant, _body(tenant))
+        assert res.status == 200
+        names = [e["name"] for e in tracer.chrome_events()]
+    finally:
+        trace_mod.disable()
+    assert "fleet.route" in names
+    assert names.count("fleet.attempt") == 2  # failed + served
+    assert "fleet.forward" in names
+
+
+# --------------------------------------------------------------------- #
+# acceptance: rolling kill under load, detection + readmission budgets
+
+
+def test_acceptance_kill_one_replica_loses_nothing():
+    """ISSUE-11 acceptance, tier-1 flavor: 3 replicas under steady load,
+    kill one — zero non-shed requests lost (retries absorb), detection
+    within 2 probe sweeps, and the killed replica re-admitted through
+    half-open after restart."""
+    router, reps, tr, clock, _ = make_fleet(
+        fail_threshold=2, passive_fail_threshold=3, open_cooldown_s=1.0)
+    rs = router.replica_set
+    tenants = [f"t{i}" for i in range(12)]
+    statuses = []
+
+    def burst():
+        for t in tenants:
+            statuses.append(router.route(t, _body(t)).status)
+
+    burst()                       # steady state
+    victim = router.order_for(tenants[0])[0]
+    t_kill = clock.t
+    tr.kill(victim)
+    burst()                       # in-flight loss window: retries absorb
+    clock.advance(0.05)
+    rs.probe_once()               # detection within <= 2 sweeps
+    clock.advance(0.05)
+    rs.probe_once()
+    assert rs.state(victim) == fleet.OPEN
+    ts_open = next(ts for ts, r, _f, to, _why in rs.state_changes
+                   if r == victim and to == "open")
+    assert ts_open - t_kill <= 2 * 0.05 + 1e-9
+    burst()                       # degraded but fully served
+    # restart + half-open readmission
+    tr.restore(victim)
+    clock.advance(rs.open_cooldown_s + 0.01)
+    rs.probe_once()
+    assert rs.state(victim) == fleet.CLOSED
+    burst()                       # the tenant's home serves again
+    assert statuses and all(s == 200 for s in statuses)
+    home_again = router.route(tenants[0], _body(tenants[0]))
+    assert home_again.replica == victim
+
+
+# --------------------------------------------------------------------- #
+# HTTP front door (real sockets, fake backend)
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url + path, timeout=10) as r:
+        return json.loads(r.read()), r.status, dict(r.headers)
+
+
+def test_router_http_front_door():
+    reg = MetricsRegistry()
+    reps = {f"r{i}": fleet.LoopbackReplica(f"r{i}") for i in range(3)}
+    tr = fleet.FakeTransport(reps)
+    rs = fleet.ReplicaSet(list(reps), tr, probe_interval_s=0.05,
+                          registry=reg)
+    with fleet.FleetRouter(list(reps), transport=tr, replica_set=rs,
+                           registry=reg, port=0) as router:
+        url = router.url
+        req = urllib.request.Request(
+            url + "/predict", _body("web-tenant"),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.loads(r.read())
+        assert r.status == 200 and "outputs" in doc
+        health, code, _ = _get(url, "/healthz")
+        assert code == 200
+        assert health["replicas_closed"] == 3
+        assert health["role"] == "fleet-router"
+        stats, _, _ = _get(url, "/replicas")
+        assert set(stats) == set(reps)
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "svgd_fleet_requests_total" in text
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/nope", timeout=10)
+        assert ei.value.code == 404
+
+
+def test_router_http_failover_and_shed_passthrough():
+    reg = MetricsRegistry()
+    reps = {f"r{i}": fleet.LoopbackReplica(f"r{i}") for i in range(2)}
+    tr = fleet.FakeTransport(reps)
+    rs = fleet.ReplicaSet(list(reps), tr, registry=reg)
+    with fleet.FleetRouter(
+            list(reps), transport=tr, replica_set=rs, registry=reg,
+            backoff=Backoff(base_s=0.001, max_s=0.002), port=0) as router:
+        tenant = "shedder"
+        home = router.order_for(tenant)[0]
+        tr.kill(home)  # HTTP request rides the failover path
+        req = urllib.request.Request(
+            router.url + "/predict", _body(tenant),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        tr.restore(home)
+        # now the home sheds: the 429 + Retry-After passes through HTTP
+        reps[home]._predict = lambda i, t, h: (_ for _ in ()).throw(
+            fleet.Shed("full", retry_after_s=3.0))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                router.url + "/predict", _body(tenant),
+                {"Content-Type": "application/json"}), timeout=10)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) == 3
+
+
+# --------------------------------------------------------------------- #
+# validation
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="at least one replica"):
+        fleet.ReplicaSet([], fleet.FakeTransport({}),
+                         registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="thresholds"):
+        fleet.ReplicaSet(["a"], fleet.FakeTransport({}), fail_threshold=0,
+                         registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="transport"):
+        fleet.FleetRouter(["a"])
+    with pytest.raises(ValueError, match="vnodes"):
+        fleet._HashRing(["a"], vnodes=0)
+    with pytest.raises(fleet.ConnectError, match="unknown replica"):
+        fleet.FakeTransport({}).request("ghost", "GET", "/healthz")

@@ -1,0 +1,88 @@
+"""tools/fleet_drill.py: the fleet_failover row — fake-mode drill in
+tier-1 (schema + the zero-lost / clean-partition contracts), the
+real-subprocess kill/partition/restart drill slow-marked."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import fleet_drill
+
+
+@pytest.fixture(scope="module")
+def fake_row():
+    return fleet_drill.run_drill(mode="fake", rate_hz=150.0,
+                                 steady_s=0.4, kill_s=0.6, partition_s=0.5)
+
+
+def test_fake_drill_row_schema(fake_row):
+    row = fake_row
+    for key in ("metric", "value", "unit", "mode", "replicas", "requests",
+                "lost_requests", "shed_requests", "detect_s",
+                "detect_probe_intervals", "readmit_s", "p99_steady_ms",
+                "p99_kill_ms", "p99_partition_ms", "retries", "hedges",
+                "failovers", "misroutes", "ejections", "readmissions",
+                "partition_replica_alive", "partition_flight_trips",
+                "probe_interval_s", "open_cooldown_s", "status_counts",
+                "wall_s"):
+        assert key in row, key
+    assert row["metric"] == "fleet_failover"
+    assert row["mode"] == "fake"
+    assert row["replicas"] == 3
+    assert row["requests"] > 0
+
+
+def test_fake_drill_acceptance(fake_row):
+    """The ISSUE-11 availability drill, measured: killing one of three
+    replicas under open-loop load loses ZERO non-shed requests, detection
+    lands within 2 probe intervals, the partitioned replica stays alive
+    and flight-clean, and the restart re-admits through half-open."""
+    row = fake_row
+    ok, why = fleet_drill.row_ok(row)
+    assert ok, why
+    assert row["value"] == 1.0
+    assert row["lost_requests"] == 0
+    assert row["misroutes"] == 0
+    assert row["detect_probe_intervals"] <= 2.0
+    assert row["readmit_s"] > 0
+    assert row["readmissions"] >= 1
+    assert row["retries"] >= 1          # the kill was absorbed, not missed
+    assert row["partition_replica_alive"] is True
+    assert row["partition_flight_trips"] == 0
+
+
+def test_row_ok_catches_every_gate():
+    good = {"lost_requests": 0, "misroutes": 0, "detect_s": 0.1,
+            "readmit_s": 0.2, "readmissions": 1,
+            "partition_replica_alive": True, "partition_flight_trips": 0}
+    assert fleet_drill.row_ok(dict(good)) == (True, [])
+    for key, bad in (("lost_requests", 3), ("misroutes", 1),
+                     ("detect_s", None), ("readmit_s", None),
+                     ("readmissions", 0),
+                     ("partition_replica_alive", False),
+                     ("partition_flight_trips", 2)):
+        row = dict(good)
+        row[key] = bad
+        ok, why = fleet_drill.row_ok(row)
+        assert not ok and why, key
+
+
+def test_drill_cli_exits_clean():
+    assert fleet_drill.main(["--mode", "fake", "--rate", "120"]) == 0
+
+
+@pytest.mark.slow
+def test_real_subprocess_drill():
+    """Real sockets, real SIGKILL, real restart: three PredictionServer
+    subprocesses (CPU jax) behind the router.  The partition is cut
+    router-side (HttpTransport deny-list) so the replica process is
+    provably untouched."""
+    row = fleet_drill.run_drill(mode="real", rate_hz=80.0)
+    ok, why = fleet_drill.row_ok(row)
+    assert ok, (why, row)
+    assert row["lost_requests"] == 0
+    assert row["partition_replica_alive"] is True

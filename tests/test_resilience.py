@@ -426,3 +426,59 @@ def test_rerun_resets_counters_and_budget(tmp_path):
     # dispatches, so one successful segment (8→12) after the rollback
     assert r2["segments"] == 1
     assert r2["resumed_from"] == 8
+
+
+# --------------------------------------------------------------------- #
+# shared backoff (resilience/backoff.py, round 15): one implementation
+# behind both the supervisor's RetryPolicy and the fleet router
+
+
+def test_capped_delay_is_the_retrypolicy_schedule():
+    """Extracting the schedule into backoff.capped_delay changed nothing:
+    RetryPolicy.delay_s delegates and stays bit-identical."""
+    from dist_svgd_tpu.resilience.backoff import capped_delay
+
+    rp = RetryPolicy(backoff_base_s=0.5, backoff_factor=3.0,
+                     max_backoff_s=10.0)
+    for k in range(1, 8):
+        assert rp.delay_s(k) == capped_delay(k, 0.5, 3.0, 10.0)
+    assert capped_delay(1, 1.0, 2.0, 60.0) == 1.0
+    assert capped_delay(4, 1.0, 2.0, 60.0) == 8.0
+    assert capped_delay(50, 1.0, 2.0, 60.0) == 60.0  # capped
+    assert capped_delay(0, 1.0, 2.0, 60.0) == 1.0   # clamps to 1-based
+
+
+def test_backoff_jitter_bounded_and_deterministic():
+    import random
+
+    from dist_svgd_tpu.resilience.backoff import Backoff, capped_delay
+
+    bo = Backoff(base_s=0.1, factor=2.0, max_s=5.0, jitter_frac=0.25,
+                 rng=random.Random(7))
+    for k in range(1, 12):
+        d = bo.delay_s(k)
+        exact = capped_delay(k, 0.1, 2.0, 5.0)
+        assert (1 - 0.25) * exact <= d <= min((1 + 0.25) * exact, 5.0)
+        assert d <= 5.0  # the cap survives jitter
+    # deterministic under an injected seed
+    a = [Backoff(jitter_frac=0.3, rng=random.Random(3)).delay_s(k)
+         for k in range(1, 6)]
+    b = [Backoff(jitter_frac=0.3, rng=random.Random(3)).delay_s(k)
+         for k in range(1, 6)]
+    assert a == b
+    # jitter_frac=0 is the exact schedule (what the supervisor uses)
+    zero = Backoff(base_s=1.0, factor=2.0, max_s=60.0)
+    assert [zero.delay_s(k) for k in (1, 2, 3)] == [1.0, 2.0, 4.0]
+
+
+def test_backoff_validation():
+    from dist_svgd_tpu.resilience.backoff import Backoff
+
+    with pytest.raises(ValueError, match="jitter_frac"):
+        Backoff(jitter_frac=1.0)
+    with pytest.raises(ValueError, match="factor"):
+        Backoff(factor=0.5)
+    with pytest.raises(ValueError, match="max_s"):
+        Backoff(base_s=2.0, max_s=1.0)
+    with pytest.raises(ValueError, match="base_s"):
+        Backoff(base_s=-1.0)

@@ -520,9 +520,11 @@ def test_server_concurrent_load_coalesces(rng):
         assert m["batcher"]["requests_per_batch_mean"] > 1
 
 
-def test_server_sheds_with_503(rng):
-    """Overload surfaces as HTTP 503, not a hung connection: the batcher
-    never starts, so queued rows accumulate until the bound trips."""
+def test_server_sheds_with_429_retry_after(rng):
+    """Overload surfaces as HTTP 429 with a computed Retry-After (round
+    15: a shed is load, not failure — a router must not burn retries on
+    it), not a hung connection: the batcher never starts, so queued rows
+    accumulate until the bound trips."""
     eng, _ = _logreg_engine(rng)
     bat, _ = make_batcher(eng.predict, max_batch=4, max_queue_rows=4,
                           max_wait_ms=1.0)
@@ -546,12 +548,60 @@ def test_server_sheds_with_503(rng):
                 {"Content-Type": "application/json"},
             )
             urllib.request.urlopen(req, timeout=10)
-        assert ei.value.code == 503
+        assert ei.value.code == 429
+        # the batcher's drain estimate rides the response: integral
+        # delta-seconds header (ceil, >= 1) + the precise body field
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.loads(ei.value.read())
+        assert body["retry_after_s"] > 0
         bat.start()
         t.join(timeout=10)
     finally:
         bat.start()
         srv.shutdown()
+
+
+def test_overloaded_retry_after_scales_with_queue_depth(rng):
+    """The Overloaded hint is (1 + ceil(queued/max_batch)) coalescing
+    windows — deeper backlog, later retry."""
+    eng, _ = _logreg_engine(rng)
+    bat, _ = make_batcher(eng.predict, max_batch=4, max_queue_rows=8,
+                          max_wait_ms=10.0)
+    bat.submit(np.zeros((8, 4), np.float32))  # fill: worker never started
+    with pytest.raises(Overloaded) as ei:
+        bat.submit(np.zeros((1, 4), np.float32))
+    # 8 queued rows = 2 batches -> (1 + 2) * 10 ms
+    assert ei.value.retry_after_s == pytest.approx(0.030)
+    bat.start()
+    bat.close(drain=True)
+
+
+def test_shutdown_flips_healthz_before_socket_close(rng):
+    """Drain-signal ordering pin (round 15): shutdown() must advertise
+    503 "draining" on /healthz while the socket still answers — a fleet
+    router probing health then stops routing BEFORE the address dies.
+    The spy wraps the httpd's shutdown (the first socket-closing step) and
+    performs a live GET from inside it."""
+    eng, _ = _logreg_engine(rng)
+    srv = PredictionServer(eng, port=0, max_wait_ms=1.0).start()
+    seen = {}
+    orig_shutdown = srv._httpd.shutdown
+
+    def spy():
+        # at this instant the socket has NOT been closed yet: a real GET
+        # must succeed and must already read as draining
+        try:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+            seen["code"] = 200
+        except urllib.error.HTTPError as e:
+            seen["code"] = e.code
+            seen["body"] = json.loads(e.read())
+        orig_shutdown()
+
+    srv._httpd.shutdown = spy
+    srv.shutdown()
+    assert seen["code"] == 503
+    assert seen["body"]["status"] == "draining"
 
 
 # --------------------------------------------------------------------- #

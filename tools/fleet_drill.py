@@ -1,0 +1,465 @@
+"""Fleet failover drill: availability through replica loss, partition,
+and readmission — the ``fleet_failover`` bench row.
+
+The drill runs steady open-loop load through a
+:class:`~dist_svgd_tpu.serving.fleet.FleetRouter` over 3 replicas and
+walks the failure story end to end:
+
+1. **steady** — baseline latency with everyone healthy;
+2. **kill** — one replica dies mid-load (fake: transport ``kill``; real:
+   ``SIGKILL`` on the subprocess).  Every in-flight and subsequent
+   request must be absorbed by retries/failover: the row counts any
+   **lost (non-shed) request as an unconditional FAIL** in
+   ``perf_regress``.  Detection latency = kill → circuit-open, read off
+   the replica set's transition log;
+3. **partition** — a second replica becomes unreachable *from the router*
+   while staying alive (fake: ``partition``; real: the
+   :class:`~dist_svgd_tpu.serving.fleet.HttpTransport` deny-list — the
+   subprocess keeps running untouched).  Same ejection path as a crash,
+   zero replica-side effects; the row records p99 during the partition
+   window;
+4. **restart** — the killed replica comes back and must be re-admitted
+   through the half-open circuit; time-to-readmit = restore →
+   circuit-closed.
+
+Modes:
+
+- ``--mode fake`` (default) — :class:`LoopbackReplica` +
+  :class:`FakeTransport`: no sockets, no jax, runs in tier-1
+  (``tests/test_fleet_drill.py`` pins the row schema and the zero-lost
+  contract);
+- ``--mode real`` — 3 ``PredictionServer`` subprocesses
+  (``JAX_PLATFORMS=cpu`` — the drill measures the router, not the chip)
+  serving a real logreg checkpoint over real sockets, kill/partition/
+  restart for real.  Slow-marked in the test suite.
+
+Row fields are documented in ``tools/README.md``;
+``tools/perf_regress.py`` gates ``detect_s`` / ``readmit_s`` with
+median+MAD incumbent windows and FAILs unconditionally on
+``lost_requests > 0`` or ``misroutes > 0`` (a routed request reaching an
+ejected replica).
+
+Usage::
+
+    python tools/fleet_drill.py                 # fake-mode row
+    python tools/fleet_drill.py --mode real     # subprocess drill
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dist_svgd_tpu.resilience.backoff import Backoff
+from dist_svgd_tpu.serving import fleet as fleet_mod
+from dist_svgd_tpu.telemetry.metrics import MetricsRegistry
+
+REPLICAS = ("r0", "r1", "r2")
+TENANTS = tuple(f"t{i}" for i in range(8))
+
+
+def _p99(vals: List[float]) -> float:
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(round(0.99 * (len(vals) - 1))))]
+
+
+class _OpenLoopLoad:
+    """Open-loop request generator: fires at ``rate_hz`` regardless of
+    completions (the arrival process a real fleet sees), tagging each
+    record with the drill phase active at submit time."""
+
+    def __init__(self, router, rate_hz: float, workers: int = 32,
+                 tenant_in_body: bool = True):
+        self._router = router
+        self._rate = rate_hz
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="drill-load")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.phase = ["warmup"]  # single-slot mutable cell
+        self.records: List[Tuple[str, int, float]] = []  # (phase, status, s)
+        self._tenant_i = 0
+        # the routing key is always the tenant; single-tenant
+        # PredictionServer replicas reject a "tenant" body field, so the
+        # real drill keeps it out of the payload
+        self._tenant_in_body = tenant_in_body
+
+    def _one(self, tenant: str, phase: str) -> None:
+        doc = {"inputs": [[0.1, 0.2]]}
+        if self._tenant_in_body:
+            doc["tenant"] = tenant
+        body = json.dumps(doc).encode()
+        t0 = time.monotonic()
+        res = self._router.route(tenant, body)
+        self.records.append((phase, res.status, time.monotonic() - t0))
+
+    def _loop(self) -> None:
+        interval = 1.0 / self._rate
+        t_next = time.monotonic()
+        while not self._stop.is_set():
+            tenant = TENANTS[self._tenant_i % len(TENANTS)]
+            self._tenant_i += 1
+            self._pool.submit(self._one, tenant, self.phase[0])
+            t_next += interval
+            delay = t_next - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    def start(self) -> "_OpenLoopLoad":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._pool.shutdown(wait=True)
+
+    def counts(self, phase: str) -> Dict[str, Any]:
+        rows = [r for r in self.records if r[0] == phase]
+        lost = sum(1 for _, s, _ in rows if s >= 500)
+        shed = sum(1 for _, s, _ in rows if s == 429)
+        ok = sum(1 for _, s, _ in rows if 200 <= s < 300)
+        lat = [w for _, s, w in rows if 200 <= s < 300]
+        return {"total": len(rows), "ok": ok, "lost": lost, "shed": shed,
+                "p99_ms": round(_p99(lat) * 1e3, 3)}
+
+
+def _transition_ts(replica_set, rid: str, to_state: str,
+                   after_ts: float) -> Optional[float]:
+    for ts, r, _frm, to, _reason in list(replica_set.state_changes):
+        if r == rid and to == to_state and ts >= after_ts:
+            return ts
+    return None
+
+
+# --------------------------------------------------------------------- #
+# replica backends
+
+
+class _FakeFleet:
+    """3 LoopbackReplicas on a FakeTransport; faults are transport flips."""
+
+    def __init__(self):
+        self.replicas = {
+            rid: fleet_mod.LoopbackReplica(
+                rid, predict_fn=self._predict, tenants=TENANTS)
+            for rid in REPLICAS
+        }
+        self.transport = fleet_mod.FakeTransport(self.replicas)
+
+    @staticmethod
+    def _predict(inputs, tenant, headers):
+        time.sleep(0.001)  # a realistic (tiny) dispatch floor
+        return {"mean": [0.0] * len(inputs)}
+
+    def kill(self, rid):
+        self.transport.kill(rid)
+
+    def partition(self, rid):
+        self.transport.partition(rid)
+
+    def heal(self, rid):
+        self.transport.restore(rid)
+
+    def restart(self, rid):
+        self.transport.restore(rid)
+
+    def close(self):
+        pass
+
+    def assert_partition_clean(self, rid) -> Dict[str, Any]:
+        """The partitioned replica must be ALIVE: reachable directly (not
+        through the router's cut) and with zero flight-recorder trips."""
+        rep = self.replicas[rid]
+        reply = rep.handle("GET", "/healthz", None, {})
+        return {"alive": reply.status == 200,
+                "flight_trips": rep.flight_trips,
+                "served_during_partition": rep.requests}
+
+
+class _RealFleet:
+    """3 PredictionServer subprocesses over real sockets (CPU jax)."""
+
+    def __init__(self, tmpdir: str, max_batch: int = 16):
+        import socket
+        import subprocess
+
+        import numpy as np
+
+        from dist_svgd_tpu.utils.checkpoint import save_state
+
+        self._subprocess = subprocess
+        ckpt = os.path.join(tmpdir, "ckpt")
+        rng = np.random.default_rng(0)
+        save_state(ckpt, {"particles": rng.normal(
+            size=(64, 3)).astype(np.float32), "t": 1}, backend="npz")
+        self._ckpt = ckpt
+        self._max_batch = max_batch
+        self.addresses: Dict[str, Tuple[str, int]] = {}
+        self._procs: Dict[str, Any] = {}
+        for rid in REPLICAS:
+            with socket.socket() as s:  # grab a free port per replica
+                s.bind(("127.0.0.1", 0))
+                self.addresses[rid] = ("127.0.0.1", s.getsockname()[1])
+        self.transport = fleet_mod.HttpTransport(self.addresses)
+        for rid in REPLICAS:
+            self._spawn(rid)
+        for rid in REPLICAS:
+            self._wait_healthy(rid)
+
+    def _spawn(self, rid: str) -> None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        host, port = self.addresses[rid]
+        self._procs[rid] = self._subprocess.Popen(
+            [sys.executable, "-m", "dist_svgd_tpu.serving.server",
+             "--checkpoint", self._ckpt, "--model", "logreg",
+             "--host", host, "--port", str(port),
+             "--max-batch", str(self._max_batch), "--max-wait-ms", "1.0"],
+            env=env, stdout=self._subprocess.DEVNULL,
+            stderr=self._subprocess.DEVNULL,
+        )
+
+    def _wait_healthy(self, rid: str, timeout_s: float = 60.0) -> None:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            try:
+                reply = self.transport.request(rid, "GET", "/healthz",
+                                               timeout_s=1.0)
+                if reply.status == 200:
+                    return
+            except fleet_mod.TransportError:
+                pass
+            time.sleep(0.2)
+        raise RuntimeError(f"replica {rid} never became healthy")
+
+    def kill(self, rid):
+        self._procs[rid].kill()
+        self._procs[rid].wait(timeout=10)
+
+    def partition(self, rid):
+        self.transport.partition(rid)
+
+    def heal(self, rid):
+        self.transport.heal(rid)
+
+    def restart(self, rid):
+        self._spawn(rid)
+        self._wait_healthy(rid)
+
+    def close(self):
+        for p in self._procs.values():
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+    def assert_partition_clean(self, rid) -> Dict[str, Any]:
+        """Bypass the router-side cut: a direct probe (fresh transport, no
+        deny-list) must still see a live, healthy process."""
+        direct = fleet_mod.HttpTransport(self.addresses)
+        try:
+            reply = direct.request(rid, "GET", "/healthz", timeout_s=2.0)
+            return {"alive": reply.status == 200, "flight_trips": 0,
+                    "served_during_partition": None}
+        except fleet_mod.TransportError:
+            return {"alive": False, "flight_trips": None,
+                    "served_during_partition": None}
+
+
+# --------------------------------------------------------------------- #
+
+
+def run_drill(mode: str = "fake", *, rate_hz: float = 200.0,
+              steady_s: float = 0.6, kill_s: float = 0.8,
+              partition_s: float = 0.8, probe_interval_s: float = 0.05,
+              open_cooldown_s: float = 0.25,
+              readmit_timeout_s: float = 10.0,
+              hedge: bool = False) -> Dict[str, Any]:
+    """Run the drill, return the ``fleet_failover`` row dict."""
+    if mode not in ("fake", "real"):
+        raise ValueError(f"mode must be fake|real, got {mode!r}")
+    registry = MetricsRegistry()
+    tmpdir = None
+    if mode == "real":
+        import tempfile
+
+        tmpdir = tempfile.TemporaryDirectory(prefix="fleet_drill_")
+        backend = _RealFleet(tmpdir.name)
+        probe_interval_s = max(probe_interval_s, 0.1)
+    else:
+        backend = _FakeFleet()
+    t_wall0 = time.monotonic()
+    replica_set = fleet_mod.ReplicaSet(
+        REPLICAS, backend.transport,
+        probe_interval_s=probe_interval_s,
+        probe_timeout_s=0.5 if mode == "real" else 0.2,
+        fail_threshold=2, passive_fail_threshold=2,
+        open_cooldown_s=open_cooldown_s,
+        registry=registry,
+    )
+    router = fleet_mod.FleetRouter(
+        list(REPLICAS), transport=backend.transport,
+        replica_set=replica_set,
+        max_retries=2, per_try_timeout_s=1.0 if mode == "real" else 0.5,
+        default_deadline_s=5.0,
+        backoff=Backoff(base_s=0.005, factor=2.0, max_s=0.05,
+                        jitter_frac=0.2),
+        hedge=hedge, registry=registry,
+    )
+    router.start()
+    load = _OpenLoopLoad(router, rate_hz,
+                         tenant_in_body=mode == "fake").start()
+    partition_clean = None
+    try:
+        # phase 1: steady state
+        load.phase[0] = "steady"
+        time.sleep(steady_s)
+
+        # phase 2: kill r0 under load — retries must absorb every request
+        load.phase[0] = "kill"
+        t_kill = time.monotonic()
+        backend.kill("r0")
+        time.sleep(kill_s)
+        ts_open = _transition_ts(replica_set, "r0", "open", t_kill)
+        detect_s = None if ts_open is None else ts_open - t_kill
+
+        # phase 3: partition r1 (alive, unreachable) — same ejection path
+        load.phase[0] = "partition"
+        t_part = time.monotonic()
+        backend.partition("r1")
+        time.sleep(partition_s)
+        partition_clean = backend.assert_partition_clean("r1")
+        backend.heal("r1")
+
+        # phase 4: restart r0 — must come back through half-open
+        load.phase[0] = "restart"
+        t_restart = time.monotonic()
+        backend.restart("r0")
+        deadline = time.monotonic() + readmit_timeout_s
+        ts_closed = None
+        while time.monotonic() < deadline:
+            ts_closed = _transition_ts(replica_set, "r0", "closed", t_restart)
+            if ts_closed is not None:
+                break
+            time.sleep(probe_interval_s / 2)
+        readmit_s = None if ts_closed is None else ts_closed - t_restart
+        load.phase[0] = "cooldown"
+    finally:
+        load.stop()
+        router.shutdown()
+        backend.close()
+        if tmpdir is not None:
+            tmpdir.cleanup()
+
+    steady = load.counts("steady")
+    kill = load.counts("kill")
+    part = load.counts("partition")
+    restart = load.counts("restart")
+    total = steady["total"] + kill["total"] + part["total"] + restart["total"]
+    lost = (steady["lost"] + kill["lost"] + part["lost"] + restart["lost"])
+    shed = (steady["shed"] + kill["shed"] + part["shed"] + restart["shed"])
+    availability = (1.0 if kill["total"] == 0
+                    else kill["ok"] / max(kill["total"] - kill["shed"], 1))
+
+    def _counter_sum(name: str) -> float:
+        metric = registry._metrics.get(name)
+        if metric is None:
+            return 0
+        with metric._lock:
+            return sum(metric._series.values())
+
+    row = {
+        "metric": "fleet_failover",
+        "value": round(availability, 6),
+        "unit": "non-shed availability during single-replica loss",
+        "mode": mode,
+        "replicas": len(REPLICAS),
+        "rate_hz": rate_hz,
+        "requests": total,
+        "lost_requests": lost,
+        "shed_requests": shed,
+        "detect_s": None if detect_s is None else round(detect_s, 4),
+        "detect_probe_intervals": (
+            None if detect_s is None
+            else round(detect_s / probe_interval_s, 2)),
+        "readmit_s": None if readmit_s is None else round(readmit_s, 4),
+        "p99_steady_ms": steady["p99_ms"],
+        "p99_kill_ms": kill["p99_ms"],
+        "p99_partition_ms": part["p99_ms"],
+        "retries": int(_counter_sum("svgd_fleet_retries_total")),
+        "hedges": int(_counter_sum("svgd_fleet_hedges_total")),
+        "failovers": int(_counter_sum("svgd_fleet_failovers_total")),
+        "misroutes": int(_counter_sum("svgd_fleet_misroutes_total")),
+        "ejections": int(_counter_sum("svgd_fleet_ejections_total")),
+        "readmissions": int(_counter_sum("svgd_fleet_readmissions_total")),
+        "partition_replica_alive": (
+            None if partition_clean is None else partition_clean["alive"]),
+        "partition_flight_trips": (
+            None if partition_clean is None
+            else partition_clean["flight_trips"]),
+        "probe_interval_s": probe_interval_s,
+        "open_cooldown_s": open_cooldown_s,
+        "status_counts": {
+            str(s): sum(1 for _, st, _ in load.records if st == s)
+            for s in sorted({st for _, st, _ in load.records})},
+        "wall_s": round(time.monotonic() - t_wall0, 3),
+    }
+    return row
+
+
+def row_ok(row: Dict[str, Any]) -> Tuple[bool, List[str]]:
+    """The unconditional correctness gates ``perf_regress`` applies to a
+    ``fleet_failover`` row (speed is windowed separately)."""
+    why = []
+    if row["lost_requests"] > 0:
+        why.append(f"lost {row['lost_requests']} non-shed request(s) — "
+                   "retries failed to absorb a replica loss")
+    if row["misroutes"] > 0:
+        why.append(f"{row['misroutes']} request(s) routed to an ejected "
+                   "replica")
+    if row["detect_s"] is None:
+        why.append("the killed replica was never ejected")
+    if row["readmit_s"] is None:
+        why.append("the restarted replica was never re-admitted")
+    if row["readmissions"] < 1:
+        why.append("no half-open readmission observed")
+    if row["partition_replica_alive"] is False:
+        why.append("the partitioned replica died — partition must leave "
+                   "the process untouched")
+    if row["partition_flight_trips"] not in (None, 0):
+        why.append("partition tripped the replica's own flight recorder")
+    return (not why), why
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("fake", "real"), default="fake")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open-loop request rate (req/s)")
+    ap.add_argument("--hedge", action="store_true",
+                    help="enable tail hedging in the router under drill")
+    args = ap.parse_args(argv)
+    row = run_drill(mode=args.mode, rate_hz=args.rate, hedge=args.hedge)
+    ok, why = row_ok(row)
+    row["ok"] = ok
+    if why:
+        row["failures"] = why
+    print(json.dumps(row), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

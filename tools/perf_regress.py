@@ -66,6 +66,17 @@ A/B timing protocol those notes derived:
   its ``elastic_reshard_wall_s`` / ``elastic_recovery_wall_s`` walls gate
   against their own median+MAD incumbent windows.
 
+- **fleet-failover rows (round 15)** — ``fleet_failover``
+  (``tools/fleet_drill.py`` in real-subprocess mode: 3 CPU replica
+  processes behind the consistent-hash ``FleetRouter``, SIGKILL one under
+  open-loop load, partition a second router-side, restart the first) is
+  gated on correctness unconditionally — zero lost non-shed requests
+  during single-replica loss, zero requests routed to an ejected replica,
+  the kill detected and the restart re-admitted through half-open, and
+  the partitioned replica process provably untouched — while
+  ``fleet_detect_s`` / ``fleet_readmit_s`` gate against their own
+  median+MAD incumbent windows.
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -120,7 +131,10 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
               "serve_multitenant": 2.0, "serve_multitenant_p99": 2.0,
               # the elastic walls are dominated by host checkpoint I/O and
               # one-off XLA compiles — as scheduling-noisy as the serve rows
-              "elastic_reshard_wall_s": 2.0, "elastic_recovery_wall_s": 2.0}
+              "elastic_reshard_wall_s": 2.0, "elastic_recovery_wall_s": 2.0,
+              # the fleet walls measure probe scheduling + subprocess
+              # restart (readmit includes a cold jax import) — host-noisy
+              "fleet_detect_s": 2.0, "fleet_readmit_s": 2.0}
 
 #: Hard ceiling on the span tracer's measured serve-bench cost (round 10):
 #: the interleaved tracer-off/on A/B (``serve_bench.
@@ -782,6 +796,53 @@ def main():
                 if status == "FAIL":
                     failures += 1
                 results[key] = value
+            print(json.dumps(row), flush=True)
+
+    # fleet-failover gates (round 15): the real-subprocess drill — 3 CPU
+    # replica processes behind the router, SIGKILL one under open-loop
+    # load, partition another, restart the first.  Correctness gates are
+    # unconditional (fleet_drill.row_ok): ANY lost non-shed request during
+    # single-replica loss, ANY request routed to an ejected replica, a
+    # never-ejected kill, a never-readmitted restart, or a partition that
+    # touched the replica process — all FAIL regardless of speed.  The
+    # detection and readmit walls gate against their own median+MAD
+    # incumbent windows (readmit includes the replica's cold start by
+    # design — that IS the recovery the fleet user waits for).
+    import fleet_drill
+
+    frow = fleet_drill.run_drill(mode="real")
+    fleet_ok, fleet_why = fleet_drill.row_ok(frow)
+    row = {"bench": "fleet_failover", "value": frow["value"],
+           "unit": frow["unit"], "mode": frow["mode"],
+           "replicas": frow["replicas"], "requests": frow["requests"],
+           "lost_requests": frow["lost_requests"],
+           "shed_requests": frow["shed_requests"],
+           "misroutes": frow["misroutes"],
+           "detect_probe_intervals": frow["detect_probe_intervals"],
+           "p99_partition_ms": frow["p99_partition_ms"],
+           "partition_replica_alive": frow["partition_replica_alive"],
+           "partition_flight_trips": frow["partition_flight_trips"]}
+    if not fleet_ok:
+        row["status"] = "FAIL"
+        row["error"] = "; ".join(fleet_why)
+        failures += 1
+    else:
+        row["status"] = "PASS"
+    print(json.dumps(row), flush=True)
+    if fleet_ok:
+        for key, field in (("fleet_detect_s", "detect_s"),
+                           ("fleet_readmit_s", "readmit_s")):
+            value = frow[field]
+            row = {"bench": key, "value": value, "unit": "s"}
+            tol = min(args.tol * TOL_FACTOR.get(key, 1.0), 0.9)
+            status, info = judge_row(
+                value, incumbent_history(incumbents, key), tol, False,
+            )
+            row.update(info)
+            row["status"] = status
+            if status == "FAIL":
+                failures += 1
+            results[key] = value
             print(json.dumps(row), flush=True)
 
     print(json.dumps({
