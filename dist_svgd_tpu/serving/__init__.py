@@ -63,6 +63,7 @@ from dist_svgd_tpu.serving.fleet import (
     FleetRouter,
     HttpTransport,
     LoopbackReplica,
+    MetricsFederation,
     ReplicaSet,
 )
 from dist_svgd_tpu.serving.registry import (
@@ -83,6 +84,7 @@ __all__ = [
     "PredictionServer",
     "Tenant",
     "FleetRouter",
+    "MetricsFederation",
     "ReplicaSet",
     "HttpTransport",
     "FakeTransport",

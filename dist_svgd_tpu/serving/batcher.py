@@ -116,11 +116,12 @@ class _Request:
     the epoch, so a timestamp is only meaningful against the same tracer."""
 
     __slots__ = ("future", "n_chunks", "parts", "enqueued", "trace_enq",
-                 "trace_src", "tenant")
+                 "trace_src", "tenant", "trace")
 
     def __init__(self, n_chunks: int, enqueued: float,
                  trace_enq: Optional[float] = None, trace_src=None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 trace: Optional[str] = None):
         self.future: Future = Future()
         self.n_chunks = n_chunks
         self.parts: List[Optional[Dict[str, np.ndarray]]] = [None] * n_chunks
@@ -128,6 +129,7 @@ class _Request:
         self.trace_enq = trace_enq
         self.trace_src = trace_src
         self.tenant = tenant
+        self.trace = trace
 
 
 class _Chunk:
@@ -311,7 +313,8 @@ class MicroBatcher:
     # ------------------------------------------------------------------ #
     # client side
 
-    def submit(self, x, tenant: Optional[str] = None) -> Future:
+    def submit(self, x, tenant: Optional[str] = None,
+               trace: Optional[str] = None) -> Future:
         """Enqueue one request; returns a ``Future`` resolving to the dispatch
         output dict sliced back to this request's rows.
 
@@ -319,6 +322,14 @@ class MicroBatcher:
         same bounded queue but only coalesces with its own tenant's chunks,
         dispatches as ``dispatch(x, tenant)``, and participates in the
         quota shed priorities (module docstring).
+
+        ``trace`` (round 16) is the cross-process trace id this request
+        belongs to (the HTTP layer extracts it from ``X-Fleet-Trace``);
+        it tags the request's lane tree so ``trace_report --stitch`` can
+        join this hop to the router's.  While tracing is enabled, a
+        trace-less request **mints its own id** — propagation cost is then
+        always inside the telemetry-overhead A/B ceiling, and standalone
+        serving traces stay self-joinable.
 
         Raises :class:`Overloaded` when accepting the request would push the
         queue past ``max_queue_rows`` (all-or-nothing: a request is never
@@ -329,6 +340,8 @@ class MicroBatcher:
             raise ValueError(f"expected a non-empty (rows, features) array, got {x.shape}")
         rows = x.shape[0]
         tracer = _trace.get_tracer()
+        if trace is None and tracer is not None:
+            trace = _trace.mint_trace_id()
         tl = {} if tenant is None else {"tenant": tenant}
         shed_futures: List[Future] = []
         shed_err: Optional[Overloaded] = None
@@ -368,7 +381,7 @@ class MicroBatcher:
                 n_chunks = -(-rows // self.max_batch)
                 req = _Request(n_chunks, self._clock(),
                                tracer.now() if tracer is not None else None,
-                               tracer, tenant)
+                               tracer, tenant, trace)
                 for i in range(n_chunks):
                     chunk = x[i * self.max_batch : (i + 1) * self.max_batch]
                     self._queue.append(_Chunk(chunk, req, i))
@@ -531,6 +544,14 @@ class MicroBatcher:
         x = np.concatenate([c.x for c in batch], axis=0)
         self._m_lane_inflight.set(rows, batcher=self.metrics_instance,
                                   lane=lane_label, **tl)
+        # thread the trace id through the dispatch via the trace context
+        # (the engine's spans tag themselves from it — same mechanics as
+        # the tenant label, but per-request): only when the whole batch
+        # belongs to ONE trace is the context unambiguous
+        batch_traces = {c.req.trace for c in batch}
+        ctx_trace = (batch_traces.pop() if len(batch_traces) == 1 else None)
+        prev_ctx = (_trace.set_trace_context(ctx_trace)
+                    if ctx_trace is not None else None)
         t_disp0 = tracer.now() if tracer is not None else 0.0
         try:
             out = (self._dispatch(x) if tenant is None
@@ -554,6 +575,9 @@ class MicroBatcher:
                     # this lane thread
                     pass
             return
+        finally:
+            if ctx_trace is not None:
+                _trace.set_trace_context(prev_ctx)
         t_disp1 = tracer.now() if tracer is not None else 0.0
         self._m_lane_inflight.set(0, batcher=self.metrics_instance,
                                   lane=lane_label, **tl)
@@ -628,6 +652,10 @@ class MicroBatcher:
                          "lane": lane_label}
                 if tenant is not None:
                     attrs["tenant"] = tenant
+                if req.trace is not None:
+                    # the cross-process join key: trace_report --stitch
+                    # matches this tree to the router's fleet.route on it
+                    attrs["trace"] = req.trace
                 tracer.lane_tree(
                     "serve.request", enq, t_reply, attrs,
                     children=[

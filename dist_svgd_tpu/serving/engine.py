@@ -493,9 +493,16 @@ class PredictiveEngine:
             )
         bucket = bucket_for(b, self.min_bucket)
         traced = _trace.enabled()
-        with _trace.span("engine.predict",
-                         {"rows": b, "bucket": bucket, "model": self.model}
-                         if traced else None):
+        tags = None
+        if traced:
+            tags = {"rows": b, "bucket": bucket, "model": self.model}
+            # the batcher sets the thread's trace context when the whole
+            # coalesced batch belongs to one request trace — tag it so a
+            # cross-process stitch can attribute engine time to the trace
+            ctx = _trace.get_trace_context()
+            if ctx is not None:
+                tags["trace"] = ctx
+        with _trace.span("engine.predict", tags):
             fn, dtype = self._kernel_for(bucket)
             if bucket != b:
                 # pad on HOST: a device-side jnp.concatenate compiles one XLA

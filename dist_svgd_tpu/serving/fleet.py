@@ -65,6 +65,29 @@ fleet.forward`` lane tree per routed request while tracing is enabled —
 ``tools/trace_report.py`` then ranks where failover latency hides.
 ``tools/fleet_drill.py`` measures the whole story as the
 ``fleet_failover`` bench row.
+
+**Cross-process observability (round 16)** — the layers above used to
+stop at the process boundary; three additions carry them across it:
+
+- **trace propagation** — every routed request carries a trace id
+  (client-supplied ``X-Fleet-Trace`` or minted here) downstream on each
+  attempt; the router's ``fleet.route``/``fleet.attempt`` lane trees and
+  the replica's ``serve.request`` trees tag it, and every trace export
+  carries a process-identity header + clock anchor, so
+  ``tools/trace_report.py --stitch`` joins the disjoint per-process
+  exports into one tree per request (retries as sibling attempts, the
+  network/queue gap as a synthetic span);
+- **metrics federation** (:class:`MetricsFederation`) — the router's
+  ``/metrics`` scrapes every replica's full-fidelity ``/metrics.dump``
+  and merges clamped per-replica deltas into one fleet registry
+  (replica-labelled series + exact rollups; a restarted replica's
+  counter reset clamps to a zero delta, never a negative rate; scrape
+  failures are themselves counted per replica);
+- **fleet SLO + status plane** — the router's ``/slo`` evaluates
+  ``default_serving_slos`` over the *federated* window (fleet-wide p99,
+  not any one replica's), and ``/fleet`` serves the one-stop status
+  document ``tools/fleet_status.py`` renders (breaker states, per-tenant
+  fleet rps/p99 from merged histograms, SLO verdicts).
 """
 
 from __future__ import annotations
@@ -85,6 +108,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from dist_svgd_tpu.resilience.backoff import Backoff
 from dist_svgd_tpu.telemetry import metrics as _metrics
 from dist_svgd_tpu.telemetry import trace as _trace
+from dist_svgd_tpu.telemetry.slo import default_serving_slos
 
 __all__ = [
     "TransportError",
@@ -97,6 +121,7 @@ __all__ = [
     "Shed",
     "classify_slo",
     "format_retry_after",
+    "MetricsFederation",
     "ReplicaSet",
     "FleetRouter",
     "CLOSED",
@@ -109,11 +134,14 @@ __all__ = [
 CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
 _STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 
-#: Downstream headers: the remaining per-request budget and the attempt
-#: ordinal, so replicas can bound their own waits and logs can join
-#: retries to one logical request.
+#: Downstream headers: the remaining per-request budget, the attempt
+#: ordinal, and (round 16) the per-request trace id — so replicas can
+#: bound their own waits, logs can join retries to one logical request,
+#: and every hop's spans stitch into one cross-process tree
+#: (``tools/trace_report.py --stitch``).
 DEADLINE_HEADER = "X-Fleet-Deadline-S"
 ATTEMPT_HEADER = "X-Fleet-Attempt"
+TRACE_HEADER = _trace.TRACE_HEADER  # one spelling, shared with server.py
 
 
 class TransportError(RuntimeError):
@@ -238,21 +266,34 @@ class Shed(RuntimeError):
 class LoopbackReplica:
     """In-process stand-in for one ``PredictionServer`` replica: the same
     route surface (``POST /predict``, ``GET /healthz``,
-    ``GET /healthz/<tenant>``, ``GET /slo``) with no jax, no sockets and
-    no threads — tier-1 failover tests drive it through
-    :class:`FakeTransport`.
+    ``GET /healthz/<tenant>``, ``GET /slo``, ``GET /metrics``,
+    ``GET /metrics.dump``) with no jax, no sockets and no threads —
+    tier-1 failover tests drive it through :class:`FakeTransport`.
 
     ``predict_fn(inputs, tenant, headers)`` returns the outputs dict (or
     raises :class:`Shed` to model a 429).  ``slo_status`` and ``draining``
     are plain mutable attributes for tests/drills.  ``flight_trips``
     counts internal crashes (a handler exception → 500) — the partition
     acceptance test asserts it stays 0 while the router ejects the
-    replica, pinning *partition ≠ crash*."""
+    replica, pinning *partition ≠ crash*.
+
+    Observability (round 16): each loopback owns its OWN metrics registry
+    (default: a fresh one — it stands in for a separate process) and
+    writes the real server's series names (``svgd_serve_requests_total``,
+    ``svgd_serve_request_latency_seconds``, ``svgd_serve_shed_total``,
+    ``svgd_http_requests_total``), so the router's federation merges fake
+    and real replicas identically.  Pass ``tracer=`` (a per-replica
+    :class:`~dist_svgd_tpu.telemetry.trace.Tracer`, again standing in for
+    the other process's tracer) and every served predict emits a
+    ``serve.request`` lane tree tagged with the incoming
+    ``X-Fleet-Trace`` id — the replica half of a stitch."""
 
     def __init__(self, name: str,
                  predict_fn: Optional[Callable] = None,
                  tenants: Sequence[str] = (),
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 tracer: Optional[_trace.Tracer] = None):
         self.name = name
         self.tenants = list(tenants)
         self.slo_status = "ok"
@@ -264,12 +305,29 @@ class LoopbackReplica:
         self._predict = predict_fn or (
             lambda inputs, tenant, headers: {
                 "mean": [0.0] * len(inputs)})
+        self.registry = (registry if registry is not None
+                         else _metrics.MetricsRegistry())
+        self.tracer = tracer
+        if tracer is not None:
+            tracer.set_process("replica", name, only_if_default=True)
+        self._m_requests = self.registry.counter(
+            "svgd_serve_requests_total", "requests fully resolved")
+        self._m_latency = self.registry.histogram(
+            "svgd_serve_request_latency_seconds",
+            "request end-to-end latency (enqueue to resolve)")
+        self._m_shed = self.registry.counter(
+            "svgd_serve_shed_total",
+            "requests shed with Overloaded (bounded queue full)")
+        self._m_http = self.registry.counter(
+            "svgd_http_requests_total", "HTTP requests by route and status")
 
     def handle(self, method: str, path: str, body: Optional[bytes],
                headers: Optional[Dict[str, str]]) -> Reply:
         try:
             return self._handle(method, path, body, headers or {})
         except Shed as e:
+            self._m_shed.inc()
+            self._m_http.inc(route="/predict", status=429)
             return _json_reply(429, {"error": str(e),
                                      "retry_after_s": e.retry_after_s},
                                {"Retry-After": _format_retry_after(
@@ -282,7 +340,12 @@ class LoopbackReplica:
         path = path.split("?", 1)[0]
         if method == "POST" and path == "/predict":
             self.requests += 1
-            self.last_headers = {k.lower(): v for k, v in headers.items()}
+            # per-request headers stay LOCAL through the handler: the
+            # loopback serves concurrent requests on many router threads,
+            # and reading the instance attribute after the predict would
+            # tag this request with whichever trace id arrived last
+            hdrs = {k.lower(): v for k, v in headers.items()}
+            self.last_headers = hdrs  # test introspection only
             if self.draining:
                 return _json_reply(503, {"error": "draining"})
             doc = json.loads(body or b"null")
@@ -290,11 +353,38 @@ class LoopbackReplica:
             if inputs is None:
                 return _json_reply(400, {"error": "body needs inputs"})
             tenant = doc.get("tenant") if isinstance(doc, dict) else None
-            out = self._predict(inputs, tenant, self.last_headers)
+            tr = self.tracer
+            t0 = tr.now() if tr is not None else 0.0
+            wall0 = time.perf_counter()
+            out = self._predict(inputs, tenant, hdrs)
+            wall = time.perf_counter() - wall0
+            tl = {} if tenant is None else {"tenant": tenant}
+            self._m_requests.inc(**tl)
+            self._m_latency.observe(wall, **tl)
+            self._m_http.inc(route="/predict", status=200, **tl)
+            if tr is not None:
+                t1 = tr.now()
+                attrs = {"rows": len(inputs), "replica": self.name, **tl}
+                trace_id = hdrs.get(TRACE_HEADER.lower())
+                if trace_id:
+                    attrs["trace"] = trace_id
+                attempt = hdrs.get(ATTEMPT_HEADER.lower())
+                if attempt is not None:
+                    attrs["attempt"] = attempt
+                tr.lane_tree(
+                    "serve.request", t0, t1, attrs,
+                    children=[("serve.dispatch", t0, t1,
+                               {"rows": len(inputs)})])
             payload = {"outputs": out, "replica": self.name}
             if tenant is not None:
                 payload["tenant"] = tenant
             return _json_reply(200, payload)
+        if method == "GET" and path == "/metrics":
+            return Reply(200, {"Content-Type":
+                               "text/plain; version=0.0.4; charset=utf-8"},
+                         self.registry.exposition().encode())
+        if method == "GET" and path == "/metrics.dump":
+            return _json_reply(200, self.registry.dump())
         if method == "GET" and path == "/healthz":
             if self.draining:
                 return _json_reply(503, {"status": "draining"})
@@ -383,6 +473,14 @@ class FakeTransport:
         with self._lock:
             self._forced.pop(replica, None)
             self._forced_slow.pop(replica, None)
+
+    def set_replica(self, replica: str, handler: Any) -> None:
+        """Swap the handler behind ``replica`` — a drill models a process
+        *restart* by installing a FRESH :class:`LoopbackReplica` (new
+        registry, counters back at zero, new tracer), which is exactly
+        what exercises the federation's counter-reset clamping."""
+        with self._lock:
+            self._replicas[replica] = handler
 
     @property
     def ordinal(self) -> int:
@@ -795,6 +893,249 @@ class ReplicaSet:
 
 
 # --------------------------------------------------------------------- #
+# metrics federation
+
+
+class MetricsFederation:
+    """Router-side metrics federation: scrape every replica's
+    full-fidelity registry dump (``GET /metrics.dump``) and merge the
+    **clamped per-replica deltas** into one fleet registry — the
+    Prometheus-federation shape, built on our own registry instead of a
+    scrape stack.
+
+    - counters and histograms accumulate non-negative window deltas per
+      replica (:func:`~dist_svgd_tpu.telemetry.metrics.dump_delta`):
+      merging is **exact** because every registry shares the fixed
+      log-spaced bucket lattice, and a restarted replica's counter reset
+      clamps to a zero delta (slo.py's window-reset discipline) so
+      federated rates never go negative.  Every series lands twice —
+      labelled ``replica=<id>`` and unlabelled (the **fleet rollup**: the
+      sum over replicas);
+    - gauges are last-write-wins under their ``replica=`` label only
+      (summing instantaneous state encodings across processes is not
+      meaningful; rates belong to counters);
+    - a scrape failure (dead/partitioned replica, malformed dump,
+      mismatched buckets) increments
+      ``svgd_fleet_scrape_errors_total{replica=...}`` and leaves that
+      replica's prior contribution standing — federation **degrades
+      visibly, not silently**.  The ``replica`` label rides the shared
+      cardinality guard, so a flapping fleet aggregates into the
+      ``other`` rollup instead of growing the exposition without bound.
+
+    One :meth:`scrape_once` sweep is serialized under the federation lock
+    (two concurrent ``/metrics`` collections must not double-count one
+    window) and its wall is observed into
+    ``svgd_fleet_scrape_seconds`` — the ``federation_scrape_ms`` number
+    the fleet drill rows carry.
+    """
+
+    def __init__(self, replica_set: "ReplicaSet", transport=None, *,
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 fleet_registry: Optional[_metrics.MetricsRegistry] = None,
+                 path: str = "/metrics.dump",
+                 timeout_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.replica_set = replica_set
+        self.transport = transport if transport is not None \
+            else replica_set.transport
+        self.path = path
+        self.timeout_s = float(timeout_s)
+        self._clock = clock
+        #: The federated view: replica-labelled series + fleet rollups.
+        #: Deliberately its OWN registry (never the process default) so
+        #: scraped series cannot collide with the router's own.
+        self.fleet_registry = (fleet_registry if fleet_registry is not None
+                               else _metrics.MetricsRegistry())
+        self._lock = threading.Lock()
+        self._prev: Dict[str, dict] = {}
+        self._scrapes = 0
+        self._skips = 0
+        self._last_wall_ms: Optional[float] = None
+        self._monotone = True
+        self._last_rollup: Dict[str, float] = {}
+        reg = registry if registry is not None else _metrics.default_registry()
+        self._m_errors = reg.counter(
+            "svgd_fleet_scrape_errors_total",
+            "replica /metrics.dump scrapes that failed "
+            "(unreachable replica, malformed dump)")
+        self._m_scrapes = reg.counter(
+            "svgd_fleet_scrapes_total", "federation scrape sweeps")
+        self._m_wall = reg.histogram(
+            "svgd_fleet_scrape_seconds", "one federation sweep's wall")
+
+    def _validate_delta(self, delta: dict) -> None:
+        """Reject a delta the fleet registry could not ingest atomically —
+        BEFORE any series is applied, so a bad dump never leaves the
+        replica-labelled and rollup views half-updated."""
+        for name, entry in delta.get("metrics", {}).items():
+            if not _metrics._NAME_OK.match(str(name)):
+                # the registry's own name gate, applied up front: ingest
+                # hitting it MID-dump would leave earlier metrics applied
+                raise ValueError(f"dump carries invalid metric name "
+                                 f"{name!r}")
+            kind = entry.get("kind")
+            if kind not in ("counter", "gauge", "histogram"):
+                raise ValueError(
+                    f"dump entry {name!r} has unknown kind {kind!r}")
+            existing = self.fleet_registry.get(name)
+            if existing is not None and existing.kind != kind:
+                raise ValueError(
+                    f"dump entry {name!r} is a {kind}; the fleet registry "
+                    f"holds a {existing.kind} under that name")
+            for s in entry.get("series", []):
+                labels = s.get("labels")
+                if labels is not None and not isinstance(labels, dict):
+                    raise ValueError(
+                        f"{name!r} series labels must be an object")
+            if kind in ("counter", "gauge"):
+                for s in entry.get("series", []):
+                    value = s.get("value", 0)
+                    if not isinstance(value, (int, float)):
+                        raise ValueError(
+                            f"{kind} {name!r} has non-numeric value "
+                            f"{value!r}")
+                    if kind == "counter" and value < 0:
+                        raise ValueError(
+                            f"counter {name!r} delta went negative")
+            elif kind == "histogram":
+                dumped = entry.get("buckets")
+                bounds = (tuple(dumped) if dumped is not None
+                          else getattr(existing, "buckets",
+                                       _metrics.LATENCY_BUCKETS_S))
+                if (existing is not None
+                        and tuple(bounds) != tuple(existing.buckets)):
+                    raise ValueError(
+                        f"histogram {name!r}: dump buckets do not match "
+                        "the fleet lattice")
+                for s in entry.get("series", []):
+                    counts = s.get("counts", [])
+                    if len(counts) != len(bounds) + 1:
+                        raise ValueError(
+                            f"histogram {name!r}: series has "
+                            f"{len(counts)} bucket counts, "
+                            f"lattice needs {len(bounds) + 1}")
+                    if not all(isinstance(c, (int, float))
+                               for c in counts) or not isinstance(
+                                   s.get("sum", 0.0), (int, float)):
+                        raise ValueError(
+                            f"histogram {name!r} has non-numeric counts")
+
+    def scrape_once(self) -> Dict[str, Any]:
+        """One federation sweep; returns ``{"wall_ms", "scraped": [...],
+        "skipped": [...], "errors": {replica: reason}}``.
+
+        Replicas whose circuit is already OPEN are **skipped**, not
+        scraped: their prior contribution stands either way, and paying
+        ``timeout_s`` per known-dead replica on every ``/metrics``
+        collection would stall a scraper ``dead × timeout`` seconds
+        through a whole outage (the breaker's probes own readmission —
+        scraping resumes the sweep after they re-close the circuit).
+        Failures on replicas still believed healthy ARE counted — that's
+        the visible-degradation window between a death and its
+        detection."""
+        with self._lock:
+            t0 = self._clock()
+            scraped: List[str] = []
+            skipped: List[str] = []
+            errors: Dict[str, str] = {}
+            for rid in self.replica_set.replica_ids():
+                if self.replica_set.state(rid) == OPEN:
+                    skipped.append(rid)
+                    continue
+                try:
+                    reply = self.transport.request(
+                        rid, "GET", self.path, timeout_s=self.timeout_s)
+                    if reply.status != 200:
+                        raise TransportError(
+                            f"{self.path} answered {reply.status}")
+                    doc = reply.json()
+                    if not isinstance(doc, dict) or "metrics" not in doc:
+                        raise ValueError("reply is not a metrics dump")
+                    delta = _metrics.dump_delta(self._prev.get(rid), doc)
+                    # validate → ingest → only THEN advance the window:
+                    # a rejected dump must leave the replica's prior
+                    # contribution standing and its window un-consumed
+                    # (advancing _prev on failure would silently drop the
+                    # failed window's counts forever)
+                    self._validate_delta(delta)
+                    # replica-labelled series AND the unlabelled rollup;
+                    # gauges only under their replica identity.  A
+                    # replica's own SLO verdict mirrors (svgd_slo_*) stay
+                    # replica-labelled ONLY: the router's fleet SLO
+                    # engine writes the unlabelled {slo=...} series in
+                    # this same registry, and rolling replica-local
+                    # verdicts into it would conflate per-engine breach
+                    # counts with the fleet verdict
+                    self.fleet_registry.ingest(delta, labels={"replica": rid})
+                    rollup = {"metrics": {
+                        n: e for n, e in delta.get("metrics", {}).items()
+                        if not n.startswith("svgd_slo_")}}
+                    self.fleet_registry.ingest(rollup, skip_gauges=True)
+                    self._prev[rid] = doc
+                    scraped.append(rid)
+                except Exception as e:
+                    errors[rid] = f"{type(e).__name__}: {e}"
+                    self._m_errors.inc(replica=rid)
+            wall = self._clock() - t0
+            self._scrapes += 1
+            self._skips += len(skipped)
+            self._last_wall_ms = wall * 1e3
+            # monotonicity audit over the ROLLUP series (everything not
+            # carrying the replica identity — the federated totals): an
+            # assert-style invariant detector.  Add-only ingest plus
+            # clamped deltas make a decrease unreachable today; if a
+            # future change breaks either half, this flips the drill's
+            # federation_monotone gate instead of silently shipping
+            # negative rates
+            with self.fleet_registry._lock:
+                fed_metrics = dict(self.fleet_registry._metrics)
+            for name, metric in fed_metrics.items():
+                if not isinstance(metric, _metrics.Counter):
+                    continue
+                value = float(sum(
+                    metric.value(**ls) for ls in metric.label_sets()
+                    if "replica" not in ls))
+                if value < self._last_rollup.get(name, 0.0):
+                    self._monotone = False
+                self._last_rollup[name] = value
+        self._m_scrapes.inc()
+        self._m_wall.observe(wall)
+        return {"wall_ms": round(wall * 1e3, 3), "scraped": scraped,
+                "skipped": skipped, "errors": errors}
+
+    @property
+    def scrapes(self) -> int:
+        with self._lock:
+            return self._scrapes
+
+    @property
+    def skips(self) -> int:
+        """Cumulative open-circuit replicas skipped across sweeps."""
+        with self._lock:
+            return self._skips
+
+    @property
+    def monotone(self) -> bool:
+        """False if any federated counter rollup ever decreased between
+        sweeps (must stay True — clamping exists exactly for this)."""
+        with self._lock:
+            return self._monotone
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {"scrapes": self._scrapes,
+                   "skipped": self._skips,
+                   "last_scrape_ms": (None if self._last_wall_ms is None
+                                      else round(self._last_wall_ms, 3)),
+                   "monotone": self._monotone}
+        out["scrape_errors"] = {
+            rid: self._m_errors.value(replica=rid)
+            for rid in self.replica_set.replica_ids()
+            if self._m_errors.value(replica=rid) > 0}
+        return out
+
+
+# --------------------------------------------------------------------- #
 # consistent hashing
 
 
@@ -903,6 +1244,9 @@ class FleetRouter:
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
                  registry: Optional[_metrics.MetricsRegistry] = None,
+                 slo_p99_ms: float = 100.0,
+                 slo_min_interval_s: float = 5.0,
+                 federation_timeout_s: float = 1.0,
                  host: str = "127.0.0.1",
                  port: Optional[int] = None):
         if isinstance(replicas, dict) and transport is None:
@@ -952,6 +1296,25 @@ class FleetRouter:
             "requests served by a non-home replica")
         self._m_latency = reg.histogram(
             "svgd_fleet_route_seconds", "end-to-end routed request wall")
+
+        #: Metrics federation over the fleet (round 16): ``/metrics``
+        #: scrapes-on-collect and serves the router's own series plus the
+        #: per-replica-labelled + rollup federated view; ``/slo``
+        #: evaluates the serving objectives over the *federated* window.
+        self.federation = MetricsFederation(
+            self.replica_set, self.transport, registry=reg,
+            timeout_s=federation_timeout_s, clock=clock)
+        self.slo_engine = default_serving_slos(
+            self.federation.fleet_registry, p99_ms=slo_p99_ms,
+            aggregate=True)
+        #: The SLO objectives are stateful windows; N concurrent pollers
+        #: (an alerting scraper on /slo + an operator looping /fleet)
+        #: would slice one window into N slivers and make burn rates
+        #: flap.  evaluate_slo() therefore caches the verdict for
+        #: ``slo_min_interval_s`` — every consumer sees windows at least
+        #: that wide no matter how many poll.
+        self.slo_min_interval_s = float(slo_min_interval_s)
+        self._slo_cache: Optional[Tuple[float, Dict[str, Any]]] = None
 
         self._httpd = None
         self._serve_thread = None
@@ -1091,9 +1454,17 @@ class FleetRouter:
 
     def route(self, tenant: str, body: bytes,
               deadline_s: Optional[float] = None,
-              method: str = "POST", path: str = "/predict") -> RouteResult:
+              method: str = "POST", path: str = "/predict",
+              trace: Optional[str] = None) -> RouteResult:
         """Forward one request for ``tenant`` through the robustness kit.
-        Never raises — every failure mode maps to a status code."""
+        Never raises — every failure mode maps to a status code.
+
+        ``trace`` is the request's cross-process trace id: taken from the
+        client's ``X-Fleet-Trace`` header when present, minted here
+        otherwise, and sent downstream on every attempt — the join key
+        ``tools/trace_report.py --stitch`` reassembles router→replica
+        trees on."""
+        trace_id = trace or _trace.mint_trace_id()
         t_start = self._clock()
         deadline = t_start + (deadline_s if deadline_s is not None
                               else self.default_deadline_s)
@@ -1122,7 +1493,8 @@ class FleetRouter:
             timeout_s = min(self.per_try_timeout_s, remaining)
             headers = {"Content-Type": "application/json",
                        DEADLINE_HEADER: f"{remaining:.3f}",
-                       ATTEMPT_HEADER: str(attempts - 1)}
+                       ATTEMPT_HEADER: str(attempts - 1),
+                       TRACE_HEADER: trace_id}
             a0 = tracer.now() if tracer is not None else 0.0
             try:
                 reply, served_by, was_hedged = self._attempt(
@@ -1133,7 +1505,8 @@ class FleetRouter:
                 if tracer is not None:
                     children.append(("fleet.attempt", a0, a1,
                                      {"n": attempts - 1, "replica": rid,
-                                      "error": type(e).__name__}))
+                                      "error": type(e).__name__,
+                                      "trace": trace_id}))
                 reason = ("connect" if isinstance(e, ConnectError)
                           else "timeout" if isinstance(e, RequestTimeout)
                           else "transport")
@@ -1151,7 +1524,8 @@ class FleetRouter:
                 children.append(("fleet.attempt", a0, a1,
                                  {"n": attempts - 1, "replica": served_by,
                                   "status": reply.status,
-                                  "hedged": was_hedged}))
+                                  "hedged": was_hedged,
+                                  "trace": trace_id}))
                 children.append(("fleet.forward", a0, a1,
                                  {"replica": served_by}))
             if reply.status == 429:
@@ -1217,7 +1591,7 @@ class FleetRouter:
                 "fleet.route", tr0, tr1,
                 {"tenant": tenant, "status": result.status,
                  "attempts": attempts, "outcome": result.outcome,
-                 "replica": result.replica},
+                 "replica": result.replica, "trace": trace_id},
                 children=children)
         return result
 
@@ -1241,6 +1615,67 @@ class FleetRouter:
             "replicas_closed": n_up,
             "replicas_total": len(states),
         }
+
+    def evaluate_slo(self, scrape: bool = True) -> Dict[str, Any]:
+        """The fleet SLO verdict over the federated window, cached for
+        ``slo_min_interval_s`` (see the constructor note: concurrent
+        pollers must not slice the objectives' windows into slivers).
+        ``scrape=False`` skips the federation sweep when the caller just
+        ran one."""
+        now = self._clock()
+        with self._lock:
+            cached = self._slo_cache
+        if (cached is not None
+                and now - cached[0] < self.slo_min_interval_s):
+            return cached[1]
+        if scrape:
+            self.federation.scrape_once()
+        doc = self.slo_engine.evaluate()
+        with self._lock:
+            self._slo_cache = (now, doc)
+        return doc
+
+    def fleet_status(self, scrape: bool = True) -> Dict[str, Any]:
+        """One structured fleet-status document (served at ``/fleet``;
+        ``tools/fleet_status.py`` renders it): breaker states, federation
+        health, per-tenant fleet-wide request counts and latency
+        percentiles from the **merged** histograms (the rollup series —
+        no single replica could answer these), and the SLO verdicts over
+        the federated window.  ``scrape=True`` runs one federation sweep
+        first so the numbers are current."""
+        scrape_info = self.federation.scrape_once() if scrape else None
+        slo_doc = self.evaluate_slo(scrape=False)
+        fed = self.federation.fleet_registry
+        tenants: Dict[str, Any] = {}
+        lat = fed.get("svgd_serve_request_latency_seconds")
+        req = fed.get("svgd_serve_requests_total")
+        if isinstance(lat, _metrics.Histogram):
+            for labels in lat.label_sets():
+                if "replica" in labels:
+                    continue  # per-replica detail stays in /metrics
+                name = labels.get("tenant", "") or "(default)"
+                s = lat.summary(scale=1e3, **labels)
+                tenants[name] = {
+                    "requests": s["count"],
+                    "p50_ms": s["p50"], "p99_ms": s["p99"],
+                }
+        if isinstance(req, _metrics.Counter):
+            for labels in req.label_sets():
+                if "replica" in labels:
+                    continue
+                name = labels.get("tenant", "") or "(default)"
+                tenants.setdefault(name, {})["requests_total"] = (
+                    req.value(**labels))
+        doc = self.health()
+        doc.update(
+            ts=time.time(),
+            federation=self.federation.stats(),
+            tenants=tenants,
+            slo=slo_doc,
+        )
+        if scrape_info is not None:
+            doc["federation"]["last_sweep"] = scrape_info
+        return doc
 
     # ---- HTTP front door ---------------------------------------------- #
 
@@ -1274,11 +1709,28 @@ class FleetRouter:
                 elif path == "/replicas":
                     self._write_json(200, router.replica_set.stats())
                 elif path == "/metrics":
+                    # scrape-on-collect federation (the Prometheus
+                    # federation convention): one sweep over the live
+                    # replicas, then the router's own series plus the
+                    # replica-labelled + rollup federated view in ONE
+                    # document (names dedup toward the router's)
+                    router.federation.scrape_once()
                     self._write(
                         200,
                         {"Content-Type":
                          "text/plain; version=0.0.4; charset=utf-8"},
-                        router.registry.exposition().encode())
+                        _metrics.combined_exposition(
+                            router.registry,
+                            router.federation.fleet_registry).encode())
+                elif path == "/slo":
+                    # the fleet SLO: the same declarative objectives the
+                    # replicas evaluate locally, judged over the
+                    # FEDERATED window — fleet-wide p99 for the fleet
+                    # (verdict cached slo_min_interval_s against
+                    # window-slicing by concurrent pollers)
+                    self._write_json(200, router.evaluate_slo())
+                elif path == "/fleet":
+                    self._write_json(200, router.fleet_status())
                 else:
                     self._write_json(404, {"error": f"no route {self.path}"})
 
@@ -1301,7 +1753,8 @@ class FleetRouter:
                         deadline_s = max(float(raw), 0.001)
                     except ValueError:
                         pass
-                res = router.route(tenant, body, deadline_s=deadline_s)
+                res = router.route(tenant, body, deadline_s=deadline_s,
+                                   trace=self.headers.get(TRACE_HEADER))
                 self._write(res.status, res.headers, res.body)
 
         return Handler
@@ -1316,6 +1769,11 @@ class FleetRouter:
     def start(self) -> "FleetRouter":
         """Start the probe thread and (when built with ``port=``) the HTTP
         front door."""
+        tracer = _trace.get_tracer()
+        if tracer is not None:
+            # stitchers label this process's export off the tracer's
+            # process header; an identity a drill already set wins
+            tracer.set_process("router", "router", only_if_default=True)
         self.replica_set.start()
         if self._httpd is not None and self._serve_thread is None:
             self._serve_thread = threading.Thread(

@@ -408,15 +408,18 @@ class ModelRegistry:
     # ------------------------------------------------------------------ #
     # request path
 
-    def submit(self, name: str, x):
-        """Enqueue one request for tenant ``name``; returns the future."""
+    def submit(self, name: str, x, trace: Optional[str] = None):
+        """Enqueue one request for tenant ``name``; returns the future.
+        ``trace`` is the cross-process trace id (see
+        :meth:`MicroBatcher.submit`) — the HTTP layer passes the
+        ``X-Fleet-Trace`` header through here."""
         with self._lock:
             tenant = self._tenants.get(name)
         if tenant is None:
             raise KeyError(f"unknown tenant {name!r}")
         if tenant.state != "serving":
             raise KeyError(f"tenant {name!r} is {tenant.state}")
-        return self.batcher.submit(x, tenant=name)
+        return self.batcher.submit(x, tenant=name, trace=trace)
 
     def predict(self, name: str, x, timeout: Optional[float] = 30.0):
         """Blocking convenience: ``submit(...).result(timeout)``."""

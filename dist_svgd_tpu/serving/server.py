@@ -190,6 +190,12 @@ class PredictionServer:
                         200, server.registry.exposition(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
+                elif path == "/metrics.dump":
+                    # full-fidelity registry dump (raw histogram bucket
+                    # counts) — the fleet federation's scrape format:
+                    # exact cross-replica merging needs buckets, which
+                    # the Prometheus text above quantises into exposition
+                    self._reply(200, server.registry.dump())
                 elif path == "/metrics.json":
                     self._reply(200, server.metrics())
                 elif path == "/slo":
@@ -212,9 +218,14 @@ class PredictionServer:
                         deadline_s = max(float(raw), 1e-3)
                     except ValueError:
                         pass
-                with _trace.span("http.predict"):
+                # the router's trace id: joins this replica's spans to the
+                # router's fleet.route tree at stitch time
+                trace_id = self.headers.get(_trace.TRACE_HEADER) or None
+                with _trace.span("http.predict",
+                                 {"trace": trace_id} if trace_id else None):
                     code, payload, rows, tenant, extra = server._predict(
-                        self._read_body(), timeout_s=deadline_s)
+                        self._read_body(), timeout_s=deadline_s,
+                        trace=trace_id)
                 wall = time.perf_counter() - t0
                 payload.setdefault("latency_ms", round(wall * 1e3, 3))
                 self._reply(code, payload, extra)
@@ -254,10 +265,13 @@ class PredictionServer:
         host, port = self.address[:2]
         return f"http://{host}:{port}"
 
-    def _predict(self, body: bytes, timeout_s: Optional[float] = None):
+    def _predict(self, body: bytes, timeout_s: Optional[float] = None,
+                 trace: Optional[str] = None):
         """Returns ``(status_code, payload, rows, tenant, headers)``;
         never raises.  ``timeout_s`` (a router-propagated deadline) caps
-        the future wait below the server's own ``request_timeout_s``."""
+        the future wait below the server's own ``request_timeout_s``;
+        ``trace`` (the ``X-Fleet-Trace`` header) threads through to the
+        batcher's request lane tree."""
         from concurrent.futures import CancelledError
         from concurrent.futures import TimeoutError as FuturesTimeout
 
@@ -295,13 +309,14 @@ class PredictionServer:
         try:
             if self.model_registry is not None:
                 try:
-                    future = self.model_registry.submit(tenant, x)
+                    future = self.model_registry.submit(tenant, x,
+                                                        trace=trace)
                 except KeyError as e:
                     with self._lock:
                         self._errors += 1
                     return 404, {"error": str(e)}, 0, tenant, None
             else:
-                future = self.batcher.submit(x)
+                future = self.batcher.submit(x, trace=trace)
             wait_s = self._request_timeout_s
             if timeout_s is not None:
                 wait_s = min(wait_s, timeout_s)
@@ -401,6 +416,13 @@ class PredictionServer:
 
     def start(self) -> "PredictionServer":
         """Serve in a background thread (returns self for chaining)."""
+        tracer = _trace.get_tracer()
+        if tracer is not None:
+            # best-effort self-labelling for trace stitching: a drill/CLI
+            # that already declared an identity wins (only_if_default)
+            host, port = self.address[:2]
+            tracer.set_process("replica", f"{host}:{port}",
+                               only_if_default=True)
         if self._serve_thread is None:
             self._serve_thread = threading.Thread(
                 target=self._httpd.serve_forever, name="http-serve", daemon=True
@@ -495,6 +517,14 @@ def main(argv=None):
     ap.add_argument("--max-queue-rows", type=int, default=8192)
     ap.add_argument("--request-log", default=None,
                     help="JSONL per-request record path (utils/metrics.py)")
+    ap.add_argument("--trace-export", default=None, metavar="PATH",
+                    help="enable the span tracer for this replica's "
+                         "lifetime and export a Chrome trace here on "
+                         "shutdown (the replica-side half of a fleet "
+                         "stitch — tools/trace_report.py --stitch)")
+    ap.add_argument("--replica-name", default=None,
+                    help="process-identity name stamped into trace "
+                         "exports (default host:port)")
     ap.add_argument("--warmup", default=True,
                     action=argparse.BooleanOptionalAction,
                     help="pre-trace every padding bucket up to max-batch "
@@ -544,8 +574,21 @@ def main(argv=None):
             lanes=args.lanes, max_wait_ms=args.max_wait_ms,
             max_queue_rows=args.max_queue_rows, logger=logger,
         )
+    if args.trace_export:
+        from dist_svgd_tpu import telemetry
+
+        tracer = telemetry.enable()
+        tracer.set_process(
+            "replica",
+            args.replica_name or f"{args.host}:{args.port}")
     print(json.dumps({"serving": srv.url, **srv.health()}), flush=True)
-    srv.serve_forever()
+    try:
+        srv.serve_forever()
+    finally:
+        if args.trace_export:
+            tracer = telemetry.disable()
+            if tracer is not None:
+                tracer.export_chrome(args.trace_export)
 
 
 if __name__ == "__main__":

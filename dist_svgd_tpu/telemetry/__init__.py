@@ -39,9 +39,12 @@ from dist_svgd_tpu.telemetry.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    combined_exposition,
     default_registry,
+    dump_delta,
 )
 from dist_svgd_tpu.telemetry.trace import (
+    TRACE_HEADER,
     FlightRecorder,
     SpanHandle,
     Tracer,
@@ -49,21 +52,30 @@ from dist_svgd_tpu.telemetry.trace import (
     enable,
     enabled,
     flight_recorder,
+    get_trace_context,
     get_tracer,
     install_flight_recorder,
     instant,
+    mint_trace_id,
     record_flight,
+    set_trace_context,
     span,
     uninstall_flight_recorder,
 )
 
 __all__ = [
     "LATENCY_BUCKETS_S",
+    "TRACE_HEADER",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "combined_exposition",
     "default_registry",
+    "dump_delta",
+    "get_trace_context",
+    "mint_trace_id",
+    "set_trace_context",
     "FlightRecorder",
     "SpanHandle",
     "Tracer",

@@ -49,14 +49,21 @@ from typing import Dict, Iterable, Optional, Tuple
 
 __all__ = [
     "DEFAULT_MAX_LABEL_SETS",
+    "DUMP_FORMAT",
     "LATENCY_BUCKETS_S",
     "OTHER_LABEL_VALUE",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "combined_exposition",
     "default_registry",
+    "dump_delta",
 ]
+
+#: Wire-format tag of :meth:`MetricsRegistry.dump` (the full-fidelity
+#: snapshot the fleet federation scrapes at ``/metrics.dump``).
+DUMP_FORMAT = "svgd-metrics-dump-1"
 
 #: Default per-metric bound on distinct label sets — generous for the
 #: repo's own labels (tenants × lanes × routes stay well under it) while
@@ -167,6 +174,13 @@ class _Metric:
         ``no_data`` vs ``ok``)."""
         with self._lock:
             return _label_key(labels) in self._series
+
+    def label_sets(self) -> list:
+        """Every written label set, as dicts — the introspection surface
+        federation/status tooling enumerates series with (pair it with
+        ``value(**labels)`` / ``summary(**labels)``)."""
+        with self._lock:
+            return [dict(k) for k in self._series]
 
 
 class Counter(_Metric):
@@ -281,6 +295,30 @@ class Histogram(_Metric):
             series.counts[i] += 1
             series.sum += value
             series.count += 1
+        if warn:
+            self._warn_overflow()
+
+    def merge_series(self, counts: Iterable[int], sum: float, count: int,
+                     **labels) -> None:
+        """Add one dumped series (raw per-bucket counts + sum + count) into
+        this histogram — **exact** because every registry shares the same
+        fixed bucket lattice; a mismatched bucket count raises (the
+        federation surfaces it as a scrape error, never a silent skew)."""
+        counts = list(counts)
+        if len(counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"histogram {self.name}: cannot merge {len(counts)} bucket "
+                f"counts into {len(self.buckets) + 1} buckets"
+            )
+        with self._lock:
+            key, warn = self._admit(_label_key(labels))
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = _HistSeries(len(self.buckets) + 1)
+            for i, c in enumerate(counts):
+                series.counts[i] += c
+            series.sum += sum
+            series.count += count
         if warn:
             self._warn_overflow()
 
@@ -433,6 +471,87 @@ class MetricsRegistry:
             lines.extend(metric._render())
         return "\n".join(lines) + ("\n" if lines else "")
 
+    def get(self, name: str) -> Optional[_Metric]:
+        """The metric registered under ``name`` (None when absent) — the
+        read-only peek the SLO engine and the fleet federation use."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def dump(self) -> dict:
+        """Full-fidelity JSON-safe snapshot — unlike :meth:`snapshot`,
+        histograms keep their **raw per-bucket counts**, so two dumps from
+        registries sharing the fixed bucket lattice merge *exactly*
+        (:meth:`ingest`).  This is the fleet federation's wire format
+        (served at ``/metrics.dump``)."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        out: dict = {"format": DUMP_FORMAT, "metrics": {}}
+        for metric in metrics:
+            entry: dict = {"kind": metric.kind, "help": metric.help}
+            with metric._lock:
+                if isinstance(metric, Histogram):
+                    entry["buckets"] = list(metric.buckets)
+                    entry["series"] = [
+                        {"labels": dict(k), "counts": list(s.counts),
+                         "sum": s.sum, "count": s.count}
+                        for k, s in metric._series.items()
+                    ]
+                else:
+                    entry["series"] = [{"labels": dict(k), "value": v}
+                                       for k, v in metric._series.items()]
+            out["metrics"][metric.name] = entry
+        return out
+
+    def ingest(self, dump: dict, labels: Optional[dict] = None,
+               skip_gauges: bool = False) -> None:
+        """Merge a :meth:`dump` document into this registry.
+
+        Counters and histogram series **add** (repeated ingests accumulate
+        — pass per-scrape *deltas* from :func:`dump_delta` for federation
+        semantics); gauges **set** (last write wins — instantaneous values
+        do not sum meaningfully, so a federation rollup passes
+        ``skip_gauges=True`` on its unlabelled pass).  ``labels`` adds
+        extra label pairs to every ingested series (the federation's
+        ``replica=`` identity); they route through the cardinality guard
+        like any other label set."""
+        extra = dict(labels or {})
+        for name, entry in dump.get("metrics", {}).items():
+            kind = entry.get("kind")
+            help_ = entry.get("help", "")
+            series = entry.get("series", [])
+            if kind == "counter":
+                m = self.counter(name, help_)
+                for s in series:
+                    m.inc(s.get("value", 0) or 0,
+                          **{**(s.get("labels") or {}), **extra})
+            elif kind == "gauge":
+                if skip_gauges:
+                    continue
+                m = self.gauge(name, help_)
+                for s in series:
+                    m.set(s.get("value", 0.0) or 0.0,
+                          **{**(s.get("labels") or {}), **extra})
+            elif kind == "histogram":
+                m = self.histogram(name, help_, buckets=entry.get("buckets"))
+                dumped = entry.get("buckets")
+                if dumped is not None and tuple(dumped) != tuple(m.buckets):
+                    # get-or-create returned an EXISTING histogram whose
+                    # lattice the buckets= argument cannot change: merging
+                    # same-length-but-different-boundary lattices would
+                    # silently skew every quantile — refuse instead (the
+                    # federation surfaces it as a scrape error)
+                    raise ValueError(
+                        f"histogram {name!r}: dump buckets {dumped} do not "
+                        f"match this registry's lattice {list(m.buckets)}")
+                for s in series:
+                    m.merge_series(s.get("counts", []),
+                                   s.get("sum", 0.0) or 0.0,
+                                   s.get("count", 0) or 0,
+                                   **{**(s.get("labels") or {}), **extra})
+            else:
+                raise ValueError(
+                    f"dump entry {name!r} has unknown kind {kind!r}")
+
     def snapshot(self) -> dict:
         """JSON-friendly dump: counters/gauges as scalars (labelled series
         keyed ``name{k="v"}``), histograms as their ms-scaled summaries."""
@@ -452,6 +571,132 @@ class MetricsRegistry:
                 for key, value in series.items():
                     out[name + _format_labels(key)] = value
         return out
+
+
+def _series_by_labels(entry: dict) -> Dict[_LabelKey, dict]:
+    return {_label_key(s.get("labels") or {}): s
+            for s in entry.get("series", [])}
+
+
+def dump_delta(prev: Optional[dict], cur: dict) -> dict:
+    """The per-series window delta between two :meth:`MetricsRegistry.dump`
+    documents of ONE source registry — what a federation ingests per
+    scrape.
+
+    Counters and histograms yield **non-negative deltas**: a series whose
+    total went *down* means the source process restarted (counters reset
+    to zero), and the delta **clamps to zero** — the same window-reset
+    discipline ``telemetry/slo.py`` applies (``max(now - before, 0)``), so
+    federated rates dip to zero across a restart instead of going
+    negative.  Gauges pass through current values unchanged (last write
+    wins at ingest).  ``prev=None`` (the first scrape) yields ``cur``
+    whole — cumulative-since-start, the first-window convention."""
+    if prev is None:
+        return cur
+    out: dict = {"format": cur.get("format", DUMP_FORMAT), "metrics": {}}
+    prev_metrics = prev.get("metrics", {})
+    for name, entry in cur.get("metrics", {}).items():
+        kind = entry.get("kind")
+        pentry = prev_metrics.get(name)
+        if kind == "gauge" or pentry is None or pentry.get("kind") != kind:
+            out["metrics"][name] = entry
+            continue
+        prev_series = _series_by_labels(pentry)
+        new_series = []
+        for s in entry.get("series", []):
+            p = prev_series.get(_label_key(s.get("labels") or {}))
+            if kind == "counter":
+                base = (p.get("value", 0) or 0) if p else 0
+                delta = max((s.get("value", 0) or 0) - base, 0)
+                new_series.append({"labels": s.get("labels") or {},
+                                   "value": delta})
+            else:  # histogram
+                cur_counts = list(s.get("counts", []))
+                cur_count = s.get("count", 0) or 0
+                if p is None:
+                    new_series.append(dict(s))
+                    continue
+                prev_counts = list(p.get("counts", []))
+                if len(prev_counts) != len(cur_counts):
+                    new_series.append(dict(s))
+                    continue
+                if (cur_count < (p.get("count", 0) or 0)
+                        or any(c < q for c, q in zip(cur_counts,
+                                                     prev_counts))):
+                    # whole-series reset: ANY decrease — total count OR a
+                    # single bucket — clamps the entire window to zero.
+                    # (A restart masked by growth can keep the total count
+                    # rising while individual buckets shrink; per-bucket
+                    # clamping there would emit a delta whose bucket sum
+                    # disagrees with its count — an inconsistent
+                    # histogram skewing every federated quantile.)
+                    new_series.append({"labels": s.get("labels") or {},
+                                       "counts": [0] * len(cur_counts),
+                                       "sum": 0.0, "count": 0})
+                    continue
+                new_series.append({
+                    "labels": s.get("labels") or {},
+                    "counts": [c - q
+                               for c, q in zip(cur_counts, prev_counts)],
+                    "sum": max((s.get("sum", 0.0) or 0.0)
+                               - (p.get("sum", 0.0) or 0.0), 0.0),
+                    "count": cur_count - (p.get("count", 0) or 0),
+                })
+        delta_entry = {"kind": kind, "help": entry.get("help", ""),
+                       "series": new_series}
+        if kind == "histogram" and "buckets" in entry:
+            delta_entry["buckets"] = entry["buckets"]
+        out["metrics"][name] = delta_entry
+    return out
+
+
+def combined_exposition(*registries: MetricsRegistry) -> str:
+    """One Prometheus text document over several registries (the fleet
+    router's ``/metrics``: its own series + the federated fleet view).
+
+    A metric name appearing in several registries renders as ONE block
+    (two blocks under one name would be a malformed exposition): the
+    earlier registry contributes its header and samples, later registries
+    **append the series the block doesn't already carry** — so a name both
+    processes emit (a router that traces has its own
+    ``svgd_trace_dropped_total`` while the federation holds the replicas'
+    ``{replica=...}`` series of the same name) keeps every distinct
+    series visible instead of dropping the federated view wholesale.  On
+    an identical series identity the earlier registry wins (the router's
+    unlabelled series means *this process*; a same-name unlabelled rollup
+    from elsewhere is ambiguous and defers).  A later registry whose
+    metric has a different *kind* under the name is skipped entirely."""
+    blocks: Dict[str, dict] = {}
+    order: list = []
+    for reg in registries:
+        with reg._lock:
+            metrics = [reg._metrics[k] for k in sorted(reg._metrics)]
+        for metric in metrics:
+            rendered = metric._render()
+            headers = [ln for ln in rendered if ln.startswith("# ")]
+            samples = [ln for ln in rendered if not ln.startswith("# ")]
+            block = blocks.get(metric.name)
+            if block is None:
+                blocks[metric.name] = {
+                    "kind": metric.kind, "headers": headers,
+                    "samples": list(samples),
+                    "series": {ln.rsplit(" ", 1)[0] for ln in samples},
+                }
+                order.append(metric.name)
+                continue
+            if block["kind"] != metric.kind:
+                continue
+            for ln in samples:
+                sid = ln.rsplit(" ", 1)[0]
+                if sid not in block["series"]:
+                    block["series"].add(sid)
+                    block["samples"].append(ln)
+    lines: list = []
+    for name in order:
+        block = blocks[name]
+        lines.extend(block["headers"])
+        lines.extend(block["samples"])
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 _DEFAULT = MetricsRegistry()

@@ -68,19 +68,44 @@ class _Objective:
         raise NotImplementedError
 
 
+#: Label keys an aggregate-mode objective skips: a federated registry
+#: carries every series twice (``replica=``-labelled + rollup), and
+#: summing both would double-count the fleet.
+AGGREGATE_EXCLUDE_KEYS = ("replica",)
+
+
+def _aggregate_label_sets(metric) -> list:
+    return [ls for ls in metric.label_sets()
+            if not any(k in ls for k in AGGREGATE_EXCLUDE_KEYS)]
+
+
 def _count_delta(registry: MetricsRegistry, name: str, labels: dict,
-                 prev: Dict, key: str) -> Optional[float]:
+                 prev: Dict, key: str,
+                 aggregate: bool = False) -> Optional[float]:
     """Windowed total of a Counter (value) or Histogram (observation
     count) since the previous evaluation; ``None`` when the metric was
-    never registered."""
+    never registered.  ``aggregate=True`` sums across every label set
+    (minus :data:`AGGREGATE_EXCLUDE_KEYS`) instead of reading one — the
+    fleet-SLO mode, where traffic lives in tenant-labelled rollups."""
     metric = registry._metrics.get(name)  # read-only peek, same package
     if metric is None:
         return None
     if isinstance(metric, Counter):
-        now = metric.value(**labels)
+        if aggregate:
+            now = float(sum(metric.value(**ls)
+                            for ls in _aggregate_label_sets(metric)))
+        else:
+            now = metric.value(**labels)
     elif isinstance(metric, Histogram):
-        series = metric._snapshot(labels)
-        now = float(series.count) if series is not None else 0.0
+        if aggregate:
+            now = 0.0
+            for ls in _aggregate_label_sets(metric):
+                series = metric._snapshot(ls)
+                if series is not None:
+                    now += series.count
+        else:
+            series = metric._snapshot(labels)
+            now = float(series.count) if series is not None else 0.0
     else:
         raise ValueError(f"metric {name!r} is not a counter or histogram")
     before = prev.get(key, 0.0)
@@ -90,10 +115,17 @@ def _count_delta(registry: MetricsRegistry, name: str, labels: dict,
 
 class LatencyObjective(_Objective):
     """``target`` fraction of observations must land at or under
-    ``threshold_s``, judged per evaluation window."""
+    ``threshold_s``, judged per evaluation window.
+
+    ``aggregate=True`` sums bucket counts across every label set of the
+    histogram (minus :data:`AGGREGATE_EXCLUDE_KEYS`) before windowing —
+    the **fleet-SLO mode**: a federated registry holds per-tenant rollup
+    series, and the fleet-wide p99 is judged over their exact bucket sum
+    (same lattice, so the sum is itself a valid histogram)."""
 
     def __init__(self, name: str, histogram: str, threshold_s: float,
-                 target: float = 0.99, labels: Optional[dict] = None):
+                 target: float = 0.99, labels: Optional[dict] = None,
+                 aggregate: bool = False):
         super().__init__(name)
         if not 0.0 < target < 1.0:
             raise ValueError(f"target must be in (0, 1), got {target}")
@@ -103,13 +135,28 @@ class LatencyObjective(_Objective):
         self.threshold_s = float(threshold_s)
         self.target = float(target)
         self.labels = dict(labels or {})
+        self.aggregate = bool(aggregate)
         self._prev_counts: Optional[List[int]] = None
 
+    def _current_counts(self, hist: Histogram) -> Optional[List[int]]:
+        if not self.aggregate:
+            series = hist._snapshot(self.labels)
+            return list(series.counts) if series is not None else None
+        totals: Optional[List[int]] = None
+        for ls in _aggregate_label_sets(hist):
+            series = hist._snapshot(ls)
+            if series is None:
+                continue
+            if totals is None:
+                totals = list(series.counts)
+            else:
+                totals = [a + b for a, b in zip(totals, series.counts)]
+        return totals
+
     def _window_counts(self, hist: Histogram) -> Optional[List[int]]:
-        series = hist._snapshot(self.labels)
-        if series is None:
+        counts = self._current_counts(hist)
+        if counts is None:
             return None
-        counts = list(series.counts)
         prev = self._prev_counts
         self._prev_counts = counts
         if prev is None or len(prev) != len(counts):
@@ -161,7 +208,8 @@ class RatioObjective(_Objective):
     histogram contributes its observation count)."""
 
     def __init__(self, name: str, numerator: str, denominator: str,
-                 max_ratio: float, labels: Optional[dict] = None):
+                 max_ratio: float, labels: Optional[dict] = None,
+                 aggregate: bool = False):
         super().__init__(name)
         if max_ratio < 0:
             raise ValueError(f"max_ratio must be >= 0, got {max_ratio}")
@@ -169,13 +217,14 @@ class RatioObjective(_Objective):
         self.denominator = denominator
         self.max_ratio = float(max_ratio)
         self.labels = dict(labels or {})
+        self.aggregate = bool(aggregate)
         self._prev: Dict[str, float] = {}
 
     def evaluate(self, registry: MetricsRegistry, now_s: float) -> Dict:
         num = _count_delta(registry, self.numerator, self.labels,
-                           self._prev, "num")
+                           self._prev, "num", aggregate=self.aggregate)
         den = _count_delta(registry, self.denominator, self.labels,
-                           self._prev, "den")
+                           self._prev, "den", aggregate=self.aggregate)
         row = {"objective": "ratio", "numerator": self.numerator,
                "denominator": self.denominator, "max_ratio": self.max_ratio}
         if (num or 0.0) > 0 and not den:
@@ -320,17 +369,25 @@ def default_serving_slos(registry: MetricsRegistry, *,
                          p99_ms: float = 100.0,
                          shed_budget: float = 0.01,
                          error_budget: float = 0.01,
+                         aggregate: bool = False,
                          clock: Callable[[], float] = time.time) -> SloEngine:
     """The serving server's standard objective set: request p99 under
     ``p99_ms``, sheds under ``shed_budget`` per resolved request, and
-    dispatch errors under ``error_budget`` per batch."""
+    dispatch errors under ``error_budget`` per batch.
+
+    ``aggregate=True`` judges every objective over the **sum across label
+    sets** (minus the ``replica`` federation identity) — how the fleet
+    router evaluates the same objectives over its federated window, where
+    all traffic lives in tenant-labelled rollup series."""
     return SloEngine(registry, [
         LatencyObjective("serve_p99", "svgd_serve_request_latency_seconds",
-                         p99_ms / 1e3, target=0.99),
+                         p99_ms / 1e3, target=0.99, aggregate=aggregate),
         RatioObjective("shed_rate", "svgd_serve_shed_total",
-                       "svgd_serve_requests_total", shed_budget),
+                       "svgd_serve_requests_total", shed_budget,
+                       aggregate=aggregate),
         RatioObjective("dispatch_errors", "svgd_serve_dispatch_errors_total",
-                       "svgd_serve_batches_total", error_budget),
+                       "svgd_serve_batches_total", error_budget,
+                       aggregate=aggregate),
     ], clock=clock)
 
 

@@ -1,0 +1,429 @@
+"""Metrics federation (round 16): full-fidelity registry dumps, clamped
+delta merging, the router-side federation sweep (exactness, restart
+clamping, visible scrape failures, replica-label cardinality), the
+federated ``/metrics``+``/slo``+``/fleet`` routes, and the
+``tools/fleet_status.py`` CLI."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from dist_svgd_tpu.serving import fleet
+from dist_svgd_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    combined_exposition,
+    dump_delta,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+def _loaded_registry(n_requests, latency_s=0.004, tenant="t0"):
+    reg = MetricsRegistry()
+    c = reg.counter("svgd_serve_requests_total", "requests fully resolved")
+    h = reg.histogram("svgd_serve_request_latency_seconds", "latency")
+    g = reg.gauge("svgd_serve_queue_depth_rows", "depth")
+    for _ in range(n_requests):
+        c.inc(tenant=tenant)
+        h.observe(latency_s, tenant=tenant)
+    g.set(n_requests, batcher="b0")
+    return reg
+
+
+# --------------------------------------------------------------------- #
+# dump / delta / ingest units
+
+
+def test_dump_roundtrip_is_exact():
+    src = _loaded_registry(9)
+    dst = MetricsRegistry()
+    dst.ingest(src.dump())
+    assert dst.counter("svgd_serve_requests_total").value(tenant="t0") == 9
+    hist = dst.histogram("svgd_serve_request_latency_seconds")
+    s = hist.summary(tenant="t0")
+    assert s["count"] == 9
+    # raw bucket counts travelled, so quantiles agree exactly with the
+    # source's (same fixed lattice, same interpolation)
+    src_hist = src.histogram("svgd_serve_request_latency_seconds")
+    assert hist.quantile(0.99, tenant="t0") == pytest.approx(
+        src_hist.quantile(0.99, tenant="t0"))
+    assert dst.gauge("svgd_serve_queue_depth_rows").value(batcher="b0") == 9
+
+
+def test_dump_delta_clamps_counter_and_histogram_resets():
+    before = _loaded_registry(10)
+    dump0 = before.dump()
+    # a restart: fresh registry with LESS traffic than before
+    after = _loaded_registry(3)
+    delta = dump_delta(dump0, after.dump())
+    counters = delta["metrics"]["svgd_serve_requests_total"]["series"]
+    assert all(s["value"] == 0 for s in counters)
+    hists = delta["metrics"]["svgd_serve_request_latency_seconds"]["series"]
+    assert all(s["count"] == 0 and sum(s["counts"]) == 0 for s in hists)
+    # gauges pass through current values (last write wins at ingest)
+    gauges = delta["metrics"]["svgd_serve_queue_depth_rows"]["series"]
+    assert gauges[0]["value"] == 3
+    # and a normal increment windows exactly
+    more = _loaded_registry(13)
+    delta2 = dump_delta(dump0, more.dump())
+    assert delta2["metrics"]["svgd_serve_requests_total"][
+        "series"][0]["value"] == 3
+
+
+def test_dump_delta_masked_restart_still_clamps():
+    """A restart hidden by growth — the new lifetime already has MORE
+    total observations than the old one, but individual buckets shrank —
+    must still read as a reset: per-bucket clamping there would emit a
+    delta whose bucket sum disagrees with its count."""
+    before = MetricsRegistry()
+    h = before.histogram("h", "x")
+    for _ in range(100):
+        h.observe(0.004)       # old lifetime: 100 obs in one bucket
+    after = MetricsRegistry()
+    h2 = after.histogram("h", "x")
+    for _ in range(150):
+        h2.observe(0.5)        # new lifetime: more obs, DIFFERENT bucket
+    delta = dump_delta(before.dump(), after.dump())
+    s = delta["metrics"]["h"]["series"][0]
+    assert s["count"] == 0 and sum(s["counts"]) == 0 and s["sum"] == 0.0
+
+
+def test_ingest_rejects_mismatched_bucket_boundaries():
+    src = MetricsRegistry()
+    src.histogram("h", "x", buckets=(0.1, 0.2, 0.4)).observe(0.15)
+    dst = MetricsRegistry()
+    dst.histogram("h", "x", buckets=(0.1, 0.3, 0.9)).observe(0.15)
+    # same bucket COUNT, different boundaries: merging would silently
+    # skew quantiles — must refuse instead
+    with pytest.raises(ValueError, match="lattice"):
+        dst.ingest(src.dump())
+
+
+def test_failed_scrape_does_not_consume_the_window():
+    """A dump the registry cannot ingest must not advance the replica's
+    delta window: the failed window's counts arrive with the NEXT good
+    scrape instead of being dropped forever."""
+    reg = _loaded_registry(5)
+
+    class FlakyDumpReplica:
+        poison = False
+
+        def handle(self, method, path, body, headers):
+            if path == "/metrics.dump" and self.poison:
+                return fleet._json_reply(200, {"metrics": {
+                    "svgd_serve_requests_total": {"kind": "zebra",
+                                                  "series": []}}})
+            if path == "/metrics.dump":
+                return fleet._json_reply(200, reg.dump())
+            return fleet._json_reply(200, {"status": "ok"})
+
+    rep = FlakyDumpReplica()
+    transport = fleet.FakeTransport({"r0": rep})
+    rs = fleet.ReplicaSet(["r0"], transport, registry=MetricsRegistry())
+    fed = fleet.MetricsFederation(rs, transport, registry=rs.registry)
+    fed.scrape_once()
+    c = fed.fleet_registry.counter("svgd_serve_requests_total")
+    assert c.value(tenant="t0") == 5
+    reg.counter("svgd_serve_requests_total").inc(3, tenant="t0")
+    rep.poison = True
+    out = fed.scrape_once()
+    assert "r0" in out["errors"]
+    assert c.value(tenant="t0") == 5  # prior contribution stands
+    rep.poison = False
+    fed.scrape_once()
+    assert c.value(tenant="t0") == 8  # the failed window was NOT dropped
+    assert fed.monotone is True
+
+
+def test_replica_slo_verdicts_stay_replica_labelled_only():
+    """A replica's own svgd_slo_* verdict mirrors must never roll up into
+    the unlabelled series — that's where the ROUTER's fleet SLO engine
+    writes, and summing per-engine breach counts into it would corrupt
+    the fleet verdict series."""
+    reg = _loaded_registry(3)
+    reg.counter("svgd_slo_breaches_total", "x").inc(5, slo="serve_p99")
+    rep = fleet.LoopbackReplica("r0", registry=reg)
+    transport = fleet.FakeTransport({"r0": rep})
+    rs = fleet.ReplicaSet(["r0"], transport, registry=MetricsRegistry())
+    fed = fleet.MetricsFederation(rs, transport, registry=rs.registry)
+    fed.scrape_once()
+    c = fed.fleet_registry.counter("svgd_slo_breaches_total")
+    assert c.value(slo="serve_p99", replica="r0") == 5
+    assert c.value(slo="serve_p99") == 0  # no rollup: the router's series
+    # ordinary serving counters still roll up
+    assert fed.fleet_registry.counter(
+        "svgd_serve_requests_total").value(tenant="t0") == 3
+
+
+def test_router_slo_verdict_cached_against_window_slicing():
+    reps = {"r0": fleet.LoopbackReplica("r0", registry=_loaded_registry(4))}
+    transport = fleet.FakeTransport(reps)
+    router = fleet.FleetRouter(["r0"], transport=transport,
+                               registry=MetricsRegistry(),
+                               probe_interval_s=10.0,
+                               slo_min_interval_s=60.0)
+    try:
+        first = router.evaluate_slo()
+        assert first["objectives"]["serve_p99"]["window_count"] == 4
+        # more traffic lands, but a second poll inside the interval must
+        # return the CACHED verdict — not consume a sliver window
+        reps["r0"].registry.histogram(
+            "svgd_serve_request_latency_seconds").observe(0.004, tenant="t0")
+        assert router.evaluate_slo() is first
+        router.slo_min_interval_s = 0.0
+        fresh = router.evaluate_slo()
+        assert fresh is not first
+    finally:
+        router.shutdown()
+
+
+def test_trace_header_one_spelling():
+    from dist_svgd_tpu import telemetry
+
+    assert fleet.TRACE_HEADER == telemetry.TRACE_HEADER == "X-Fleet-Trace"
+
+
+def test_dump_delta_first_scrape_is_cumulative():
+    reg = _loaded_registry(4)
+    delta = dump_delta(None, reg.dump())
+    assert delta["metrics"]["svgd_serve_requests_total"][
+        "series"][0]["value"] == 4
+
+
+def test_histogram_merge_rejects_mismatched_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("h", "x", buckets=(0.1, 0.2, 0.4))
+    with pytest.raises(ValueError, match="cannot merge"):
+        h.merge_series([1, 2], 0.3, 3)
+
+
+def test_combined_exposition_merges_blocks_and_keeps_distinct_series():
+    a = MetricsRegistry()
+    b = MetricsRegistry()
+    a.counter("shared", "from a").inc(1)
+    b.counter("shared", "from b").inc(99)            # same series id
+    b.counter("shared", "from b").inc(7, replica="r0")  # distinct series
+    b.counter("only_b", "x").inc(2)
+    text = combined_exposition(a, b)
+    # ONE block per name; on the identical series identity the earlier
+    # registry wins, but the later registry's DISTINCT series survive —
+    # a router that traces must not hide the replicas' federated
+    # svgd_trace_* series behind its own same-named metric
+    assert text.count("# TYPE shared counter") == 1
+    assert "shared 1" in text and "shared 99" not in text
+    assert 'shared{replica="r0"} 7' in text
+    assert "only_b 2" in text
+
+
+# --------------------------------------------------------------------- #
+# the federation sweep
+
+
+def _fed_fleet(n=2, counts=(5, 7)):
+    reps = {}
+    for i in range(n):
+        rid = f"r{i}"
+        reps[rid] = fleet.LoopbackReplica(
+            rid, registry=_loaded_registry(counts[i]))
+    transport = fleet.FakeTransport(reps)
+    rs = fleet.ReplicaSet(list(reps), transport,
+                          registry=MetricsRegistry())
+    return reps, transport, rs
+
+
+def test_federated_counters_equal_sum_of_replica_snapshots():
+    reps, transport, rs = _fed_fleet(counts=(5, 7))
+    fed = fleet.MetricsFederation(rs, transport, registry=rs.registry)
+    out = fed.scrape_once()
+    assert out["errors"] == {}
+    c = fed.fleet_registry.counter("svgd_serve_requests_total")
+    # the rollup equals the exact sum; per-replica series carry identity
+    assert c.value(tenant="t0") == 12
+    assert c.value(tenant="t0", replica="r0") == 5
+    assert c.value(tenant="t0", replica="r1") == 7
+    h = fed.fleet_registry.histogram("svgd_serve_request_latency_seconds")
+    assert h.summary(tenant="t0")["count"] == 12
+    # scraping again with no new traffic adds nothing (windowed deltas)
+    fed.scrape_once()
+    assert c.value(tenant="t0") == 12
+
+
+def test_federation_survives_replica_restart_clamped():
+    reps, transport, rs = _fed_fleet(counts=(5, 7))
+    fed = fleet.MetricsFederation(rs, transport, registry=rs.registry)
+    fed.scrape_once()
+    # restart r0: FRESH registry (counters reset), some new traffic
+    transport.set_replica(
+        "r0", fleet.LoopbackReplica("r0", registry=_loaded_registry(2)))
+    fed.scrape_once()
+    c = fed.fleet_registry.counter("svgd_serve_requests_total")
+    # the reset window clamps to zero — never a negative rate — and the
+    # rollup stays monotone
+    assert c.value(tenant="t0") == 12
+    assert fed.monotone is True
+    # post-restart traffic federates again
+    reps2 = transport._replicas["r0"]
+    reps2.registry.counter("svgd_serve_requests_total").inc(4, tenant="t0")
+    fed.scrape_once()
+    assert c.value(tenant="t0") == 16
+    assert fed.monotone is True
+
+
+def test_scrape_failure_is_counted_and_prior_contribution_stands():
+    reps, transport, rs = _fed_fleet(counts=(5, 7))
+    fed = fleet.MetricsFederation(rs, transport, registry=rs.registry)
+    fed.scrape_once()
+    transport.kill("r0")
+    out = fed.scrape_once()
+    assert "r0" in out["errors"] and out["scraped"] == ["r1"]
+    errs = rs.registry.counter("svgd_fleet_scrape_errors_total")
+    assert errs.value(replica="r0") == 1
+    assert errs.value(replica="r1") == 0
+    # r0's previously-federated 5 requests are still in the rollup
+    c = fed.fleet_registry.counter("svgd_serve_requests_total")
+    assert c.value(tenant="t0") == 12
+    assert fed.stats()["scrape_errors"] == {"r0": 1}
+
+
+def test_replica_label_rides_the_cardinality_guard():
+    """A flapping fleet (many distinct replica identities) must aggregate
+    into the reserved ``other`` rollup, never grow without bound."""
+    ids = [f"flap{i}" for i in range(8)]
+    reps = {rid: fleet.LoopbackReplica(rid, registry=_loaded_registry(1))
+            for rid in ids}
+    transport = fleet.FakeTransport(reps)
+    rs = fleet.ReplicaSet(ids, transport, registry=MetricsRegistry())
+    fed = fleet.MetricsFederation(
+        rs, transport, registry=rs.registry,
+        fleet_registry=MetricsRegistry(max_label_sets=4))
+    with pytest.warns(RuntimeWarning, match="max_label_sets"):
+        fed.scrape_once()
+    c = fed.fleet_registry.counter("svgd_serve_requests_total")
+    label_sets = c.label_sets()
+    # the bound plus the reserved rollup series itself
+    assert len(label_sets) <= 5
+    # the overflow landed in the rollup series, not on the floor
+    assert c.value(tenant="other", replica="other") > 0
+    # exposition stays bounded and well-formed
+    text = fed.fleet_registry.exposition()
+    assert text.count("svgd_serve_requests_total{") <= 5
+
+
+# --------------------------------------------------------------------- #
+# the router's federated HTTP plane
+
+
+def _http_router(tenants=("t0", "t1")):
+    reps = {f"r{i}": fleet.LoopbackReplica(f"r{i}", tenants=list(tenants))
+            for i in range(2)}
+    transport = fleet.FakeTransport(reps)
+    router = fleet.FleetRouter(
+        list(reps), transport=transport, registry=MetricsRegistry(),
+        probe_interval_s=5.0, port=0).start()
+    return router, reps, transport
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url.rstrip("/") + path, timeout=5) as r:
+        return r.status, r.read()
+
+
+def test_router_metrics_exposes_federated_series():
+    router, reps, transport = _http_router()
+    try:
+        for i in range(6):
+            t = "t0" if i % 2 else "t1"
+            res = router.route(t, json.dumps(
+                {"inputs": [[0.1, 0.2]], "tenant": t}).encode())
+            assert res.status == 200
+        status, body = _get(router.url, "/metrics")
+        text = body.decode()
+        assert status == 200
+        # the router's own series...
+        assert "svgd_fleet_requests_total" in text
+        # ...plus the federated replica-labelled series and the rollup
+        assert 'svgd_serve_requests_total{replica="r0"' in text \
+            or 'svgd_serve_requests_total{replica="r1"' in text
+        assert 'svgd_serve_requests_total{tenant="t0"}' in text
+        # one TYPE block per name (combined_exposition dedup)
+        assert text.count("# TYPE svgd_serve_requests_total counter") == 1
+    finally:
+        router.shutdown()
+
+
+def test_router_slo_evaluates_federated_window():
+    router, reps, transport = _http_router()
+    try:
+        for _ in range(8):
+            router.route("t0", json.dumps(
+                {"inputs": [[0.1, 0.2]], "tenant": "t0"}).encode())
+        status, body = _get(router.url, "/slo")
+        doc = json.loads(body)
+        assert status == 200
+        assert doc["status"] in ("ok", "breach")
+        p99 = doc["objectives"]["serve_p99"]
+        # the window saw the federated (cross-replica) observations
+        assert p99["status"] == "ok" and p99["window_count"] == 8
+    finally:
+        router.shutdown()
+
+
+def test_fleet_route_and_status_doc():
+    router, reps, transport = _http_router()
+    try:
+        for _ in range(4):
+            router.route("t0", json.dumps(
+                {"inputs": [[0.1, 0.2]], "tenant": "t0"}).encode())
+        status, body = _get(router.url, "/fleet")
+        doc = json.loads(body)
+        assert status == 200
+        assert doc["role"] == "fleet-router"
+        assert set(doc["replicas"]) == {"r0", "r1"}
+        assert doc["federation"]["scrapes"] >= 1
+        assert doc["federation"]["monotone"] is True
+        assert doc["tenants"]["t0"]["requests"] == 4
+        assert doc["tenants"]["t0"]["requests_total"] == 4
+        assert "p99_ms" in doc["tenants"]["t0"]
+        assert doc["slo"]["status"] in ("ok", "breach")
+    finally:
+        router.shutdown()
+
+
+def test_fleet_status_cli_against_live_router(capsys):
+    import fleet_status
+
+    router, reps, transport = _http_router()
+    try:
+        for _ in range(5):
+            router.route("t0", json.dumps(
+                {"inputs": [[0.1, 0.2]], "tenant": "t0"}).encode())
+        rc = fleet_status.main(["--url", router.url, "--interval-s", "0.05",
+                                "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["healthy"] is True
+        assert out["metric"] == "fleet_status"
+        assert out["replicas"]["r0"]["state"] == "closed"
+        assert out["tenants"]["t0"]["requests"] == 5
+        # the two-poll window derived a (possibly zero) rate, not null
+        assert out["tenants"]["t0"]["rps"] is not None
+        # human rendering exits through the same health verdict
+        rc = fleet_status.main(["--url", router.url, "--interval-s", "0"])
+        human = capsys.readouterr().out
+        assert rc == 0 and "replicas closed" in human
+    finally:
+        router.shutdown()
+
+
+def test_fleet_status_cli_unreachable_exits_2(capsys):
+    import fleet_status
+
+    rc = fleet_status.main(["--url", "http://127.0.0.1:9",
+                            "--interval-s", "0", "--timeout-s", "0.2"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert err.count("\n") == 1 and "fleet_status:" in err

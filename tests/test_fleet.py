@@ -553,12 +553,57 @@ def test_route_lane_tree_emitted():
     try:
         res = router.route(tenant, _body(tenant))
         assert res.status == 200
-        names = [e["name"] for e in tracer.chrome_events()]
+        events = [e for e in tracer.chrome_events() if e.get("ph") == "X"]
+        names = [e["name"] for e in events]
     finally:
         trace_mod.disable()
     assert "fleet.route" in names
     assert names.count("fleet.attempt") == 2  # failed + served
     assert "fleet.forward" in names
+    # one trace id tags the route AND both sibling attempts — the join
+    # key the cross-process stitcher reassembles trees on
+    route = [e for e in events if e["name"] == "fleet.route"][0]
+    trace_id = route["args"]["trace"]
+    assert trace_id and len(trace_id) == 16
+    attempts = [e for e in events if e["name"] == "fleet.attempt"]
+    assert all(a["args"]["trace"] == trace_id for a in attempts)
+
+
+def test_trace_header_propagated_downstream():
+    """Every forward attempt sends X-Fleet-Trace; a caller-supplied id is
+    passed through untouched, an absent one is minted per request."""
+    router, reps, tr, clock, _ = make_fleet()
+    tenant = "t-hdr"
+    home = router.order_for(tenant)[0]
+    res = router.route(tenant, _body(tenant), trace="feed0000deadbeef")
+    assert res.status == 200
+    assert reps[home].last_headers["x-fleet-trace"] == "feed0000deadbeef"
+    res = router.route(tenant, _body(tenant))
+    minted = reps[home].last_headers["x-fleet-trace"]
+    assert len(minted) == 16 and minted != "feed0000deadbeef"
+    res = router.route(tenant, _body(tenant))
+    assert reps[home].last_headers["x-fleet-trace"] != minted  # per request
+
+
+def test_router_http_front_door_passes_trace_header():
+    import urllib.request
+
+    replicas = {f"r{i}": fleet.LoopbackReplica(f"r{i}") for i in range(2)}
+    transport = fleet.FakeTransport(replicas)
+    router = fleet.FleetRouter(
+        list(replicas), transport=transport, registry=MetricsRegistry(),
+        probe_interval_s=5.0, port=0).start()
+    try:
+        req = urllib.request.Request(
+            router.url + "/predict", _body("t0"),
+            {"Content-Type": "application/json",
+             "X-Fleet-Trace": "0123456789abcdef"})
+        doc = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        served_by = doc["replica"]
+        assert replicas[served_by].last_headers["x-fleet-trace"] == \
+            "0123456789abcdef"
+    finally:
+        router.shutdown()
 
 
 # --------------------------------------------------------------------- #

@@ -27,6 +27,12 @@ def test_fake_drill_row_schema(fake_row):
                 "p99_kill_ms", "p99_partition_ms", "retries", "hedges",
                 "failovers", "misroutes", "ejections", "readmissions",
                 "partition_replica_alive", "partition_flight_trips",
+                "trace_stitch_coverage", "stitch_served_routes",
+                "stitch_retry_trees", "stitch_orphans",
+                "federation_scrape_ms", "federation_scrapes",
+                "federation_scrapes_skipped",
+                "federation_scrape_errors", "federation_monotone",
+                "federated_requests_total",
                 "probe_interval_s", "open_cooldown_s", "status_counts",
                 "wall_s"):
         assert key in row, key
@@ -55,20 +61,53 @@ def test_fake_drill_acceptance(fake_row):
     assert row["partition_flight_trips"] == 0
 
 
+def test_fake_drill_observability_acceptance(fake_row):
+    """ISSUE-12 acceptance on CPU: every non-shed served request stitches
+    into exactly one router→replica tree (coverage 1.0, the kill-phase
+    retries as sibling attempts), the federation scraped through the kill
+    (visible errors) and stayed monotone across the restarted replica's
+    counter reset."""
+    row = fake_row
+    assert row["trace_stitch_coverage"] == 1.0
+    assert row["stitch_served_routes"] > 0
+    assert row["stitch_retry_trees"] >= 1   # the kill produced siblings
+    assert row["stitch_orphans"] == 0
+    assert row["federation_scrapes"] >= 4
+    # the dead replica degraded VISIBLY while survivors federated: its
+    # scrape either failed (pre-detection) or was skipped (circuit open)
+    assert (row["federation_scrape_errors"]
+            + row["federation_scrapes_skipped"]) >= 1
+    assert row["federation_monotone"] is True
+    assert row["federation_scrape_ms"] > 0
+    assert row["federated_requests_total"] > 0
+
+
 def test_row_ok_catches_every_gate():
     good = {"lost_requests": 0, "misroutes": 0, "detect_s": 0.1,
             "readmit_s": 0.2, "readmissions": 1,
-            "partition_replica_alive": True, "partition_flight_trips": 0}
+            "partition_replica_alive": True, "partition_flight_trips": 0,
+            "mode": "fake", "trace_stitch_coverage": 1.0,
+            "federation_monotone": True}
     assert fleet_drill.row_ok(dict(good)) == (True, [])
     for key, bad in (("lost_requests", 3), ("misroutes", 1),
                      ("detect_s", None), ("readmit_s", None),
                      ("readmissions", 0),
                      ("partition_replica_alive", False),
-                     ("partition_flight_trips", 2)):
+                     ("partition_flight_trips", 2),
+                     ("trace_stitch_coverage", 0.97),
+                     ("trace_stitch_coverage", None),
+                     ("federation_monotone", False)):
         row = dict(good)
         row[key] = bad
         ok, why = fleet_drill.row_ok(row)
         assert not ok and why, key
+    # real mode carries no stitch gate (a SIGKILLed replica takes its
+    # trace buffer with it) but keeps the monotone-federation gate
+    real = dict(good, mode="real", trace_stitch_coverage=None)
+    assert fleet_drill.row_ok(real) == (True, [])
+    real["federation_monotone"] = False
+    ok, why = fleet_drill.row_ok(real)
+    assert not ok and why
 
 
 def test_drill_cli_exits_clean():

@@ -411,7 +411,14 @@ def test_jsonl_exporter_mirrors_events(tmp_path):
             tracer.instant("m")
     lines = [json.loads(x) for x in open(path)]
     kinds = {(r["kind"], r["name"]) for r in lines}
-    assert kinds == {("instant", "m"), ("span", "a")}
+    assert kinds == {("instant", "m"), ("span", "a"),
+                     ("process", f"pid-{os.getpid()}")}
+    # the process-identity header leads the stream (a stitcher labels the
+    # file before reading any span) and carries the clock anchor
+    assert lines[0]["kind"] == "process"
+    assert lines[0]["role"] == "process"
+    assert isinstance(lines[0]["anchor_unix_s"], float)
+    assert lines[0]["anchor_trace_s"] == 0.0
     span_rec = [r for r in lines if r["kind"] == "span"][0]
     assert span_rec["dur"] == pytest.approx(0.5)
 

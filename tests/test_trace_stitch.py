@@ -1,0 +1,320 @@
+"""Cross-process trace propagation + stitching (round 16): the trace
+context, process-identity export headers, batcher/server trace threading,
+and ``tools/trace_report.py --stitch`` (golden tree, orphan tolerance,
+missing-anchor exit-2 contract)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from dist_svgd_tpu.serving import fleet
+from dist_svgd_tpu.telemetry import trace as trace_mod
+from dist_svgd_tpu.telemetry.metrics import MetricsRegistry
+from dist_svgd_tpu.telemetry.trace import Tracer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import trace_report
+
+
+@pytest.fixture
+def global_tracer():
+    tracer = trace_mod.enable()
+    try:
+        yield tracer
+    finally:
+        trace_mod.disable()
+
+
+# --------------------------------------------------------------------- #
+# trace context + process identity primitives
+
+
+def test_trace_context_is_per_thread_and_restorable():
+    import threading
+
+    assert trace_mod.get_trace_context() is None
+    prev = trace_mod.set_trace_context("abc")
+    assert prev is None and trace_mod.get_trace_context() == "abc"
+    seen = {}
+
+    def other():
+        seen["ctx"] = trace_mod.get_trace_context()
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen["ctx"] is None  # thread-local, never inherited
+    trace_mod.set_trace_context(prev)
+    assert trace_mod.get_trace_context() is None
+
+
+def test_mint_trace_id_shape_and_uniqueness():
+    ids = {trace_mod.mint_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids)
+
+
+def test_chrome_export_carries_process_header(tmp_path):
+    tracer = Tracer(registry=MetricsRegistry())
+    tracer.set_process("replica", "r7")
+    with tracer.span("a"):
+        pass
+    path = str(tmp_path / "t.json")
+    tracer.export_chrome(path)
+    doc = json.load(open(path))
+    proc = doc["otherData"]["process"]
+    assert proc["role"] == "replica" and proc["name"] == "r7"
+    assert proc["pid"] == os.getpid()
+    assert proc["anchor_trace_s"] == 0.0
+    assert isinstance(proc["anchor_unix_s"], float)
+    # the loader surfaces it
+    loaded, spans, _ = trace_report.load_export(path)
+    assert loaded["name"] == "r7" and len(spans) == 1
+
+
+def test_set_process_only_if_default_never_clobbers():
+    tracer = Tracer(registry=MetricsRegistry())
+    tracer.set_process("router", "the-router")
+    tracer.set_process("replica", "imposter", only_if_default=True)
+    meta = tracer.process_meta()
+    assert meta["role"] == "router" and meta["name"] == "the-router"
+
+
+def test_tracer_drop_and_lane_metrics():
+    reg = MetricsRegistry()
+    tracer = Tracer(max_events=2, registry=reg)
+    for i in range(5):
+        with tracer.span("s"):
+            pass
+    assert tracer.dropped_events == 3
+    # a saturated buffer is a scrapeable counter, not a silent property
+    assert reg.counter("svgd_trace_dropped_total").value() == 3
+    tracer2 = Tracer(registry=reg)
+    tracer2.lane_tree("a", 0.0, 1.0)
+    tracer2.lane_tree("b", 0.0, 1.0)  # overlaps → second lane
+    tracer2.lane_tree("c", 2.0, 3.0)  # fits lane 0
+    assert reg.gauge("svgd_trace_lanes").value() == 2
+
+
+# --------------------------------------------------------------------- #
+# batcher / engine propagation
+
+
+def test_batcher_threads_trace_through_lane_tree(global_tracer, ):
+    from dist_svgd_tpu.serving import MicroBatcher, PredictiveEngine
+
+    rng = np.random.default_rng(0)
+    parts = rng.normal(size=(16, 5)).astype(np.float32)
+    eng = PredictiveEngine("logreg", parts, min_bucket=4, max_bucket=16,
+                           registry=MetricsRegistry())
+    eng.warmup()
+    bat = MicroBatcher(eng.predict, max_batch=8, max_wait_ms=1.0,
+                       registry=MetricsRegistry())
+    try:
+        x = rng.normal(size=(2, 4)).astype(np.float32)
+        bat.submit(x, trace="feedbeef00000001").result(timeout=10)
+        bat.submit(x).result(timeout=10)  # tracer on → id auto-minted
+    finally:
+        bat.close(drain=True)
+    spans = [e for e in global_tracer.chrome_events() if e["ph"] == "X"]
+    reqs = [e for e in spans if e["name"] == "serve.request"]
+    traces = [r["args"].get("trace") for r in reqs]
+    assert "feedbeef00000001" in traces
+    assert all(t for t in traces)  # the trace-less submit minted its own
+    # the engine's span picked the id up from the dispatch trace context
+    eng_spans = [e for e in spans if e["name"] == "engine.predict"]
+    assert "feedbeef00000001" in {e["args"].get("trace")
+                                  for e in eng_spans}
+
+
+def test_http_server_extracts_fleet_trace_header(global_tracer):
+    import urllib.request
+
+    from dist_svgd_tpu.serving import (MicroBatcher, PredictionServer,
+                                       PredictiveEngine)
+
+    rng = np.random.default_rng(0)
+    parts = rng.normal(size=(16, 5)).astype(np.float32)
+    eng = PredictiveEngine("logreg", parts, min_bucket=4, max_bucket=16,
+                           registry=MetricsRegistry())
+    eng.warmup()
+    srv = PredictionServer(eng, port=0, max_wait_ms=1.0,
+                           registry=MetricsRegistry()).start()
+    try:
+        req = urllib.request.Request(
+            srv.url + "/predict",
+            json.dumps({"inputs": [[0.1, 0.2, 0.3, 0.4]]}).encode(),
+            {"Content-Type": "application/json",
+             "X-Fleet-Trace": "cafe000000000002"})
+        assert json.loads(urllib.request.urlopen(
+            req, timeout=10).read())["outputs"]
+    finally:
+        srv.shutdown()
+    spans = [e for e in global_tracer.chrome_events() if e["ph"] == "X"]
+    for name in ("http.predict", "serve.request"):
+        tagged = [e for e in spans if e["name"] == name
+                  and e["args"].get("trace") == "cafe000000000002"]
+        assert tagged, name
+
+
+# --------------------------------------------------------------------- #
+# stitching
+
+
+def _run_fleet_and_export(tmp_path, n_requests=6, kill_one=True):
+    """Route through a 2-replica loopback fleet under the global tracer,
+    export router + replica traces, return (paths, served routes)."""
+    tracer = trace_mod.enable()
+    tracer.set_process("router", "router")
+    rep_tracers = {r: Tracer(registry=MetricsRegistry())
+                   for r in ("ra", "rb")}
+    reps = {r: fleet.LoopbackReplica(r, tenants=["t0"],
+                                     tracer=rep_tracers[r])
+            for r in ("ra", "rb")}
+    transport = fleet.FakeTransport(reps)
+    router = fleet.FleetRouter(list(reps), transport=transport,
+                               registry=MetricsRegistry(),
+                               probe_interval_s=10.0)
+    body = json.dumps({"inputs": [[0.1, 0.2]], "tenant": "t0"}).encode()
+    served = 0
+    for _ in range(n_requests):
+        if router.route("t0", body).status == 200:
+            served += 1
+    if kill_one:
+        victim = router.route("t0", body).replica
+        served += 1
+        transport.kill(victim)
+        res = router.route("t0", body)  # retries to the survivor
+        assert res.status == 200 and res.attempts > 1
+        served += 1
+    router.shutdown()
+    tracer = trace_mod.disable()
+    router_path = str(tmp_path / "router.json")
+    tracer.export_chrome(router_path)
+    paths = [router_path]
+    for r, rt in rep_tracers.items():
+        p = str(tmp_path / f"{r}.json")
+        rt.export_chrome(p)
+        paths.append(p)
+    return paths, served
+
+
+def test_stitch_golden_tree_with_retry_siblings(tmp_path):
+    paths, served = _run_fleet_and_export(tmp_path)
+    report = trace_report.stitch_files(paths)
+    assert report["served_routes"] == served
+    assert report["coverage"] == 1.0
+    assert report["orphan_replica_traces"] == 0
+    # the killed-replica request shows as ONE tree with sibling attempts:
+    # a failed leg (transport error) and the serving leg with its
+    # replica-side serve.request and a non-negative wire gap
+    retry = [t for t in report["trees"] if len(t["attempts"]) > 1]
+    assert report["retry_trees"] >= 1 and retry
+    tree = retry[0]
+    errors = [a for a in tree["attempts"] if "error" in a]
+    serving = [a for a in tree["attempts"] if "serve" in a]
+    assert errors and serving
+    assert serving[0]["serve"]["wire_gap_ms"] >= 0.0
+    # per-hop rows exist for every level of the stitched tree
+    for hop in ("fleet.route", "fleet.attempt", "fleet.wire",
+                "serve.request", "serve.dispatch"):
+        assert report["hops"][hop]["count"] >= 1, hop
+
+
+def test_stitch_duplicate_client_trace_ids_stay_separate_trees(tmp_path):
+    """A client replaying one X-Fleet-Trace id across requests (the
+    front door passes it through verbatim) must yield one tree PER
+    route — never a merged pseudo-retry tree."""
+    tracer = trace_mod.enable()
+    tracer.set_process("router", "router")
+    rep_tracer = Tracer(registry=MetricsRegistry())
+    reps = {"ra": fleet.LoopbackReplica("ra", tenants=["t0"],
+                                        tracer=rep_tracer)}
+    transport = fleet.FakeTransport(reps)
+    router = fleet.FleetRouter(["ra"], transport=transport,
+                               registry=MetricsRegistry(),
+                               probe_interval_s=10.0)
+    body = json.dumps({"inputs": [[0.1, 0.2]], "tenant": "t0"}).encode()
+    for _ in range(3):
+        assert router.route("t0", body, trace="5717CKed00000bad").status \
+            == 200
+    router.shutdown()
+    tracer = trace_mod.disable()
+    paths = [str(tmp_path / "router.json"), str(tmp_path / "ra.json")]
+    tracer.export_chrome(paths[0])
+    rep_tracer.export_chrome(paths[1])
+    report = trace_report.stitch_files(paths)
+    assert report["router_routes"] == 3
+    assert report["served_routes"] == 3
+    assert report["coverage"] == 1.0
+    # three single-attempt trees, NOT one three-attempt "retry" tree
+    assert report["retry_trees"] == 0
+    assert all(len(t["attempts"]) == 1 for t in report["trees"])
+
+
+def test_stitch_orphan_replica_spans_reported_not_fatal(tmp_path):
+    paths, _served = _run_fleet_and_export(tmp_path, kill_one=False)
+    # a replica export whose ROUTER file is missing: fabricate a second
+    # fleet's replica-only export and stitch it alongside
+    stray = Tracer(registry=MetricsRegistry())
+    stray.set_process("replica", "stray")
+    stray.lane_tree("serve.request", 0.0, 0.001,
+                    {"trace": "dead000000000009", "replica": "stray"})
+    stray_path = str(tmp_path / "stray.json")
+    stray.export_chrome(stray_path)
+    report = trace_report.stitch_files(paths + [stray_path])
+    assert report["coverage"] == 1.0  # the real fleet still fully joins
+    assert report["orphan_replica_traces"] == 1
+
+
+def test_stitch_missing_anchor_exits_2_with_one_line(tmp_path, capsys):
+    paths, _ = _run_fleet_and_export(tmp_path, kill_one=False)
+    # an old-format export: no otherData.process header at all
+    legacy = str(tmp_path / "legacy.json")
+    doc = json.load(open(paths[1]))
+    del doc["otherData"]
+    json.dump(doc, open(legacy, "w"))
+    rc = trace_report.main(["--stitch", paths[0], legacy])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert err.count("\n") == 1 and "process-identity header" in err
+    # an anchor-less header is diagnosed just as cleanly
+    doc = json.load(open(paths[1]))
+    del doc["otherData"]["process"]["anchor_unix_s"]
+    json.dump(doc, open(legacy, "w"))
+    rc = trace_report.main(["--stitch", paths[0], legacy])
+    err = capsys.readouterr().err
+    assert rc == 2 and "clock anchor" in err
+
+
+def test_stitch_requires_a_router_export(tmp_path, capsys):
+    paths, _ = _run_fleet_and_export(tmp_path, kill_one=False)
+    rc = trace_report.main(["--stitch", paths[1], paths[2]])
+    err = capsys.readouterr().err
+    assert rc == 2 and "router" in err
+
+
+def test_stitch_cli_json_and_human(tmp_path, capsys):
+    paths, served = _run_fleet_and_export(tmp_path)
+    rc = trace_report.main(["--stitch"] + paths + ["--json", "--top", "2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["coverage"] == 1.0 and len(doc["trees"]) <= 2
+    rc = trace_report.main(["--stitch"] + paths)
+    out = capsys.readouterr().out
+    assert rc == 0 and "coverage 1.0000" in out and "fleet.wire" in out
+
+
+def test_single_file_report_still_works_with_new_exports(tmp_path, capsys):
+    paths, _ = _run_fleet_and_export(tmp_path, kill_one=False)
+    rc = trace_report.main([paths[0], "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert "fleet.route" in doc["spans"]

@@ -33,11 +33,28 @@ Modes:
   serving a real logreg checkpoint over real sockets, kill/partition/
   restart for real.  Slow-marked in the test suite.
 
+Cross-process observability (round 16): the drill additionally measures
+the fleet's **trace stitching** and **metrics federation**.  In fake mode
+each replica owns its own tracer + metrics registry (standing in for a
+separate process), the router traces its route trees, every export lands
+in a temp dir and ``tools/trace_report.py --stitch`` joins them —
+``trace_stitch_coverage`` must be **1.0** (every non-shed served request
+reassembles into exactly one router→replica tree; ``perf_regress`` FAILs
+otherwise) and the kill phase's retries must appear as sibling attempts
+(``stitch_retry_trees >= 1``).  A restart installs a FRESH replica
+(registry reset to zero), so the federation's counter-reset clamping is
+exercised in-drill: ``federation_monotone`` must stay True.  In real mode
+the federation runs over real sockets too (``federation_scrape_ms`` is a
+real scrape wall), but ``trace_stitch_coverage`` is ``null`` — a
+SIGKILLed replica takes its in-memory trace buffer with it, which is
+exactly why the streamed-export fake drill carries the stitch gate.
+
 Row fields are documented in ``tools/README.md``;
-``tools/perf_regress.py`` gates ``detect_s`` / ``readmit_s`` with
-median+MAD incumbent windows and FAILs unconditionally on
-``lost_requests > 0`` or ``misroutes > 0`` (a routed request reaching an
-ejected replica).
+``tools/perf_regress.py`` gates ``detect_s`` / ``readmit_s`` /
+``federation_scrape_ms`` with median+MAD incumbent windows and FAILs
+unconditionally on ``lost_requests > 0``, ``misroutes > 0`` (a routed
+request reaching an ejected replica), fake-mode stitch coverage below
+1.0, or a non-monotone federated counter.
 
 Usage::
 
@@ -51,16 +68,22 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import trace_report
+
+from dist_svgd_tpu import telemetry
 from dist_svgd_tpu.resilience.backoff import Backoff
 from dist_svgd_tpu.serving import fleet as fleet_mod
 from dist_svgd_tpu.telemetry.metrics import MetricsRegistry
+from dist_svgd_tpu.telemetry.trace import Tracer
 
 REPLICAS = ("r0", "r1", "r2")
 TENANTS = tuple(f"t{i}" for i in range(8))
@@ -149,15 +172,35 @@ def _transition_ts(replica_set, rid: str, to_state: str,
 
 
 class _FakeFleet:
-    """3 LoopbackReplicas on a FakeTransport; faults are transport flips."""
+    """3 LoopbackReplicas on a FakeTransport; faults are transport flips.
 
-    def __init__(self):
-        self.replicas = {
-            rid: fleet_mod.LoopbackReplica(
-                rid, predict_fn=self._predict, tenants=TENANTS)
-            for rid in REPLICAS
-        }
-        self.transport = fleet_mod.FakeTransport(self.replicas)
+    Each replica owns its OWN tracer and metrics registry — the
+    in-process stand-in for separate replica processes, so the drill can
+    exercise cross-process stitching and federation without sockets.  A
+    ``restart`` installs a **fresh** replica (counters back at zero, new
+    tracer): exactly the reset the federation must clamp.  Every
+    generation's tracer is kept for export — modelling replicas that
+    stream their JSONL exports off-process (the reason fake mode can
+    stitch through a kill while real mode cannot)."""
+
+    def __init__(self, trace: bool = True):
+        self._trace = trace
+        self.generations: List[Tuple[str, Tracer]] = []
+        self.replicas: Dict[str, fleet_mod.LoopbackReplica] = {}
+        self.transport = fleet_mod.FakeTransport({})
+        for rid in REPLICAS:
+            self.replicas[rid] = self._make_replica(rid)
+            self.transport.set_replica(rid, self.replicas[rid])
+
+    def _make_replica(self, rid):
+        tracer = None
+        if self._trace:
+            tracer = Tracer(registry=MetricsRegistry())
+            tracer.set_process("replica", rid)
+            self.generations.append((rid, tracer))
+        return fleet_mod.LoopbackReplica(
+            rid, predict_fn=self._predict, tenants=TENANTS,
+            registry=MetricsRegistry(), tracer=tracer)
 
     @staticmethod
     def _predict(inputs, tenant, headers):
@@ -174,10 +217,27 @@ class _FakeFleet:
         self.transport.restore(rid)
 
     def restart(self, rid):
+        # a restarted process comes back EMPTY: fresh registry (counter
+        # reset → federation clamp) and fresh tracer (new epoch/anchor)
+        self.replicas[rid] = self._make_replica(rid)
+        self.transport.set_replica(rid, self.replicas[rid])
         self.transport.restore(rid)
 
     def close(self):
         pass
+
+    def export_traces(self, outdir: str) -> List[str]:
+        """One Chrome export per replica generation (r0 may have two:
+        pre-kill and post-restart)."""
+        paths = []
+        counts: Dict[str, int] = {}
+        for rid, tracer in self.generations:
+            gen = counts.get(rid, 0)
+            counts[rid] = gen + 1
+            path = os.path.join(outdir, f"{rid}-gen{gen}.json")
+            tracer.export_chrome(path)
+            paths.append(path)
+        return paths
 
     def assert_partition_clean(self, rid) -> Dict[str, Any]:
         """The partitioned replica must be ALIVE: reachable directly (not
@@ -287,20 +347,66 @@ def run_drill(mode: str = "fake", *, rate_hz: float = 200.0,
               partition_s: float = 0.8, probe_interval_s: float = 0.05,
               open_cooldown_s: float = 0.25,
               readmit_timeout_s: float = 10.0,
-              hedge: bool = False) -> Dict[str, Any]:
-    """Run the drill, return the ``fleet_failover`` row dict."""
+              hedge: bool = False, trace: bool = True) -> Dict[str, Any]:
+    """Run the drill, return the ``fleet_failover`` row dict.
+
+    ``trace`` (fake mode) enables the router-side tracer and the replica
+    stand-in tracers, exports every process's trace to a temp dir, and
+    stitches them (``trace_report.stitch_files``) into the
+    ``trace_stitch_coverage`` / ``stitch_retry_trees`` row fields.  Real
+    mode never stitches (a SIGKILL takes the replica's in-memory trace
+    buffer with it) — coverage reads ``null`` there."""
     if mode not in ("fake", "real"):
         raise ValueError(f"mode must be fake|real, got {mode!r}")
     registry = MetricsRegistry()
+    stitch = mode == "fake" and trace
+    router_tracer = None
+    own_tracer = False
+    trace_t0_us = 0.0
+    prev_process = None
+    if stitch:
+        own_tracer = telemetry.get_tracer() is None
+        router_tracer = telemetry.enable(registry=registry)
+        # a BORROWED outer tracer (perf_regress composing tools) gets its
+        # identity back afterwards — this process is only "the router"
+        # for the drill's duration
+        prev_process = (None if own_tracer
+                        else router_tracer.process_meta())
+        router_tracer.set_process("router", "router")
+        # an outer tracer may carry spans from earlier benches: stitch
+        # only what THIS drill routes
+        trace_t0_us = router_tracer.now() * 1e6
+    try:
+        return _drill_body(
+            mode, stitch=stitch, router_tracer=router_tracer,
+            trace_t0_us=trace_t0_us, registry=registry, rate_hz=rate_hz,
+            steady_s=steady_s, kill_s=kill_s, partition_s=partition_s,
+            probe_interval_s=probe_interval_s,
+            open_cooldown_s=open_cooldown_s,
+            readmit_timeout_s=readmit_timeout_s, hedge=hedge)
+    finally:
+        # tracer cleanup on EVERY exit path — a drill aborting mid-phase
+        # must not leave the process-global tracer installed (it would
+        # silently trace every later bench in this process) or a
+        # borrowed one mislabelled as the router
+        if stitch:
+            if own_tracer:
+                telemetry.disable()
+            elif prev_process is not None:
+                router_tracer.set_process(prev_process["role"],
+                                          prev_process["name"])
+
+
+def _drill_body(mode, *, stitch, router_tracer, trace_t0_us, registry,
+                rate_hz, steady_s, kill_s, partition_s, probe_interval_s,
+                open_cooldown_s, readmit_timeout_s, hedge):
     tmpdir = None
     if mode == "real":
-        import tempfile
-
         tmpdir = tempfile.TemporaryDirectory(prefix="fleet_drill_")
         backend = _RealFleet(tmpdir.name)
         probe_interval_s = max(probe_interval_s, 0.1)
     else:
-        backend = _FakeFleet()
+        backend = _FakeFleet(trace=stitch)
     t_wall0 = time.monotonic()
     replica_set = fleet_mod.ReplicaSet(
         REPLICAS, backend.transport,
@@ -318,21 +424,39 @@ def run_drill(mode: str = "fake", *, rate_hz: float = 200.0,
         backoff=Backoff(base_s=0.005, factor=2.0, max_s=0.05,
                         jitter_frac=0.2),
         hedge=hedge, registry=registry,
+        # real mode shares 2 cores between 3 jax replicas, the router,
+        # and the load generator: a scrape must never stall a sweep for
+        # a full second behind one busy replica
+        federation_timeout_s=0.5 if mode == "real" else 1.0,
     )
     router.start()
     load = _OpenLoopLoad(router, rate_hz,
                          tenant_in_body=mode == "fake").start()
     partition_clean = None
+    federation = router.federation
     try:
+        # Federation sweeps run MID-phase, never at a phase boundary: a
+        # sweep costs real CPU in the drill process (3 scrapes + dump
+        # merge) and on this 2-core box a boundary sweep lands exactly on
+        # the kill/partition instant — enough perturbation to tip the
+        # (deliberately tight) real-mode fleet into an ejection cascade
+        # that the drill would then mis-attribute to the router.
+
         # phase 1: steady state
         load.phase[0] = "steady"
-        time.sleep(steady_s)
+        time.sleep(steady_s / 2)
+        federation.scrape_once()  # everyone alive: the exactness sweep
+        time.sleep(steady_s / 2)
 
         # phase 2: kill r0 under load — retries must absorb every request
         load.phase[0] = "kill"
         t_kill = time.monotonic()
         backend.kill("r0")
-        time.sleep(kill_s)
+        time.sleep(kill_s / 2)
+        # the dead replica's scrape FAILS and is counted — federation
+        # degrades visibly, the survivors keep federating
+        federation.scrape_once()
+        time.sleep(kill_s / 2)
         ts_open = _transition_ts(replica_set, "r0", "open", t_kill)
         detect_s = None if ts_open is None else ts_open - t_kill
 
@@ -340,7 +464,9 @@ def run_drill(mode: str = "fake", *, rate_hz: float = 200.0,
         load.phase[0] = "partition"
         t_part = time.monotonic()
         backend.partition("r1")
-        time.sleep(partition_s)
+        time.sleep(partition_s / 2)
+        federation.scrape_once()
+        time.sleep(partition_s / 2)
         partition_clean = backend.assert_partition_clean("r1")
         backend.heal("r1")
 
@@ -357,12 +483,34 @@ def run_drill(mode: str = "fake", *, rate_hz: float = 200.0,
             time.sleep(probe_interval_s / 2)
         readmit_s = None if ts_closed is None else ts_closed - t_restart
         load.phase[0] = "cooldown"
+        # the restarted replica reports RESET counters: the clamped delta
+        # must keep every federated rollup monotone
+        federation.scrape_once()
     finally:
         load.stop()
         router.shutdown()
         backend.close()
         if tmpdir is not None:
             tmpdir.cleanup()
+
+    # ---- trace stitch (fake mode): every served route must reassemble
+    # into one router→replica tree on its X-Fleet-Trace id
+    stitch_report = None
+    if stitch:
+        with tempfile.TemporaryDirectory(prefix="fleet_stitch_") as sd:
+            router_path = os.path.join(sd, "router.json")
+            events = [e for e in router_tracer.chrome_events()
+                      if e.get("ph") == "M"
+                      or e.get("ts", 0.0) >= trace_t0_us - 1.0]
+            with open(router_path, "w") as fh:
+                json.dump({"traceEvents": events,
+                           "displayTimeUnit": "ms",
+                           "otherData": {
+                               "process": router_tracer.process_meta()}},
+                          fh)
+            replica_paths = backend.export_traces(sd)
+            stitch_report = trace_report.stitch_files(
+                [router_path] + replica_paths)
 
     steady = load.counts("steady")
     kill = load.counts("kill")
@@ -380,6 +528,23 @@ def run_drill(mode: str = "fake", *, rate_hz: float = 200.0,
             return 0
         with metric._lock:
             return sum(metric._series.values())
+
+    def _fed_requests_total() -> float:
+        """The federated request rollup: every non-replica-labelled
+        series summed (the per-tenant rollups partition the total)."""
+        metric = federation.fleet_registry.get("svgd_serve_requests_total")
+        if metric is None:
+            return 0.0
+        return float(sum(metric.value(**ls) for ls in metric.label_sets()
+                         if "replica" not in ls))
+
+    def _scrape_ms(reg) -> Optional[float]:
+        """Median federation sweep wall (ms) off the scrape histogram —
+        robust to the one slow sweep a phase transition can catch."""
+        hist = reg.get("svgd_fleet_scrape_seconds")
+        if hist is None or not hist.summary()["count"]:
+            return None
+        return round(hist.quantile(0.5) * 1e3, 3)
 
     row = {
         "metric": "fleet_failover",
@@ -410,6 +575,25 @@ def run_drill(mode: str = "fake", *, rate_hz: float = 200.0,
         "partition_flight_trips": (
             None if partition_clean is None
             else partition_clean["flight_trips"]),
+        # cross-process observability (round 16)
+        "trace_stitch_coverage": (
+            None if stitch_report is None else stitch_report["coverage"]),
+        "stitch_served_routes": (
+            None if stitch_report is None
+            else stitch_report["served_routes"]),
+        "stitch_retry_trees": (
+            None if stitch_report is None
+            else stitch_report["retry_trees"]),
+        "stitch_orphans": (
+            None if stitch_report is None
+            else stitch_report["orphan_replica_traces"]),
+        "federation_scrape_ms": _scrape_ms(registry),
+        "federation_scrapes": federation.scrapes,
+        "federation_scrapes_skipped": federation.skips,
+        "federation_scrape_errors": int(
+            _counter_sum("svgd_fleet_scrape_errors_total")),
+        "federation_monotone": federation.monotone,
+        "federated_requests_total": _fed_requests_total(),
         "probe_interval_s": probe_interval_s,
         "open_cooldown_s": open_cooldown_s,
         "status_counts": {
@@ -441,6 +625,19 @@ def row_ok(row: Dict[str, Any]) -> Tuple[bool, List[str]]:
                    "the process untouched")
     if row["partition_flight_trips"] not in (None, 0):
         why.append("partition tripped the replica's own flight recorder")
+    # cross-process observability gates (round 16).  Stitch coverage is a
+    # fake-mode contract: replica traces there model streamed exports, so
+    # EVERY served request must reassemble (real mode reads null — a
+    # SIGKILLed process takes its trace buffer with it).
+    if row.get("mode") == "fake":
+        cov = row.get("trace_stitch_coverage")
+        if cov is None or cov < 1.0:
+            why.append(f"trace stitch coverage {cov} < 1.0 — some served "
+                       "request's router and replica spans no longer join "
+                       "on the trace id")
+    if row.get("federation_monotone") is False:
+        why.append("a federated counter rollup decreased across scrapes — "
+                   "the restart clamp broke (negative rates)")
     return (not why), why
 
 

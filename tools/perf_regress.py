@@ -77,6 +77,18 @@ A/B timing protocol those notes derived:
   ``fleet_detect_s`` / ``fleet_readmit_s`` gate against their own
   median+MAD incumbent windows.
 
+- **fleet observability gates (round 16)** — ``fleet_trace_stitch``
+  (a fake-mode ``fleet_drill`` run whose per-process trace exports are
+  stitched by ``trace_report.stitch_files``) FAILs unconditionally when
+  coverage drops below 1.0 — any served request whose router and replica
+  spans no longer join on the ``X-Fleet-Trace`` id — or when a federated
+  counter rollup ever decreases across scrapes (the counter-reset clamp
+  broke).  ``fleet_federation_scrape_ms`` (the real-subprocess drill's
+  median federation sweep wall) gates against its own median+MAD window.
+  The existing 3% ``telemetry_overhead`` ceiling stays binding with trace
+  propagation enabled: while tracing is on, every batcher submit mints
+  and threads a trace id, so the tracer-on A/B arm prices propagation in.
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -134,7 +146,10 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
               "elastic_reshard_wall_s": 2.0, "elastic_recovery_wall_s": 2.0,
               # the fleet walls measure probe scheduling + subprocess
               # restart (readmit includes a cold jax import) — host-noisy
-              "fleet_detect_s": 2.0, "fleet_readmit_s": 2.0}
+              "fleet_detect_s": 2.0, "fleet_readmit_s": 2.0,
+              # the federation sweep is N sequential HTTP scrapes + a
+              # dump merge — host-scheduling-noisy like the other walls
+              "fleet_federation_scrape_ms": 2.0}
 
 #: Hard ceiling on the span tracer's measured serve-bench cost (round 10):
 #: the interleaved tracer-off/on A/B (``serve_bench.
@@ -831,9 +846,12 @@ def main():
     print(json.dumps(row), flush=True)
     if fleet_ok:
         for key, field in (("fleet_detect_s", "detect_s"),
-                           ("fleet_readmit_s", "readmit_s")):
+                           ("fleet_readmit_s", "readmit_s"),
+                           ("fleet_federation_scrape_ms",
+                            "federation_scrape_ms")):
             value = frow[field]
-            row = {"bench": key, "value": value, "unit": "s"}
+            row = {"bench": key, "value": value,
+                   "unit": "ms" if key.endswith("_ms") else "s"}
             tol = min(args.tol * TOL_FACTOR.get(key, 1.0), 0.9)
             status, info = judge_row(
                 value, incumbent_history(incumbents, key), tol, False,
@@ -844,6 +862,38 @@ def main():
                 failures += 1
             results[key] = value
             print(json.dumps(row), flush=True)
+
+    # fleet observability gates (round 16): trace-stitch coverage from
+    # the FAKE drill — its replica stand-ins model replicas streaming
+    # their trace exports off-process, so EVERY served route must
+    # reassemble into one router→replica tree on its X-Fleet-Trace id
+    # (real mode cannot carry this gate: a SIGKILLed replica takes its
+    # in-memory trace buffer with it).  Coverage below 1.0 — or a
+    # non-monotone federated counter rollup (the restart clamp broke) —
+    # is an unconditional FAIL regardless of every wall above.
+    fake_frow = fleet_drill.run_drill(mode="fake")
+    row = {"bench": "fleet_trace_stitch",
+           "value": fake_frow.get("trace_stitch_coverage"),
+           "unit": "fraction of served routes stitched to a replica tree",
+           "served_routes": fake_frow.get("stitch_served_routes"),
+           "retry_trees": fake_frow.get("stitch_retry_trees"),
+           "orphans": fake_frow.get("stitch_orphans"),
+           "federation_monotone": fake_frow.get("federation_monotone")}
+    cov = fake_frow.get("trace_stitch_coverage")
+    if cov is None or cov < 1.0:
+        row["status"] = "FAIL"
+        row["error"] = (f"stitch coverage {cov} < 1.0 — a served "
+                        "request's router and replica spans no longer "
+                        "join on the trace id")
+        failures += 1
+    elif fake_frow.get("federation_monotone") is False:
+        row["status"] = "FAIL"
+        row["error"] = ("a federated counter rollup decreased across "
+                        "scrapes — the restart clamp broke")
+        failures += 1
+    else:
+        row["status"] = "PASS"
+    print(json.dumps(row), flush=True)
 
     print(json.dumps({
         "summary": "FAIL" if failures else "PASS",

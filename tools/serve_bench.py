@@ -402,7 +402,12 @@ def run_bench(model="logreg", n_particles=10_000, n_features=54,
     row.update(
         serve_latency_p99=hist_ms["p99"],
         latency_hist_ms=hist_ms,
+        # trace_propagation: while tracing is on, every submit mints and
+        # threads an X-Fleet-Trace-style id through its lane tree (round
+        # 16) — so the tracer-on arm of the telemetry-overhead A/B prices
+        # propagation in, and the existing 3% ceiling stays binding
         telemetry={"tracing": bool(trace),
+                   "trace_propagation": bool(trace),
                    "queue_depth_last": registry.gauge(
                        "svgd_serve_queue_depth_rows").value(
                            batcher=batcher.metrics_instance),
