@@ -37,6 +37,7 @@ across a `jax.sharding.Mesh` with `lax.all_gather` / `lax.psum` /
 
 from dist_svgd_tpu.sampler import Sampler
 from dist_svgd_tpu.distsampler import DistSampler
+from dist_svgd_tpu.ops.approx import KernelApprox
 from dist_svgd_tpu.ops.kernels import (
     RBF,
     AdaptiveRBF,
@@ -51,6 +52,7 @@ __all__ = [
     "DistSampler",
     "RBF",
     "AdaptiveRBF",
+    "KernelApprox",
     "median_bandwidth",
     "median_bandwidth_approx",
     "__version__",

@@ -40,6 +40,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dist_svgd_tpu.ops.approx import (
+    APPROX_METHOD_CODES,
+    approx_preferred,
+    as_kernel_approx,
+    nystrom_landmark_indices,
+)
 from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.ot import wasserstein_grad_lp, wasserstein_grad_sinkhorn
 from dist_svgd_tpu.parallel.exchange import (
@@ -205,6 +211,36 @@ class DistSampler:
             RBF kernel at Gram-bound sizes, XLA otherwise), ``'xla'``,
             ``'pallas'`` (force), or ``'pallas_bf16'`` (bf16-Gram variant);
             see :func:`dist_svgd_tpu.ops.pallas_svgd.resolve_phi_fn`.
+        kernel_approx: ``None`` (exact Gram φ — the default), ``'rff'``,
+            ``'nystrom'``, or a :class:`~dist_svgd_tpu.ops.approx.
+            KernelApprox` with explicit ``num_features``/``num_landmarks``
+            dials — the sub-quadratic φ (``ops/approx.py``), O(n·R·d) /
+            O(n·L·d) instead of O(n²), for particle counts the exact
+            kernel cannot touch.  A drop-in ``phi_fn`` at the
+            ``resolve_phi_fn`` seam, so it shards, ring/gather-exchanges,
+            dispatch-budget-chunks, and composes with the W2 term
+            unchanged.  With ``phi_impl='auto'`` the (n, R) crossover is
+            resolved ONCE here from the global shape (the same decision at
+            any shard count — shard invariance) and pinned for every φ
+            call: exact below it, approximate above; see
+            :attr:`kernel_approx_active`.  The RFF bank key derives from
+            ``seed`` (``utils/rng.py:approx_bank_key``) and rides
+            :meth:`state_dict`, so resumed/resharded runs re-derive the
+            identical bank.  Requires an RBF-family kernel and the Jacobi
+            update rule; ``'rff'`` additionally requires a bandwidth
+            frozen before the bank is built — ``kernel='median'`` composes
+            (resolved here, before construction), ``'median_step'`` is
+            refused in one line (``'nystrom'`` composes with it).
+        donate_carries: donate the training-step carries (particles, W2
+            snapshots, Sinkhorn duals, intra-step chunk accumulators) to
+            XLA at every scanned/chunked dispatch — the carry buffers stop
+            re-allocating per dispatch (ROADMAP item 1's last slice).
+            Bitwise-identical trajectories either way (pinned in
+            tests/test_approx.py); ``False`` restores the undonated path
+            (the A/B baseline — ``tools/profile_step_floor.py
+            --donate-ab``).  Off, automatically, for the eager
+            :meth:`make_step` path, whose pre-update array outlives the
+            dispatch.
         w2_pairing: which sets the Wasserstein term pairs, in the exchanged
             (``all_*``) modes.  ``'global'`` is the reference's literal
             (warty) semantics: each shard pairs its block against the full
@@ -255,6 +291,8 @@ class DistSampler:
         phi_impl: str = "auto",
         w2_pairing: str = "auto",
         seed=0,
+        kernel_approx=None,
+        donate_carries: bool = True,
     ):
         assert not (exchange_scores and not exchange_particles), (
             "must exchange particles to also exchange scores"
@@ -332,6 +370,12 @@ class DistSampler:
         self._num_particles = self._particles_per_shard * self._num_shards
         # NOTE: drops particles if not divisible by num_shards (reference
         # behaviour, dsvgd/distsampler.py:42-45).
+        if donate_carries:
+            # the scanned runs donate the particle carry, and an identity
+            # slice below can alias the CALLER's array — copy once here so
+            # caller buffers are never invalidated (same discipline as
+            # Sampler.run's initial_particles copy)
+            particles = jnp.array(particles)
         self._particles = particles[: self._num_particles]
         self._d = particles.shape[1]
 
@@ -437,6 +481,45 @@ class DistSampler:
         # real mesh each device runs a single lane (resolve_phi_fn docstring)
         self._phi_batch_hint = self._num_shards if self._mesh is None else 1
 
+        # Sub-quadratic kernel approximation (constructor docstring).  The
+        # 'auto' crossover is resolved ONCE from the GLOBAL shape and
+        # pinned: resolve_phi_fn's per-call-shape crossover would let the
+        # ring's small per-hop blocks pick a different backend than the
+        # gather's global set, silently breaking ring ≡ gather and shard
+        # invariance.  Exchanged modes pin the same decision at any S
+        # (k_eff = m = n); the partitions decision depends on the block
+        # size, so the pinned flag ALSO rides state_dict and a resumed
+        # run adopts the saved pin (load_state_dict) instead of
+        # re-deciding at the new topology.  Validation (RBF-only, AdaptiveRBF+rff refusal,
+        # pallas incompatibility, missing-key) runs through the ONE policy
+        # seam so this constructor cannot drift from direct resolve users.
+        self._approx = as_kernel_approx(kernel_approx)
+        self._approx_active = False
+        if self._approx is not None:
+            if update_rule != "jacobi":
+                raise ValueError(
+                    "kernel_approx requires update_rule='jacobi': the "
+                    "Gauss-Seidel sweep exists for literal reference "
+                    "parity, which an approximate kernel cannot provide"
+                )
+            if self._approx.method == "rff":
+                from dist_svgd_tpu.utils.rng import approx_bank_key
+
+                self._approx = self._approx.with_key(approx_bank_key(seed))
+            from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
+
+            resolve_phi_fn(self._kernel, phi_impl, self._phi_batch_hint,
+                           self._approx)  # validation only
+            if phi_impl == "auto":
+                m_interact = (self._num_particles
+                              if self._mode != PARTITIONS
+                              else self._particles_per_shard)
+                self._approx_active = approx_preferred(
+                    self._num_particles, m_interact,
+                    self._approx.feature_count)
+            else:
+                self._approx_active = True  # 'xla' = always approximate
+
         if shard_data and self._data is not None:
             # truncate to divisible row count before the mesh split (the
             # replicated path drops the remainder at slice time instead)
@@ -452,46 +535,9 @@ class DistSampler:
         # real mesh (vmap emulation) the plan degrades to plain jit.
         self._plan = Plan(self._mesh)
         self._data_spec = 0 if shard_data else None
-        step = make_shard_step(
-            logp=self._logp,
-            kernel=self._kernel,
-            mode=self._mode,
-            num_shards=self._num_shards,
-            n_local_data=self._rows_per_shard,
-            score_scale=self._score_scale,
-            ring=(exchange_impl == "ring"),
-            shard_data=shard_data,
-            batch_size=batch_size,
-            log_prior=log_prior,
-            phi_impl=phi_impl,
-            update_rule=update_rule,
-            phi_batch_hint=self._phi_batch_hint,
-        )
-        self._bound_step = bind_shard_fn(
-            step,
-            self._num_shards,
-            self._mesh,
-            in_specs=(0, self._data_spec, 0, None, None, None, None),
-            out_specs=(0,),
-        )
-        self._step = self._plan.compile_sharded(
-            self._bound_step,
-            in_specs=(0, self._data_spec, 0, None, None, None, None),
-            out_specs=(0,),
-        )
+        self._donate = bool(donate_carries)
         self._exchange_every = int(exchange_every)
-        self._bound_lagged = None
-        self._bound_lagged_record = None  # built lazily on first record run
-        if self._exchange_every > 1:
-            self._bound_lagged = self._bind_lagged(record=False)
-        self._scan_cache = {}
-        self._bound_w2_step = None  # lazily built by _run_steps_w2
-        # Chunked-executor caches (run_steps(dispatch_budget=...)): the
-        # per-shard hop-chunk builders and their bound/jitted programs,
-        # keyed by (kind, num_hops, rotate_last) — at most a handful of
-        # distinct programs per sampler (_chunk_sizes yields ≤ 2 sizes).
-        self._chunk_builders = None
-        self._chunk_cache = {}
+        self._build_step_programs()
         #: Execution report of the most recent :meth:`run_steps` call —
         #: ``execution`` mode, ``num_dispatches``, ``dispatches_per_step``,
         #: the resolved chunking knobs, ``max_dispatch_wall_s`` (when timed),
@@ -514,6 +560,68 @@ class DistSampler:
         # the cold start.
         self._w2_g = None
 
+    def _phi_kwargs(self) -> dict:
+        """The ``(phi_impl, kernel_approx)`` pair every step builder gets.
+
+        With the approximation pinned active, the builders see
+        ``phi_impl='xla'`` + the spec — resolve_phi_fn's always-approximate
+        combination — so every φ call site (gather, ring hops, chunk
+        programs, the W2 step) uses the approximate backend uniformly;
+        pinned inactive, the original exact configuration."""
+        if self._approx is not None and self._approx_active:
+            return {"phi_impl": "xla", "kernel_approx": self._approx}
+        return {"phi_impl": self._phi_impl, "kernel_approx": None}
+
+    def _build_step_programs(self) -> None:
+        """(Re)build every bound/compiled step program from the current
+        kernel + approximation configuration.  Called once from
+        ``__init__`` and again by :meth:`load_state_dict` when a restored
+        checkpoint carries a different RFF bank key (the saved bank wins —
+        bitwise resume beats the constructed seed)."""
+        step = make_shard_step(
+            logp=self._logp,
+            kernel=self._kernel,
+            mode=self._mode,
+            num_shards=self._num_shards,
+            n_local_data=self._rows_per_shard,
+            score_scale=self._score_scale,
+            ring=(self._exchange_impl == "ring"),
+            shard_data=self._shard_data,
+            batch_size=self._batch_size,
+            log_prior=self._log_prior,
+            update_rule=self._update_rule,
+            phi_batch_hint=self._phi_batch_hint,
+            **self._phi_kwargs(),
+        )
+        self._bound_step = bind_shard_fn(
+            step,
+            self._num_shards,
+            self._mesh,
+            in_specs=(0, self._data_spec, 0, None, None, None, None),
+            out_specs=(0,),
+        )
+        # the eager step is NOT donated: make_step's W2 bookkeeping reads
+        # the pre-update array after the dispatch (donation lives on the
+        # scanned/chunked paths, whose carries this object owns)
+        self._step = self._plan.compile_sharded(
+            self._bound_step,
+            in_specs=(0, self._data_spec, 0, None, None, None, None),
+            out_specs=(0,),
+        )
+        self._bound_lagged = None
+        self._bound_lagged_record = None  # built lazily on first record run
+        if self._exchange_every > 1:
+            self._bound_lagged = self._bind_lagged(record=False)
+        self._scan_cache = {}
+        self._bound_w2_step = None  # lazily built by _run_steps_w2
+        # Chunked-executor caches (run_steps(dispatch_budget=...)): the
+        # per-shard hop-chunk builders and their bound/jitted programs,
+        # keyed by (kind, num_hops, rotate_last) — at most a handful of
+        # distinct programs per sampler (_chunk_sizes yields ≤ 2 sizes).
+        self._chunk_builders = None
+        self._chunk_cache = {}
+        self._sinkhorn_batched = None  # lazily-built jitted vmap solver
+
     def _bind_lagged(self, record: bool):
         """Bind the lagged macro-step (``record=True`` additionally emits the
         per-sub-step pre-update history stack, sharded along its particle
@@ -530,9 +638,9 @@ class DistSampler:
             shard_data=self._shard_data,
             batch_size=self._batch_size,
             log_prior=self._log_prior,
-            phi_impl=self._phi_impl,
             phi_batch_hint=self._phi_batch_hint,
             record=record,
+            **self._phi_kwargs(),
         )
         return bind_shard_fn(
             lagged,
@@ -566,6 +674,60 @@ class DistSampler:
         boundary optimise different W2 functionals.  Also written into
         :meth:`state_dict` and the bench/large-n JSON records."""
         return self._w2_pairing
+
+    @property
+    def kernel_approx(self):
+        """The resolved :class:`~dist_svgd_tpu.ops.approx.KernelApprox`
+        (RFF bank key bound), or ``None`` when running the exact kernel."""
+        return self._approx
+
+    @property
+    def kernel_approx_active(self) -> bool:
+        """Whether φ actually runs the approximate backend after the
+        ``phi_impl='auto'`` global-shape crossover (constructor docstring)
+        — record it with experiment configs, like :attr:`w2_pairing`."""
+        return self._approx is not None and self._approx_active
+
+    def approx_residual(self, max_points: int = 512, registry=None) -> dict:
+        """Measure the feature-space φ residual of the configured
+        approximation on the CURRENT ensemble (exact vs approximate φ over
+        a ≤``max_points`` strided subsample) and publish it as
+        ``svgd_diag_phi_approx_*`` gauges, so drift guards and SLOs watch
+        approximation health next to KSD/ESS.  Probe scores are the
+        full-data (unscaled) ``∇log p`` plus the prior — representative of
+        every exchange mode's score magnitude without reproducing any one
+        mode's scaling.  O(max_points²) on host-visible state; run it at
+        diagnostics cadence, not per step."""
+        from dist_svgd_tpu.ops.approx import (
+            phi_residual_report,
+            record_phi_residual,
+        )
+
+        if self._approx is None:
+            raise ValueError(
+                "approx_residual needs kernel_approx (exact runs have no "
+                "approximation residual to measure)"
+            )
+        particles = jnp.asarray(self._particles)
+        n = particles.shape[0]
+        if n > max_points:
+            stride = -(-n // max_points)
+            particles = particles[::stride]
+        scores = jax.vmap(jax.grad(self._logp, argnums=0),
+                          in_axes=(0, None))(particles, self._data)
+        if self._log_prior is not None:
+            scores = scores + jax.vmap(jax.grad(self._log_prior))(particles)
+        if isinstance(self._kernel, RBF):
+            kernel = self._kernel
+        else:  # AdaptiveRBF: probe at the current per-step median bandwidth
+            from dist_svgd_tpu.ops.kernels import median_bandwidth_approx
+
+            kernel = RBF(float(median_bandwidth_approx(particles)))
+        report = phi_residual_report(particles, scores, kernel, self._approx,
+                                     max_points=max_points)
+        report["active"] = bool(self._approx_active)
+        record_phi_residual(report, registry=registry)
+        return report
 
     def owned_block_index(self, rank: int, t: Optional[int] = None) -> int:
         """Logical block index owned by (= updated against the data slice of)
@@ -627,6 +789,8 @@ class DistSampler:
         # call computes every shard's gradient (no per-block host round-trips)
         if self._sinkhorn_batched is None:
             warm = self._sinkhorn_warm_start
+            # the carried dual donates (the cur/prev stacks are rebuilt
+            # from sampler state each step and must not)
             self._sinkhorn_batched = self._plan.compile_sharded(
                 jax.vmap(
                     lambda c, p, g: wasserstein_grad_sinkhorn(
@@ -634,7 +798,8 @@ class DistSampler:
                         iters=self._sinkhorn_iters, tol=self._sinkhorn_tol,
                         g_init=g if warm else None, return_g=True,
                     )
-                )
+                ),
+                donate_argnums=(2,) if self._donate else (),
             )
         if self._w2_g is None:
             g0 = jnp.zeros(self._g_shape(), dtype=jnp.asarray(cur).dtype)
@@ -713,6 +878,30 @@ class DistSampler:
             self._num_shards, self._num_particles, self._d,
             self._rows_per_shard,
         ))
+        if self._approx is not None:
+            # the approximation identity: method + dial + (rff) the bank
+            # key / (nystrom) the landmark indices of the gathered-set
+            # selection.  All layout-free — reshard_state passes them
+            # through verbatim, and a resharded resume re-derives the
+            # identical bank/landmarks (utils/checkpoint.py)
+            state["approx_method"] = np.asarray(
+                APPROX_METHOD_CODES.index(self._approx.method), dtype=np.int8
+            )
+            state["approx_dial"] = np.asarray(
+                self._approx.accuracy_dial, dtype=np.int64
+            )
+            state["approx_active"] = np.asarray(
+                int(self._approx_active), dtype=np.int8
+            )
+            if self._approx.method == "rff":
+                state["approx_bank_key"] = np.asarray(self._approx.key)
+            else:
+                m_interact = (self._num_particles
+                              if self._mode != PARTITIONS
+                              else self._particles_per_shard)
+                state["approx_landmark_idx"] = nystrom_landmark_indices(
+                    m_interact, self._approx.num_landmarks
+                ).astype(np.int64)
         if self._previous is None:
             state["previous"] = None
         else:
@@ -886,6 +1075,51 @@ class DistSampler:
             # the saved minibatch root: layout-free (per-step keys fold
             # (root, t)), so a resharded resume re-derives the exact stream
             self._batch_key = jnp.asarray(np.asarray(key))
+        acode = state.get("approx_method")
+        if (acode is None) != (self._approx is None):
+            want = (self._approx.method if self._approx is not None
+                    else "exact")
+            saved = ("exact" if acode is None
+                     else APPROX_METHOD_CODES[int(np.asarray(acode))])
+            raise ValueError(
+                f"checkpoint was written with kernel_approx={saved!r} but "
+                f"this sampler runs {want!r}: resuming would silently "
+                "switch φ backends mid-trajectory — construct the sampler "
+                "with the checkpoint's kernel_approx (or retrain)"
+            )
+        if acode is not None:
+            saved_method = APPROX_METHOD_CODES[int(np.asarray(acode))]
+            saved_dial = int(np.asarray(state["approx_dial"]))
+            if (saved_method != self._approx.method
+                    or saved_dial != self._approx.accuracy_dial):
+                raise ValueError(
+                    f"checkpoint kernel_approx is {saved_method!r} at dial "
+                    f"{saved_dial} but this sampler runs "
+                    f"{self._approx.method!r} at "
+                    f"{self._approx.accuracy_dial}: the accuracy dial is "
+                    "part of the trajectory — match the saved configuration"
+                )
+            rebuild = False
+            bank = state.get("approx_bank_key")
+            if bank is not None and not np.array_equal(
+                    np.asarray(bank), np.asarray(self._approx.key)):
+                # the SAVED bank wins: bitwise resume of the original
+                # trajectory beats the key this construction's seed derived
+                self._approx = self._approx.with_key(
+                    jnp.asarray(np.asarray(bank)))
+                rebuild = True
+            active = state.get("approx_active")
+            if (active is not None
+                    and bool(int(np.asarray(active))) != self._approx_active):
+                # the SAVED crossover pin wins too: in partitions mode the
+                # 'auto' decision depends on the block size, so a resharded
+                # resume could re-pin the other backend — a silent
+                # φ-backend switch mid-trajectory, exactly what the
+                # method/dial refusals above exist to prevent
+                self._approx_active = bool(int(np.asarray(active)))
+                rebuild = True
+            if rebuild:
+                self._build_step_programs()
         self._t = int(state["t"])
 
     # ------------------------------------------------------------------ #
@@ -1247,11 +1481,25 @@ class DistSampler:
                 shard_data=self._shard_data,
                 batch_size=self._batch_size,
                 log_prior=self._log_prior,
-                phi_impl=self._phi_impl,
                 phi_batch_hint=self._phi_batch_hint,
+                **self._phi_kwargs(),
             )
         b = self._chunk_builders
         data_spec = self._data_spec
+        # Chunk-carry donation (ROADMAP item 1): the executor-owned carries
+        # — partial φ accumulators, travelling scores, and the rotated
+        # visiting/score pairs of the exact-φ pass — donate, so the relay
+        # chain stops re-allocating them per dispatch.  The particle block
+        # and the FIRST dispatch's visiting block alias self._particles
+        # (reused across chunks and by later passes) and never donate.
+        don = {
+            "local": (2,),            # acc (zeros-seeded)
+            "score": (1,),            # vscores (zeros-seeded)
+            "exact_phi": (1, 2, 3),   # visiting/vscores from the score
+                                      # pass, acc zeros-seeded
+            "add_prior": (1,),        # vscores (consumed)
+            "finish": (1, 2),         # acc + w_grad (both step-local)
+        }[kind] if self._donate else ()
         if kind == "local":
             num_hops, rotate_last = args
             fn = self._plan.compile_sharded(bind_shard_fn(
@@ -1259,7 +1507,7 @@ class DistSampler:
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, 0, data_spec, None, None),
                 out_specs=(0, 0),
-            ))
+            ), donate_argnums=don)
         elif kind == "score":
             (num_hops,) = args
             fn = self._plan.compile_sharded(bind_shard_fn(
@@ -1267,7 +1515,7 @@ class DistSampler:
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, data_spec, None, None),
                 out_specs=(0, 0),
-            ))
+            ), donate_argnums=don)
         elif kind == "exact_phi":
             num_hops, rotate_last = args
             fn = self._plan.compile_sharded(bind_shard_fn(
@@ -1275,13 +1523,14 @@ class DistSampler:
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, 0, 0),
                 out_specs=(0, 0, 0),
-            ))
+            ), donate_argnums=don)
         elif kind == "add_prior":
             # row-wise elementwise: applies to the merged global arrays
             # directly, no binding needed (same for 'finish')
-            fn = self._plan.compile_sharded(b["add_prior"])
+            fn = self._plan.compile_sharded(b["add_prior"],
+                                            donate_argnums=don)
         elif kind == "finish":
-            fn = self._plan.compile_sharded(b["finish"])
+            fn = self._plan.compile_sharded(b["finish"], donate_argnums=don)
         else:  # pragma: no cover - internal
             raise ValueError(f"unknown chunk kind {kind!r}")
         self._chunk_cache[key] = fn
@@ -1313,7 +1562,13 @@ class DistSampler:
                     g_init=None if cold else g, return_g=True,
                 )
 
-        fn = self._plan.compile_sharded(jax.vmap(per))
+        # the threaded dual g is the chain's carry — donated like every
+        # executor-owned carry (the cur/prev inputs are reused across
+        # chunks and stay undonated)
+        fn = self._plan.compile_sharded(
+            jax.vmap(per),
+            donate_argnums=(2,) if self._donate else (),
+        )
         self._chunk_cache[key] = fn
         return fn
 
@@ -1598,11 +1853,15 @@ class DistSampler:
 
             # plan-routed compile: particles sharded in/out along the mesh
             # axis (history along its particle axis 1), everything else
-            # replicated — plain jit under the vmap emulation
+            # replicated — plain jit under the vmap emulation.  The carry
+            # is donated (ROADMAP item 1): the input particle buffer
+            # aliases the output instead of re-allocating per dispatch —
+            # this object owns it and replaces it right after the call
             run = self._plan.compile_sharded(
                 scan_run,
                 in_specs=(0, self._data_spec, None, None, None, None),
                 out_specs=(0, 1) if record else (0,),
+                donate_argnums=(0,) if self._donate else (),
             )
             self._scan_cache[(num_steps, record, lagged)] = run
         out = run(
@@ -1636,7 +1895,6 @@ class DistSampler:
                 shard_data=self._shard_data,
                 batch_size=self._batch_size,
                 log_prior=self._log_prior,
-                phi_impl=self._phi_impl,
                 sinkhorn_eps=self._sinkhorn_eps,
                 sinkhorn_iters=self._sinkhorn_iters,
                 sinkhorn_tol=self._sinkhorn_tol,
@@ -1646,6 +1904,7 @@ class DistSampler:
                 w2_pairing=self._w2_pairing,
                 ring=(self._exchange_impl == "ring"
                       and self._mode != PARTITIONS),
+                **self._phi_kwargs(),
             )
             self._bound_w2_step = bind_shard_fn(
                 step,
@@ -1685,12 +1944,15 @@ class DistSampler:
                 return out, prev_out, g_out, hist
 
             # plan-routed: particle array and the per-shard snapshot/dual
-            # stacks sharded along their leading axes, history along axis 1
+            # stacks sharded along their leading axes, history along axis 1.
+            # ALL three carries (particles, W2 snapshots, Sinkhorn duals)
+            # donate: this object owns each and replaces it after the call
             run = self._plan.compile_sharded(
                 scan_run,
                 in_specs=(0, 0, 0, None, self._data_spec, None, None,
                           None, None),
                 out_specs=(0, 0, 0, 1 if record else None),
+                donate_argnums=(0, 1, 2) if self._donate else (),
             )
             self._scan_cache[("w2", num_steps, record)] = run
 

@@ -16,10 +16,22 @@ from dist_svgd_tpu.ops.svgd import (
     svgd_step,
     svgd_step_sequential,
 )
+from dist_svgd_tpu.ops.approx import (
+    KernelApprox,
+    as_kernel_approx,
+    default_error_budget,
+    phi_nystrom,
+    phi_rff,
+)
 
 __all__ = [
     "RBF",
     "AdaptiveRBF",
+    "KernelApprox",
+    "as_kernel_approx",
+    "default_error_budget",
+    "phi_nystrom",
+    "phi_rff",
     "kernel_matrix",
     "kernel_grad_matrix",
     "median_bandwidth",

@@ -1,0 +1,433 @@
+"""Sub-quadratic φ: random-feature and Nyström kernel approximations.
+
+Every φ backend in :mod:`dist_svgd_tpu.ops.svgd` / ``pallas_svgd`` evaluates
+the exact RBF Gram matrix — O(n²) pairwise interactions per step, the
+scalability wall between the measured 2M-particle rows and the 10M+ regime
+(ROADMAP item 2; PAPER.md §0's fixed-bandwidth RBF is what makes the
+closed forms below available).  This module provides two drop-in φ
+approximations with the **same** ``phi_fn(updated, interacting, scores)``
+signature as the exact backends, so everything built on that seam —
+mesh sharding, ring/gather exchange, dispatch-budget chunking, the W2
+proximal term — composes unchanged through ``resolve_phi_fn``:
+
+- **Random Fourier features** (Rahimi & Recht 2007): ``k(x, y) =
+  exp(-‖x−y‖²/h) = E_w[cos(wᵀ(x−y))]`` with ``w ~ N(0, (2/h)·I)``.  With a
+  shared R-frequency bank the SVGD drive term collapses to two
+  feature-space matmuls through the ``(2R, d)`` summary ``Φ(X)ᵀS`` and the
+  repulsive term to one more through the analytic feature gradient —
+  O((m+k)·R·d) total, the ``(m, k)`` Gram matrix never exists.  Error
+  ~O(1/√R), dialled by ``num_features``.
+- **Nyström landmarks**: ``k̂(x, y) = k(x, Z) (K_ZZ + λI)⁻¹ k(Z, y)`` over
+  an evenly-strided L-point landmark set Z re-selected from each call's
+  interaction set (so landmarks track the moving particles with no carried
+  state).  Both φ terms factor through Cholesky solves against the (L, L)
+  landmark system (the Woodbury/normal-equations factor) — O(n·L·d + L³),
+  with the exact-recovery property k̂ → k as L → m.
+
+Both are **linear in the interaction set**, which is what makes the ring
+exchange's hop-accumulated φ (``parallel/exchange.py``) and the chunked
+dispatch executors correct without modification: the sum of per-block
+approximate φ contributions is the approximate φ of the (blockwise-
+approximated) whole.  Under the ring, each hop approximates its visiting
+block with that block's own features/landmarks — same O(n/S) per-device
+memory story as the exact ring.
+
+Bandwidth discipline: the closed forms above are functions of ONE static
+bandwidth.  ``kernel='median'`` therefore resolves the bandwidth *before*
+the bank/landmark machinery is built (the samplers order it that way), and
+``AdaptiveRBF`` (``kernel='median_step'``) is refused for ``'rff'`` — the
+bank is drawn at a frozen bandwidth, and per-step drift would silently
+decalibrate it (re-drawing per step is future work).  ``'nystrom'``
+composes with the adaptive bandwidth through the exact rescaling identity
+(landmarks are re-selected and re-factored per call anyway).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_svgd_tpu.ops.kernels import RBF, squared_distances
+
+APPROX_METHODS = ("rff", "nystrom")
+
+#: ``state_dict`` encoding of the approximation method (orbax/tensorstore
+#: cannot serialise unicode arrays — same convention as ``W2_PAIRING_CODES``).
+APPROX_METHOD_CODES = APPROX_METHODS
+
+#: ``'auto'`` crossover factor: the approximate φ is preferred once the
+#: exact Gram pair count ``k·m`` exceeds ``factor × (k+m) × F`` feature
+#: evaluations (F = 2·num_features for RFF — cos and sin banks — and
+#: num_landmarks for Nyström).  1.0 is the flop-balance point; the measured
+#: CPU walls cross within ~2× of it at every probed shape (docs/notes.md
+#: round-17 crossover table), and below it the exact kernel is both faster
+#: AND exact, so ties go to exact.
+APPROX_CROSSOVER_FACTOR = 1.0
+
+
+class KernelApprox:
+    """Static configuration of a sub-quadratic φ approximation.
+
+    Args:
+        method: ``'rff'`` or ``'nystrom'``.
+        num_features: RFF frequency count R (the bank holds R cos + R sin
+            features).  The accuracy dial: φ error ~O(1/√R).
+        num_landmarks: Nyström landmark count L (strided from each call's
+            interaction set).  Exact at L = m.
+        ridge: Tikhonov jitter on the (L, L) landmark system — keeps the
+            Cholesky factor well-posed when the smooth RBF spectrum makes
+            K_ZZ numerically rank-deficient in f32 (measured: 1e-6 NaNs
+            the factor from L=1024, 1e-5 from L=2048; 1e-4 is stable
+            through L=4096 at ≤ 3e-4 added relative φ error —
+            docs/notes.md round 17).
+        key: PRNG key the RFF bank is drawn from (``utils/rng.py:
+            approx_bank_key``).  The samplers derive it from the run seed;
+            direct ``resolve_phi_fn`` users must supply it for ``'rff'``.
+
+    Instances are static configuration (close over them, like
+    :class:`~dist_svgd_tpu.ops.kernels.RBF`); :meth:`cache_token` is the
+    hashable identity compile caches key on.
+    """
+
+    def __init__(self, method: str, num_features: int = 2048,
+                 num_landmarks: int = 1024, ridge: float = 1e-4, key=None):
+        if method not in APPROX_METHODS:
+            raise ValueError(
+                f"unknown kernel_approx method {method!r} "
+                f"(expected one of {APPROX_METHODS})"
+            )
+        if num_features < 1:
+            raise ValueError(f"num_features must be >= 1, got {num_features}")
+        if num_landmarks < 1:
+            raise ValueError(f"num_landmarks must be >= 1, got {num_landmarks}")
+        if ridge < 0:
+            raise ValueError(f"ridge must be >= 0, got {ridge}")
+        self.method = method
+        self.num_features = int(num_features)
+        self.num_landmarks = int(num_landmarks)
+        self.ridge = float(ridge)
+        self.key = key
+
+    @property
+    def feature_count(self) -> int:
+        """Per-row feature work F the crossover compares against ``k·m``."""
+        return (2 * self.num_features if self.method == "rff"
+                else self.num_landmarks)
+
+    @property
+    def accuracy_dial(self) -> int:
+        """The method's accuracy parameter (R or L)."""
+        return (self.num_features if self.method == "rff"
+                else self.num_landmarks)
+
+    def with_key(self, key) -> "KernelApprox":
+        """A copy bound to ``key`` (the samplers bind the per-run bank key
+        here; idempotent when the key is unchanged)."""
+        out = KernelApprox(self.method, self.num_features,
+                           self.num_landmarks, self.ridge, key)
+        return out
+
+    def cache_token(self):
+        """Hashable identity for compile caches (the key by value, not by
+        array object — two samplers at the same seed share programs)."""
+        kb = (None if self.key is None
+              else np.asarray(self.key).tobytes())
+        return (self.method, self.num_features, self.num_landmarks,
+                self.ridge, kb)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        dial = (f"num_features={self.num_features}" if self.method == "rff"
+                else f"num_landmarks={self.num_landmarks}")
+        return f"KernelApprox({self.method!r}, {dial})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, KernelApprox)
+                and other.cache_token() == self.cache_token())
+
+    def __hash__(self) -> int:
+        return hash(self.cache_token())
+
+
+def as_kernel_approx(spec: Union[None, str, KernelApprox]
+                     ) -> Optional[KernelApprox]:
+    """Normalise the samplers' ``kernel_approx=`` argument: ``None`` passes
+    through, the strings ``'rff'``/``'nystrom'`` take the default dials, a
+    :class:`KernelApprox` instance is used as-is."""
+    if spec is None or isinstance(spec, KernelApprox):
+        return spec
+    if isinstance(spec, str):
+        return KernelApprox(spec)
+    raise ValueError(
+        f"kernel_approx must be None, 'rff', 'nystrom', or a KernelApprox "
+        f"instance, got {spec!r}"
+    )
+
+
+def approx_preferred(k_eff: int, m: int, feature_count: int) -> bool:
+    """The ``'auto'`` crossover: approximate once the exact pair count beats
+    the feature work (:data:`APPROX_CROSSOVER_FACTOR`).  ``k_eff`` is the
+    effective output-row count ``k × batch_hint`` — under vmap emulation all
+    lanes run as one batched kernel, and scaling k by the lane count makes
+    the decision a function of the GLOBAL shape, so 1-shard and 8-shard
+    runs of the same problem pick the same backend (shard invariance)."""
+    return k_eff * m >= APPROX_CROSSOVER_FACTOR * (k_eff + m) * feature_count
+
+
+def default_error_budget(approx: KernelApprox, d: int) -> float:
+    """The auto-resolved relative-φ-error ceiling the small-n pin (and the
+    ``large_n_approx`` bench gate) holds the approximation to, as a
+    function of the accuracy dial and the feature dimension.
+
+    RFF: each kernel entry carries ~1/√R standard error, and the φ
+    drive/repulse sums cancel more strongly as d grows (pairwise distances
+    concentrate, so the *relative* residual inflates ~√d) — the calibrated
+    envelope is ``3.5·√d/√R``, measured at ≤ 0.8× of itself across seeds
+    0–2, n ∈ {256..2048}, d ∈ {3, 8, 20}, R ∈ {256..8192} on the
+    canonical transient probe (:func:`error_pin_probe`; the calibration
+    table is reproduced by tests/test_approx.py).  Nyström converges much
+    faster on smooth RBF spectra (exact at L = m); ``2·√d/√L`` envelopes
+    the same measurements.
+
+    The budget is defined for the **transient** (non-equilibrium) φ the
+    probe generates.  At convergence φ → 0 and any approximation's
+    *relative* residual grows without bound while the absolute update
+    shrinks with it — gauge readers (``record_phi_residual``) should trend
+    the raw residual, not alarm on it alone."""
+    if approx.method == "rff":
+        return 3.5 * math.sqrt(d) / math.sqrt(approx.num_features)
+    return 2.0 * math.sqrt(d) / math.sqrt(approx.num_landmarks)
+
+
+def error_pin_probe(n: int, d: int, seed: int = 0):
+    """The canonical small-n configuration the error budget is pinned on:
+    a broad, off-center ensemble (``2.5·N(0,1) + 1.5``) against a
+    standard-normal target score — the transient regime where φ is O(1)
+    mass transport, which is what the approximation must get right (an
+    at-equilibrium probe has φ ≈ 0 and no meaningful relative error).
+    Returns ``(particles, scores, kernel)`` with the kernel at the probe's
+    own median-heuristic bandwidth — the regime the samplers run."""
+    from dist_svgd_tpu.ops.kernels import median_bandwidth
+    from dist_svgd_tpu.utils.rng import as_key
+
+    key = as_key(seed)
+    x = 2.5 * jax.random.normal(key, (n, d), dtype=jnp.float32) + 1.5
+    return x, -x, RBF(float(median_bandwidth(x)))
+
+
+# --------------------------------------------------------------------- #
+# random Fourier features
+
+
+def rff_frequencies(key, num_features: int, d: int, bandwidth: float,
+                    dtype=jnp.float32) -> jax.Array:
+    """The shared frequency bank ``W`` (R, d): iid ``N(0, (2/h)·I)`` rows,
+    the spectral measure of ``exp(-‖δ‖²/h)``.  Drawn from ``key`` alone —
+    every shard (and every resumed run) derives the identical bank."""
+    base = jax.random.normal(key, (num_features, d), dtype=dtype)
+    return base * float(np.sqrt(2.0 / float(bandwidth)))
+
+
+def phi_rff(updated: jax.Array, interacting: jax.Array, scores: jax.Array,
+            freqs: jax.Array) -> jax.Array:
+    """Feature-space φ̂* — drop-in for ``ops.svgd.phi`` at O((m+k)·R·d).
+
+    With ``Φ(x) = R^{-1/2}[cos(Wx); sin(Wx)]`` (so ``ΦᵀΦ`` is the unbiased
+    kernel estimate):
+
+    - drive  ``Σ_j k̂(x_j, y)·s_j = Φ(y)ᵀ(Φ(X)ᵀS)`` — the ``(2R, d)``
+      summary is computed once over the interaction set;
+    - repulse ``Σ_j ∇_{x_j}k̂(x_j, y) = (1/R)·[sin(Wy)⊙Σcos − cos(Wy)⊙Σsin]·W``
+      — the analytic feature gradient summed over the set (the ∇K term in
+      closed form; no autodiff, no (m, k, d) tensor).
+
+    Never materialises any (m, k) array; the largest temporaries are the
+    (m, R)/(k, R) feature blocks.
+    """
+    m = interacting.shape[0]
+    num_features = freqs.shape[0]
+    hi = jax.lax.Precision.HIGHEST
+    w = freqs.astype(jnp.promote_types(updated.dtype, jnp.float32))
+    # HIGHEST on the projection: phase errors pass through cos/sin at unit
+    # gain, same argument as the exact path's distance matmul
+    xw = jnp.matmul(interacting, w.T, precision=hi)   # (m, R)
+    yw = jnp.matmul(updated, w.T, precision=hi)       # (k, R)
+    cx, sx = jnp.cos(xw), jnp.sin(xw)
+    cy, sy = jnp.cos(yw), jnp.sin(yw)
+    a_cos = jnp.matmul(cx.T, scores, precision=hi)    # (R, d)
+    a_sin = jnp.matmul(sx.T, scores, precision=hi)
+    drive = (jnp.matmul(cy, a_cos, precision=hi)
+             + jnp.matmul(sy, a_sin, precision=hi))
+    sum_c = jnp.sum(cx, axis=0)                       # (R,)
+    sum_s = jnp.sum(sx, axis=0)
+    repulse = jnp.matmul(sy * sum_c[None, :] - cy * sum_s[None, :], w,
+                         precision=hi)
+    return (drive + repulse) / (num_features * m)
+
+
+# --------------------------------------------------------------------- #
+# Nyström landmarks
+
+
+def nystrom_landmark_indices(m: int, num_landmarks: int) -> np.ndarray:
+    """Evenly-strided landmark indices into an ``m``-row interaction set —
+    the same ceil-stride subsample convention as ``median_bandwidth``
+    (deterministic, layout-free, no carried state).  At ``L ≥ m`` every row
+    is a landmark and the approximation is exact (up to the ridge)."""
+    if num_landmarks >= m:
+        return np.arange(m)
+    stride = -(-m // num_landmarks)  # ceil: at most num_landmarks rows
+    return np.arange(0, m, stride)
+
+
+def phi_nystrom(updated: jax.Array, interacting: jax.Array,
+                scores: jax.Array, bandwidth: float, num_landmarks: int,
+                ridge: float = 1e-4) -> jax.Array:
+    """Landmark-factored φ̂* — drop-in for ``ops.svgd.phi`` at O(n·L·d + L³).
+
+    Landmarks Z are the strided rows of THIS call's interaction set, so
+    they track the particle flow step by step with no carried state (and a
+    resharded resume re-derives them from the checkpointed particles).
+    Both φ terms route through one Cholesky factor of ``K_ZZ + λI``:
+
+    - drive  ``k(y, Z)·(K_ZZ+λI)⁻¹·(K_XZᵀ S)``;
+    - repulse ``k(y, Z)·(K_ZZ+λI)⁻¹·G`` with ``G_l = Σ_j ∇_{x_j}k(x_j, z_l)
+      = -(2/h)(K_XZᵀX − diag(colsum)·Z)_l`` — the analytic RBF gradient
+      summed in closed form (ops/svgd.py's repulse identity, applied at
+      the landmarks).
+    """
+    m = interacting.shape[0]
+    idx = jnp.asarray(nystrom_landmark_indices(m, num_landmarks))
+    z = jnp.take(interacting, idx, axis=0)            # (L, d)
+    inv_h = 1.0 / float(bandwidth)
+    kzz = jnp.exp(-squared_distances(z, z) * inv_h)
+    kzz = kzz + ridge * jnp.eye(z.shape[0], dtype=kzz.dtype)
+    kxz = jnp.exp(-squared_distances(interacting, z) * inv_h)  # (m, L)
+    kyz = jnp.exp(-squared_distances(updated, z) * inv_h)      # (k, L)
+    hi = jax.lax.Precision.HIGHEST
+    cf = jax.scipy.linalg.cho_factor(kzz)
+    drive_c = jax.scipy.linalg.cho_solve(
+        cf, jnp.matmul(kxz.T, scores, precision=hi))           # (L, d)
+    colsum = jnp.sum(kxz, axis=0)                              # (L,)
+    grad_sum = -(2.0 * inv_h) * (
+        jnp.matmul(kxz.T, interacting, precision=hi) - colsum[:, None] * z
+    )
+    rep_c = jax.scipy.linalg.cho_solve(cf, grad_sum)
+    return jnp.matmul(kyz, drive_c + rep_c, precision=hi) / m
+
+
+# --------------------------------------------------------------------- #
+# φ-backend construction (the resolve_phi_fn plug-in)
+
+
+def make_approx_phi_fn(kernel: RBF, approx: KernelApprox):
+    """Build the approximate ``phi_fn(updated, interacting, scores)`` for a
+    fixed-bandwidth RBF kernel.  The RFF bank is derived lazily per feature
+    dimension from the spec's key at trace time (a concrete key ⇒ the bank
+    is an eager constant baked into the compiled program, shared by every
+    shard/lane); Nyström needs no bank."""
+    if not isinstance(kernel, RBF):
+        raise ValueError(
+            "kernel_approx requires an RBF kernel (the feature and landmark "
+            f"closed forms are RBF-specific), got {kernel!r}"
+        )
+    bw = kernel.bandwidth
+    if approx.method == "nystrom":
+        num_l, ridge = approx.num_landmarks, approx.ridge
+
+        def nystrom_fn(y, x, s):
+            return phi_nystrom(y, x, s, bw, num_l, ridge)
+
+        return nystrom_fn
+    if approx.key is None:
+        raise ValueError(
+            "kernel_approx='rff' needs the bank key: bind one with "
+            "KernelApprox.with_key(utils.rng.approx_bank_key(seed)) — the "
+            "samplers derive it from the run seed automatically"
+        )
+    key, num_f = approx.key, approx.num_features
+    banks = {}
+
+    def rff_fn(y, x, s):
+        d = x.shape[1]
+        freqs = banks.get(d)
+        if freqs is None:
+            # the key is concrete, so the draw is forced to compile-time
+            # eval: a concrete constant even when first touched inside a
+            # jit/scan trace — cached, embedded in every program, zero
+            # per-step cost, the ONE bank every shard shares
+            with jax.ensure_compile_time_eval():
+                freqs = rff_frequencies(key, num_f, d, bw)
+            banks[d] = freqs
+        return phi_rff(y, x, s, freqs)
+
+    return rff_fn
+
+
+# --------------------------------------------------------------------- #
+# residual probe + gauges (the svgd_diag_* posterior-health channel)
+
+
+def phi_rel_error(exact, approx) -> float:
+    """Global relative L2 (Frobenius) error of an approximate φ against the
+    exact one — the single number the error budget bounds."""
+    exact = np.asarray(exact, dtype=np.float64)
+    approx = np.asarray(approx, dtype=np.float64)
+    denom = float(np.linalg.norm(exact))
+    return float(np.linalg.norm(approx - exact) / max(denom, 1e-30))
+
+
+def phi_residual_report(particles, scores, kernel: RBF,
+                        approx: KernelApprox, max_points: int = 512) -> dict:
+    """Measure the feature-space φ residual on an evenly-strided subsample
+    of the current ensemble: exact φ vs the configured approximation, both
+    over the same ≤``max_points`` rows.  O(max_points²) — the diagnostics
+    subsample discipline, so the probe stays off the hot path at any n.
+
+    Returns ``{phi_approx_rel_err, phi_approx_budget, phi_approx_within_
+    budget, phi_approx_dial, n_eval}`` — plain floats, gauge-ready."""
+    from dist_svgd_tpu.ops.svgd import phi as phi_exact
+
+    particles = jnp.asarray(particles)
+    scores = jnp.asarray(scores)
+    n = particles.shape[0]
+    if n > max_points:
+        stride = -(-n // max_points)
+        particles = particles[::stride]
+        scores = scores[::stride]
+    approx_fn = make_approx_phi_fn(kernel, approx)
+    exact = phi_exact(particles, particles, scores, kernel)
+    est = approx_fn(particles, particles, scores)
+    err = phi_rel_error(exact, est)
+    budget = default_error_budget(approx, int(particles.shape[1]))
+    return {
+        "phi_approx_rel_err": err,
+        "phi_approx_budget": budget,
+        "phi_approx_within_budget": float(err <= budget),
+        "phi_approx_dial": float(approx.accuracy_dial),
+        "n_eval": int(particles.shape[0]),
+    }
+
+
+def record_phi_residual(report: dict, registry=None) -> None:
+    """Publish a :func:`phi_residual_report` as ``svgd_diag_*`` gauges so
+    drift guards and SLOs watch approximation health the same way they
+    watch KSD/ESS (a ``svgd_diag_phi_approx_within_budget`` gauge at 0 is
+    the alarm condition; the raw residual rides alongside for trending)."""
+    from dist_svgd_tpu.telemetry import metrics as _metrics
+
+    reg = registry if registry is not None else _metrics.default_registry()
+    helps = {
+        "phi_approx_rel_err":
+            "relative L2 error of the approximate phi vs exact (subsample)",
+        "phi_approx_budget": "declared approximation error ceiling",
+        "phi_approx_within_budget": "1 when the residual is inside budget",
+        "phi_approx_dial": "accuracy dial (RFF features / landmarks)",
+    }
+    for name, help_text in helps.items():
+        reg.gauge(f"svgd_diag_{name}", help_text).set(report[name])
+    reg.counter("svgd_diag_phi_residual_total",
+                "approximation residual probes completed").inc()

@@ -507,7 +507,8 @@ PALLAS_MIN_PAIRS_BIG_D = 1 << 16
 XLA_BLOCKWISE_MIN_PAIRS = 1 << 31
 
 
-def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1):
+def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1,
+                   kernel_approx=None):
     """The framework-wide φ-backend policy, shared by ``Sampler``,
     ``DistSampler``, and ``parallel/exchange.py``.
 
@@ -525,6 +526,24 @@ def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1):
     every ``phi_impl`` below: the returned function first re-estimates the
     median bandwidth from the interaction set, then calls the bandwidth-1
     backend through the rescaling identity (see the inline comment).
+
+    ``kernel_approx`` (``None`` | ``'rff'`` | ``'nystrom'`` | a
+    :class:`~dist_svgd_tpu.ops.approx.KernelApprox`) swaps the exact Gram
+    evaluation for the sub-quadratic feature/landmark φ (``ops/approx.py``):
+
+    - with ``phi_impl='auto'`` the (k·batch_hint, m) crossover
+      (``approx.approx_preferred``) picks exact (Pallas on TPU, XLA
+      otherwise — exact is faster AND exact below it) vs approximate per
+      traced shape;
+    - ``phi_impl='xla'`` forces the approximate φ unconditionally (its
+      feature-space matmuls ARE XLA programs);
+    - ``'pallas'``/``'pallas_bf16'`` are refused — the approximation has
+      no Pallas tier; ``'auto'`` is how exact-Pallas composes with it;
+    - ``AdaptiveRBF`` + ``'rff'`` is refused in one line (the bank is
+      drawn at a frozen bandwidth; per-step drift would silently
+      decalibrate it until a re-draw mechanism exists), while
+      ``'nystrom'`` composes through the rescaling identity (landmarks
+      are re-selected and re-factored every call anyway).
 
     Returns ``phi_fn(updated, interacting, scores)``:
 
@@ -553,6 +572,26 @@ def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1):
 
     if phi_impl not in ("auto", "xla", "pallas", "pallas_bf16"):
         raise ValueError(f"unknown phi_impl {phi_impl!r}")
+    if kernel_approx is not None:
+        from dist_svgd_tpu.ops.approx import as_kernel_approx
+
+        kernel_approx = as_kernel_approx(kernel_approx)
+        if phi_impl in ("pallas", "pallas_bf16"):
+            raise ValueError(
+                f"phi_impl={phi_impl!r} is incompatible with kernel_approx: "
+                "the approximate φ has no Pallas tier — use 'auto' (exact "
+                "Pallas below the crossover, features/landmarks above) or "
+                "'xla' (always approximate)"
+            )
+        if isinstance(kernel, AdaptiveRBF) and kernel_approx.method == "rff":
+            raise ValueError(
+                "kernel_approx='rff' with the per-step median bandwidth "
+                "(kernel='median_step' / AdaptiveRBF) is refused: the RFF "
+                "bank is drawn at a frozen bandwidth and per-step drift "
+                "would silently decalibrate it until the bank is re-drawn "
+                "— use kernel='median' (frozen per run) or "
+                "kernel_approx='nystrom' (re-factored every step)"
+            )
     if isinstance(kernel, AdaptiveRBF):
         # Per-step median bandwidth via the exact rescaling identity
         #     φ_h(y; x, s) = φ₁(y/√h; x/√h, √h·s) / √h
@@ -560,7 +599,10 @@ def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1):
         # term's 2/h factor becomes 2·(1/√h)² — algebra in docs/notes.md).
         # Every backend below stays compiled at the static bandwidth 1; the
         # traced h touches only elementwise scalings XLA fuses away.
-        base = resolve_phi_fn(RBF(1.0), phi_impl, batch_hint)
+        # kernel_approx ('nystrom' here — 'rff' was refused above) passes
+        # through: its landmarks come from the rescaled interaction set,
+        # which IS the rescaled landmark set, so the identity holds exactly.
+        base = resolve_phi_fn(RBF(1.0), phi_impl, batch_hint, kernel_approx)
         max_points = kernel.max_points
 
         def adaptive_fn(y, x, s):
@@ -569,6 +611,25 @@ def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1):
             return base(y / sh, x / sh, s * sh) / sh
 
         return adaptive_fn
+    if kernel_approx is not None:
+        from dist_svgd_tpu.ops.approx import (
+            approx_preferred,
+            make_approx_phi_fn,
+        )
+
+        approx_fn = make_approx_phi_fn(kernel, kernel_approx)
+        if phi_impl == "xla":
+            return approx_fn
+        exact_fn = resolve_phi_fn(kernel, "auto", batch_hint)
+        feature_count = kernel_approx.feature_count
+
+        def auto_approx_fn(y, x, s):
+            if approx_preferred(y.shape[0] * batch_hint, x.shape[0],
+                                feature_count):
+                return approx_fn(y, x, s)
+            return exact_fn(y, x, s)
+
+        return auto_approx_fn
     on_tpu = pallas_available()
     if phi_impl == "auto":
         if on_tpu and isinstance(kernel, RBF):

@@ -251,17 +251,20 @@ def _ring_median_bandwidth(block, num_shards: int, max_points: int):
 
 
 def _builder_prelude(logp, kernel, phi_impl, log_prior, batch_size,
-                     n_local_data, phi_batch_hint=1):
+                     n_local_data, phi_batch_hint=1, kernel_approx=None):
     """Shared construction of every step builder's numeric machinery —
     one definition so the per-step, Gauss-Seidel, lagged, and W2 builders
     cannot drift on score/prior/φ semantics.  ``phi_batch_hint`` feeds the
     φ 'auto' thresholds (how many lanes run as one batched kernel —
-    ops/pallas_svgd.py:resolve_phi_fn)."""
+    ops/pallas_svgd.py:resolve_phi_fn); ``kernel_approx`` selects the
+    sub-quadratic feature/landmark φ (``ops/approx.py``) — a drop-in
+    ``phi_fn`` with the same signature, so every exchange/chunk path
+    downstream is approximation-agnostic."""
     if batch_size is not None and not 0 < batch_size <= n_local_data:
         raise ValueError(
             f"batch_size {batch_size} not in (0, {n_local_data}] local rows"
         )
-    phi_fn = resolve_phi_fn(kernel, phi_impl, phi_batch_hint)
+    phi_fn = resolve_phi_fn(kernel, phi_impl, phi_batch_hint, kernel_approx)
     batched_score = jax.vmap(jax.grad(logp, argnums=0), in_axes=(0, None))
     if log_prior is not None:
         batched_prior = jax.vmap(jax.grad(log_prior))
@@ -284,6 +287,7 @@ def make_shard_step(
     phi_impl: str = "xla",
     update_rule: str = "jacobi",
     phi_batch_hint: int = 1,
+    kernel_approx=None,
 ) -> Callable:
     """Build the per-shard SVGD step for one exchange strategy.
 
@@ -344,6 +348,12 @@ def make_shard_step(
         1-based step counter driving the ``partitions`` rotation.
     """
     if update_rule == "gauss_seidel":
+        if kernel_approx is not None:
+            raise ValueError(
+                "kernel_approx requires update_rule='jacobi': the "
+                "Gauss-Seidel sweep exists for literal reference parity, "
+                "which an approximate kernel cannot provide"
+            )
         # the GS sweep's phi calls are single-row (1, m) probes inside a
         # lax.scan, not equal batched lane blocks -- the batching-amortised
         # thresholds the hint encodes do not apply (and would route the
@@ -358,6 +368,7 @@ def make_shard_step(
     core = _build_core(
         logp, kernel, mode, num_shards, n_local_data, score_scale,
         ring, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint,
+        kernel_approx,
     )
 
     def step(block, data, w_grad_block, t, key, step_size, h):
@@ -441,6 +452,7 @@ def _build_gs_step(
 def _build_core(
     logp, kernel, mode, num_shards, n_local_data, score_scale,
     ring, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint=1,
+    kernel_approx=None,
 ):
     """Shared exchange+φ computation: ``core(block, data, t, key) ->
     (delta, interacting)`` where ``interacting`` is the pre-update all-gather
@@ -460,7 +472,7 @@ def _build_core(
     ring_adaptive = ring and isinstance(kernel, AdaptiveRBF) and mode != PARTITIONS
     phi_fn, batched_score, batched_prior = _builder_prelude(
         logp, RBF(1.0) if ring_adaptive else kernel, phi_impl, log_prior,
-        batch_size, n_local_data, phi_batch_hint,
+        batch_size, n_local_data, phi_batch_hint, kernel_approx,
     )
 
     resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
@@ -530,6 +542,7 @@ def make_chunked_ring_step_fns(
     log_prior: Optional[Callable] = None,
     phi_impl: str = "xla",
     phi_batch_hint: int = 1,
+    kernel_approx=None,
 ) -> dict:
     """Per-shard pieces of the ring-φ SVGD step for **host-driven bounded-
     dispatch execution** — the chunked step executor behind
@@ -590,7 +603,7 @@ def make_chunked_ring_step_fns(
         )
     phi_fn, batched_score, batched_prior = _builder_prelude(
         logp, kernel, phi_impl, log_prior, batch_size, n_local_data,
-        phi_batch_hint,
+        phi_batch_hint, kernel_approx,
     )
     resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
 
@@ -667,6 +680,7 @@ def make_shard_step_lagged(
     phi_impl: str = "xla",
     phi_batch_hint: int = 1,
     record: bool = False,
+    kernel_approx=None,
 ) -> Callable:
     """Lagged (stale) ``all_particles`` exchange: one ``lax.all_gather``
     per ``exchange_every`` SVGD steps instead of per step.
@@ -711,7 +725,7 @@ def make_shard_step_lagged(
         raise ValueError(f"exchange_every must be >= 1, got {exchange_every}")
     phi_fn, batched_score, batched_prior = _builder_prelude(
         logp, kernel, phi_impl, log_prior, batch_size, n_local_data,
-        phi_batch_hint,
+        phi_batch_hint, kernel_approx,
     )
     resolve_data = _shard_data_resolver(
         ALL_PARTICLES, num_shards, n_local_data, shard_data
@@ -768,6 +782,7 @@ def make_shard_step_sinkhorn_w2(
     update_rule: str = "jacobi",
     w2_pairing: str = "global",
     ring: bool = False,
+    kernel_approx=None,
 ) -> Callable:
     """Per-shard SVGD step with the Wasserstein/JKO term computed **inside
     the step** from carried previous-snapshot state, so whole W2 trajectories
@@ -839,6 +854,11 @@ def make_shard_step_sinkhorn_w2(
     from dist_svgd_tpu.ops.ot import wasserstein_grad_sinkhorn
 
     if update_rule == "gauss_seidel":
+        if kernel_approx is not None:
+            raise ValueError(
+                "kernel_approx requires update_rule='jacobi' (the GS sweep "
+                "exists for literal reference parity)"
+            )
         gs_step = _build_gs_step(
             logp, kernel, mode, num_shards, n_local_data, score_scale,
             False, shard_data, batch_size, log_prior, phi_impl,
@@ -849,6 +869,7 @@ def make_shard_step_sinkhorn_w2(
         core = _build_core(
             logp, kernel, mode, num_shards, n_local_data, score_scale,
             ring, shard_data, batch_size, log_prior, phi_impl, phi_batch_hint,
+            kernel_approx,
         )
     else:
         raise ValueError(f"unknown update_rule {update_rule!r}")

@@ -29,6 +29,14 @@ that nag carries no signal, so :meth:`Plan.compile` suppresses it —
 scoped to the first (lowering) call of each donating program, never as a
 process-global filter, so a future training-loop donation that wants the
 warning as a tuning signal can keep it (``quiet_donation=False``).
+
+Since round 17 the **training carries donate through here too** (ROADMAP
+item 1's last slice): both samplers pass ``donate_argnums`` at their
+:meth:`Plan.compile_sharded` scan/chunk sites — particles on every
+scanned run, the W2 snapshot + Sinkhorn dual stacks in the W2 scan, and
+the intra-step executors' accumulator carries — gated by their
+``donate_carries`` flag and pinned bitwise against the undonated path
+(``tools/profile_step_floor.py --donate-ab``).
 """
 
 from __future__ import annotations

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dist_svgd_tpu.ops.approx import approx_preferred, as_kernel_approx
 from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.svgd import svgd_step_sequential
 from dist_svgd_tpu.parallel.plan import Plan
@@ -73,6 +74,24 @@ class Sampler:
             at Gram-bound sizes, XLA otherwise — see
             ``ops.pallas_svgd.resolve_phi_fn``), ``'xla'``, or ``'pallas'``
             (force; requires an RBF kernel).
+        kernel_approx: ``None`` (exact Gram φ), ``'rff'``, ``'nystrom'``,
+            or a :class:`~dist_svgd_tpu.ops.approx.KernelApprox` — the
+            sub-quadratic φ (``ops/approx.py``) with its explicit
+            ``num_features``/``num_landmarks`` accuracy dial.  With
+            ``phi_impl='auto'`` the (n, R) crossover picks exact vs
+            approximate per :meth:`run` call from that run's n (exact is
+            faster AND exact below it); ``'xla'`` forces the
+            approximation.  The RFF bank derives from each run's ``seed``
+            (``utils/rng.py:approx_bank_key``) at the bandwidth frozen by
+            then — ``kernel='median'`` resolves *before* the bank is
+            built, and ``'median_step'`` + ``'rff'`` is refused in one
+            line (``'nystrom'`` composes).  Jacobi only.
+        donate_carries: donate the scan carry (the particle array) to XLA
+            at every run/chunk dispatch — no per-dispatch re-allocation;
+            bitwise-identical results (``tools/profile_step_floor.py
+            --donate-ab``).  A caller-supplied ``initial_particles`` array
+            is defensively copied first, so caller buffers are never
+            invalidated.
     """
 
     def __init__(
@@ -85,6 +104,8 @@ class Sampler:
         batch_size: Optional[int] = None,
         log_prior: Optional[Callable] = None,
         phi_impl: str = "auto",
+        kernel_approx=None,
+        donate_carries: bool = True,
     ):
         if update_rule not in ("jacobi", "gauss_seidel"):
             raise ValueError(f"unknown update_rule {update_rule!r}")
@@ -129,7 +150,25 @@ class Sampler:
             # forced pallas choice would silently no-op
             raise ValueError(f"phi_impl={phi_impl!r} requires update_rule='jacobi'")
         self._phi_impl = phi_impl
-        self._phi = resolve_phi_fn(self._kernel, phi_impl)
+        self._donate = bool(donate_carries)
+        self._approx = as_kernel_approx(kernel_approx)
+        self._approx_active = False
+        if self._approx is not None:
+            if update_rule != "jacobi":
+                raise ValueError(
+                    "kernel_approx requires update_rule='jacobi': the "
+                    "Gauss-Seidel sweep exists for literal reference "
+                    "parity, which an approximate kernel cannot provide"
+                )
+            # validate through the ONE policy seam (pallas/AdaptiveRBF+rff
+            # refusals); the real bank key arrives with run()'s seed
+            from dist_svgd_tpu.utils.rng import approx_bank_key
+
+            va = self._approx
+            if va.method == "rff" and va.key is None:
+                va = va.with_key(approx_bank_key(0))
+            resolve_phi_fn(self._kernel, phi_impl, 1, va)
+        self._phi = self._resolve_phi()
         if data is None:
             if log_prior is not None:
                 full = lambda theta: logp(theta) + log_prior(theta)
@@ -153,6 +192,109 @@ class Sampler:
 
     # ------------------------------------------------------------------ #
 
+    @property
+    def kernel_approx(self):
+        """The resolved :class:`~dist_svgd_tpu.ops.approx.KernelApprox`
+        (RFF bank key bound once a run has derived it), or ``None``."""
+        return self._approx
+
+    @property
+    def kernel_approx_active(self) -> bool:
+        """Whether the most recent :meth:`run`'s φ used the approximate
+        backend (the per-run (n, R) crossover under ``phi_impl='auto'``;
+        always true with ``'xla'`` + ``kernel_approx``)."""
+        return self._approx is not None and self._approx_active
+
+    def _phi_token(self):
+        """The part of the compile-cache key that tracks the φ closure's
+        identity beyond the kernel bandwidth (approximation spec + pinned
+        crossover decision)."""
+        if self._approx is None:
+            return None
+        return (self._approx.cache_token(), self._approx_active)
+
+    def _resolve_phi(self):
+        """Rebuild the φ backend from the current kernel + approximation
+        state.  With the approximation pinned active the builder sees the
+        always-approximate combination; inactive (or unconfigured), the
+        exact configuration — one decision per run, like DistSampler's
+        global-shape pin."""
+        from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
+
+        if self._approx is not None and self._approx_active:
+            return resolve_phi_fn(self._kernel, "xla", 1, self._approx)
+        return resolve_phi_fn(self._kernel, self._phi_impl)
+
+    def _pin_approx(self, n: int, seed) -> None:
+        """Per-run approximation resolution: bind the run's RFF bank key
+        (``approx_bank_key(seed)``) and pin the (n, R) crossover, then
+        rebuild φ if either changed.  No-op for exact samplers."""
+        if self._approx is None:
+            return
+        from dist_svgd_tpu.utils.rng import approx_bank_key
+
+        changed = False
+        if self._approx.method == "rff":
+            bkey = approx_bank_key(seed)
+            if (self._approx.key is None
+                    or not np.array_equal(np.asarray(self._approx.key),
+                                          np.asarray(bkey))):
+                self._approx = self._approx.with_key(bkey)
+                changed = True
+        active = (approx_preferred(n, n, self._approx.feature_count)
+                  if self._phi_impl == "auto" else True)
+        if active != self._approx_active:
+            self._approx_active = active
+            changed = True
+        if changed or self._phi is None:
+            self._phi = self._resolve_phi()
+
+    def approx_residual(self, particles=None, max_points: int = 512,
+                        seed=0, registry=None) -> dict:
+        """Measure the configured approximation's φ residual (exact vs
+        approximate φ over a strided ≤``max_points`` subsample, scores from
+        this sampler's own ``∇log p``) and publish it as
+        ``svgd_diag_phi_approx_*`` gauges — the posterior-health channel
+        for approximate runs.  ``particles`` defaults to a fresh
+        ``init_particles`` draw at ``max_points`` (pre-run probing);
+        pass the current ensemble to probe a live run."""
+        from dist_svgd_tpu.ops.approx import (
+            phi_residual_report,
+            record_phi_residual,
+        )
+
+        if self._approx is None:
+            raise ValueError(
+                "approx_residual needs kernel_approx (exact runs have no "
+                "approximation residual to measure)"
+            )
+        if particles is None:
+            particles = init_particles(as_key(seed), max_points, self._d)
+        particles = jnp.asarray(particles)
+        if particles.shape[0] > max_points:
+            stride = -(-particles.shape[0] // max_points)
+            particles = particles[::stride]
+        # probe-local spec: binding a bank key for a never-run sampler must
+        # NOT rebind the live run's bank or re-pin its crossover (the probe
+        # subsample's tiny shape would flip 'active' and rebuild phi)
+        spec = self._approx
+        if spec.method == "rff" and spec.key is None:
+            from dist_svgd_tpu.utils.rng import approx_bank_key
+
+            spec = spec.with_key(approx_bank_key(seed))
+        scores = jax.vmap(self._score_fn)(particles)
+        if isinstance(self._kernel, RBF):
+            kernel = self._kernel
+        else:  # AdaptiveRBF: probe at the current per-step median bandwidth
+            from dist_svgd_tpu.ops.kernels import median_bandwidth_approx
+
+            kernel = RBF(float(median_bandwidth_approx(particles)))
+        report = phi_residual_report(particles, scores, kernel, spec,
+                                     max_points=max_points)
+        report["active"] = bool(self._approx_active)
+        record_phi_residual(report, registry=registry)
+        return report
+
     def _minibatch_scores(self, parts, key):
         """Stochastic scores: N/B-scaled batch-likelihood gradient (+ unscaled
         prior gradient when ``log_prior`` is separate)."""
@@ -167,12 +309,14 @@ class Sampler:
         of this run's initial particles (idempotent per bandwidth — the
         compile cache below is keyed by it)."""
         from dist_svgd_tpu.ops.kernels import median_bandwidth
-        from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
 
         h = float(median_bandwidth(particles))
         if self._kernel != RBF(h):
+            # bandwidth freeze ordering: the kernel is rebound BEFORE φ is
+            # re-resolved, so an RFF bank is always constructed at the
+            # frozen median bandwidth, never the placeholder
             self._kernel = RBF(h)
-            self._phi = resolve_phi_fn(self._kernel, self._phi_impl)
+            self._phi = self._resolve_phi()
 
     def freeze_median_kernel(self, particles) -> float:
         """Resolve ``kernel='median'`` from ``particles`` NOW and pin the
@@ -203,17 +347,16 @@ class Sampler:
         :meth:`freeze_median_kernel` (a resumed supervised run re-pins the
         bandwidth recorded in its checkpoint instead of re-resolving from
         the resumed particles)."""
-        from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
-
         self._median_kernel = False
         if self._kernel != RBF(float(bandwidth)):
             self._kernel = RBF(float(bandwidth))
-            self._phi = resolve_phi_fn(self._kernel, self._phi_impl)
+            self._phi = self._resolve_phi()
 
     def _run_fn(self, num_iter: int, record: bool):
         """Build (and cache) the jitted scan over `num_iter` steps."""
         cache_key = (num_iter, record, self._kernel.bandwidth
-                     if isinstance(self._kernel, RBF) else None)
+                     if isinstance(self._kernel, RBF) else None,
+                     self._phi_token())
         if cache_key in self._compiled:
             return self._compiled[cache_key]
 
@@ -247,7 +390,11 @@ class Sampler:
             final, hist = lax.scan(body, particles, jnp.arange(num_iter))
             return final, hist
 
-        run = self._plan.compile(scan_run)
+        # carry donation (ROADMAP item 1): the particle buffer aliases the
+        # output at every dispatch — run() owns/copies the input, so no
+        # caller buffer is ever invalidated
+        run = self._plan.compile(
+            scan_run, donate_argnums=(0,) if self._donate else ())
         self._compiled[cache_key] = run
         return run
 
@@ -316,11 +463,18 @@ class Sampler:
         reappears as the next chunk's first pre-update snapshot).
         """
         if initial_particles is not None:
-            particles = jnp.asarray(initial_particles, dtype=dtype)
+            if self._donate:
+                # the scan donates its particle input; copy so the CALLER's
+                # buffer survives (one (n, d) copy per run, not per dispatch)
+                particles = jnp.array(initial_particles, dtype=dtype)
+            else:
+                particles = jnp.asarray(initial_particles, dtype=dtype)
         else:
             particles = init_particles(as_key(seed), n, self._d, dtype=dtype or jnp.float32)
         if self._median_kernel:
             self._resolve_median_kernel(particles)
+        # bandwidth is frozen by here; the RFF bank (if any) builds at it
+        self._pin_approx(n, seed)
         eps = jnp.asarray(step_size, dtype=particles.dtype)
         bkey = minibatch_key(seed)
         steps_per_dispatch = num_iter

@@ -238,6 +238,12 @@ def reshard_state(state: Dict[str, Any], n_shards_to: int) -> Dict[str, Any]:
       verbatim — the per-step streams fold ``(root, t)`` and are therefore
       shard-layout-free, so every later key re-derives deterministically
       from the saved root on any mesh;
+    - **kernel-approximation identity** (``approx_method`` /
+      ``approx_dial`` / ``approx_bank_key`` / ``approx_landmark_idx``,
+      stamped by approximate-φ runs — ``ops/approx.py``): passed through
+      verbatim.  The RFF bank derives from the key alone and Nyström
+      landmarks re-derive from the (layout-free) global particle order, so
+      a resharded resume reconstructs the identical approximation;
     - **manifest**: restamped for the new topology, with
       ``topo_resharded_from`` recording the source shard count.
 

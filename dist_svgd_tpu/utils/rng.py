@@ -38,6 +38,16 @@ def minibatch_key(seed_or_key) -> jax.Array:
     return jax.random.fold_in(as_key(seed_or_key), 7919)
 
 
+def approx_bank_key(seed_or_key) -> jax.Array:
+    """Root key of the random-feature bank stream (``ops/approx.py``'s RFF
+    frequency draw), derived from the run seed by its own fixed fold so it
+    collides with neither the particle-init nor the minibatch stream.  The
+    bank is drawn ONCE per run from this key and shared by every shard —
+    and the key (not the bank) rides ``state_dict``, so a resumed or
+    resharded run re-derives the identical bank deterministically."""
+    return jax.random.fold_in(as_key(seed_or_key), 104729)
+
+
 def draw_minibatch(key, data, n_rows: int, batch_size: int):
     """One without-replacement minibatch and its importance scale.
 

@@ -1,0 +1,510 @@
+"""Sub-quadratic φ (ISSUE 13): random-feature / Nyström kernel
+approximations as first-class sampler options, plus training-carry
+donation.
+
+Pins: exact-vs-approx φ agreement inside the declared error budget (dial
+sweep), the budget calibration itself, shard invariance (1 vs 8 emulated
+shards bitwise-on-seed in the gather mode), ring ≈ gather, chunked ≡
+monolithic, checkpoint/reshard compatibility (bank key + landmark indices
+ride ``state_dict``), composition refusals in one line each, the
+``svgd_diag_phi_approx_*`` residual gauges, zero steady-state recompiles,
+and donated ≡ undonated bitwise.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dist_svgd_tpu as dt
+from dist_svgd_tpu.models.gmm import gmm_logp
+from dist_svgd_tpu.ops.approx import (
+    KernelApprox,
+    approx_preferred,
+    as_kernel_approx,
+    default_error_budget,
+    error_pin_probe,
+    make_approx_phi_fn,
+    nystrom_landmark_indices,
+    phi_rel_error,
+    phi_residual_report,
+)
+from dist_svgd_tpu.ops.kernels import RBF
+from dist_svgd_tpu.ops.pallas_svgd import resolve_phi_fn
+from dist_svgd_tpu.ops.svgd import phi as phi_exact
+from dist_svgd_tpu.utils import checkpoint as ck
+from dist_svgd_tpu.utils.rng import approx_bank_key, init_particles
+
+D = 2
+N = 128
+
+
+def dist_logp(theta, _data):
+    return gmm_logp(theta)
+
+
+def make_dist(num_shards, n=N, seed=0, p0=None, **kw):
+    kw.setdefault("exchange_particles", True)
+    kw.setdefault("exchange_scores", False)
+    kw.setdefault("include_wasserstein", False)
+    if p0 is None:
+        p0 = init_particles(seed, n, D)
+    return dt.DistSampler(num_shards, dist_logp, kw.pop("kernel", None), p0,
+                          seed=seed, **kw)
+
+
+# --------------------------------------------------------------------- #
+# φ agreement at small n: the explicit error budget, dial sweep
+
+
+@pytest.mark.parametrize("n,d", [(256, 3), (512, 8)])
+def test_rff_error_inside_budget_and_improves_with_dial(n, d):
+    x, s, kernel = error_pin_probe(n, d, seed=0)
+    exact = phi_exact(x, x, s, kernel)
+    errs = {}
+    for num_features in (256, 4096):
+        spec = KernelApprox("rff", num_features=num_features).with_key(
+            approx_bank_key(0))
+        err = phi_rel_error(exact, make_approx_phi_fn(kernel, spec)(x, x, s))
+        assert err <= default_error_budget(spec, d), (num_features, err)
+        errs[num_features] = err
+    # the accuracy dial works: 16x the features cuts the error
+    assert errs[4096] < errs[256]
+
+
+@pytest.mark.parametrize("n,d", [(256, 3), (512, 8)])
+def test_nystrom_error_inside_budget_and_exact_at_full_rank(n, d):
+    x, s, kernel = error_pin_probe(n, d, seed=1)
+    exact = phi_exact(x, x, s, kernel)
+    errs = {}
+    for num_landmarks in (64, n):
+        spec = KernelApprox("nystrom", num_landmarks=num_landmarks)
+        err = phi_rel_error(exact, make_approx_phi_fn(kernel, spec)(x, x, s))
+        assert err <= default_error_budget(spec, d), (num_landmarks, err)
+        errs[num_landmarks] = err
+    # every row a landmark => exact recovery (up to the ridge)
+    assert errs[n] < 1e-4
+    assert errs[n] < errs[64]
+
+
+def test_rff_bank_is_shared_and_deterministic():
+    """Same key -> bitwise-identical φ; different key -> a different bank."""
+    x, s, kernel = error_pin_probe(128, 3, seed=0)
+    a = make_approx_phi_fn(kernel, KernelApprox("rff", 256).with_key(
+        approx_bank_key(7)))(x, x, s)
+    b = make_approx_phi_fn(kernel, KernelApprox("rff", 256).with_key(
+        approx_bank_key(7)))(x, x, s)
+    c = make_approx_phi_fn(kernel, KernelApprox("rff", 256).with_key(
+        approx_bank_key(8)))(x, x, s)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_landmark_indices_strided_and_capped():
+    idx = nystrom_landmark_indices(100, 32)
+    assert len(idx) <= 32 and idx[0] == 0
+    assert np.all(np.diff(idx) == idx[1] - idx[0])  # even stride
+    np.testing.assert_array_equal(nystrom_landmark_indices(16, 32),
+                                  np.arange(16))
+
+
+# --------------------------------------------------------------------- #
+# the resolve_phi_fn seam: crossover policy + refusals
+
+
+def test_auto_crossover_picks_exact_below_and_approx_above():
+    x, s, kernel = error_pin_probe(256, 3, seed=0)
+    spec = KernelApprox("rff", num_features=4096).with_key(approx_bank_key(0))
+    # 256 x 256 pairs << (256+256) x 8192 feature work -> exact
+    assert not approx_preferred(256, 256, spec.feature_count)
+    fn = resolve_phi_fn(kernel, "auto", 1, spec)
+    np.testing.assert_array_equal(np.asarray(fn(x, x, s)),
+                                  np.asarray(phi_exact(x, x, s, kernel)))
+    # tiny dial at the same shape -> approximate wins
+    small = KernelApprox("rff", num_features=16).with_key(approx_bank_key(0))
+    assert approx_preferred(256, 256, small.feature_count)
+    fn2 = resolve_phi_fn(kernel, "auto", 1, small)
+    want = make_approx_phi_fn(kernel, small)(x, x, s)
+    np.testing.assert_array_equal(np.asarray(fn2(x, x, s)), np.asarray(want))
+
+
+def test_crossover_is_shard_invariant_through_batch_hint():
+    # k_eff = k x batch_hint makes the decision a function of the global
+    # shape: (n/S rows, hint S) == (n rows, hint 1)
+    f = KernelApprox("rff", num_features=512).feature_count
+    n = 4096
+    for s_count in (1, 2, 8):
+        assert (approx_preferred(n // s_count * s_count, n, f)
+                == approx_preferred(n, n, f))
+
+
+def test_refusals_are_one_line_each():
+    with pytest.raises(ValueError, match="re-drawn|decalibrate"):
+        resolve_phi_fn(dt.AdaptiveRBF(), "auto", 1, "rff")
+    with pytest.raises(ValueError, match="no Pallas tier"):
+        resolve_phi_fn(RBF(1.0), "pallas", 1, "nystrom")
+    with pytest.raises(ValueError, match="bank key"):
+        resolve_phi_fn(RBF(1.0), "xla", 1, "rff")  # no key bound
+    with pytest.raises(ValueError, match="unknown kernel_approx"):
+        as_kernel_approx("fourier")
+    with pytest.raises(ValueError, match="RBF"):
+        make_approx_phi_fn(lambda a, b: 1.0, KernelApprox("nystrom"))
+    with pytest.raises(ValueError, match="jacobi"):
+        dt.Sampler(D, gmm_logp, update_rule="gauss_seidel",
+                   kernel_approx="nystrom")
+    with pytest.raises(ValueError, match="jacobi"):
+        make_dist(2, update_rule="gauss_seidel", kernel_approx="nystrom",
+                  exchange_scores=False)
+    with pytest.raises(ValueError, match="re-drawn|decalibrate"):
+        make_dist(2, kernel="median_step", kernel_approx="rff")
+
+
+def test_adaptive_bandwidth_composes_with_nystrom():
+    ds = make_dist(2, kernel="median_step",
+                   kernel_approx=KernelApprox("nystrom", num_landmarks=16),
+                   phi_impl="xla")
+    out = np.asarray(ds.run_steps(2, 0.05))
+    assert np.all(np.isfinite(out))
+
+
+# --------------------------------------------------------------------- #
+# samplers: bandwidth freeze ordering, shard invariance, ring/chunked
+
+
+def test_sampler_median_freezes_bandwidth_before_bank():
+    """kernel='median' + rff: the bank must be built at the resolved median
+    bandwidth — pinned by reproducing the run manually with the same bank
+    at the median bandwidth (a bandwidth-1 bank diverges)."""
+    s = dt.Sampler(D, gmm_logp, kernel="median", kernel_approx="rff",
+                   phi_impl="xla")
+    final, _ = s.run(N, 2, 0.05, seed=3, record=False)
+    h = s._kernel.bandwidth
+    assert h != 1.0  # the median actually resolved
+
+    parts = init_particles(3, N, D)
+    kernel = RBF(h)
+    spec = KernelApprox("rff").with_key(approx_bank_key(3))
+    fn = make_approx_phi_fn(kernel, spec)
+    score = jax.vmap(jax.grad(gmm_logp))
+    for _ in range(2):
+        parts = parts + 0.05 * fn(parts, parts, score(parts))
+    np.testing.assert_allclose(np.asarray(final), np.asarray(parts),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_sampler_auto_small_n_equals_exact():
+    a, _ = dt.Sampler(D, gmm_logp).run(N, 3, 0.05, seed=0, record=False)
+    s = dt.Sampler(D, gmm_logp, kernel_approx="rff")
+    b, _ = s.run(N, 3, 0.05, seed=0, record=False)
+    assert not s.kernel_approx_active  # 128² pairs << feature work
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shard_invariance_bitwise_on_seed():
+    """1 vs 8 emulated (vmap) shards, gather mode, same seed: the shared
+    bank and the globally-pinned crossover make the trajectories BITWISE
+    equal.  On the real shard_map mesh (8 host devices) per-device matmul
+    partitioning re-associates float sums, so that backend pins to
+    accumulation-order tolerance instead."""
+    p0 = init_particles(0, N, D)
+    outs = []
+    for s_count in (1, 8):
+        ds = make_dist(s_count, p0=p0, mesh=None, kernel_approx="rff",
+                       phi_impl="xla")
+        ds.run_steps(3, 0.05)
+        outs.append(np.asarray(ds.particles))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+    ds = make_dist(8, p0=p0, kernel_approx="rff", phi_impl="xla")
+    ds.run_steps(3, 0.05)
+    np.testing.assert_allclose(outs[0], np.asarray(ds.particles),
+                               rtol=0, atol=1e-5)
+
+
+def test_ring_matches_gather_for_both_methods():
+    p0 = init_particles(1, N, D)
+    for method in ("rff", "nystrom"):
+        spec = (KernelApprox("rff", num_features=256) if method == "rff"
+                else KernelApprox("nystrom", num_landmarks=16))
+        runs = []
+        for impl in ("gather", "ring"):
+            ds = make_dist(4, p0=p0, exchange_impl=impl, kernel_approx=spec,
+                           phi_impl="xla")
+            ds.run_steps(3, 0.05)
+            runs.append(np.asarray(ds.particles))
+        # ring accumulates per-block φ contributions (RFF: linear in the
+        # interaction set — float-order only; Nyström: per-block landmark
+        # sets, a blockwise approximation of the same dial)
+        rtol = 1e-5 if method == "rff" else 0.3
+        np.testing.assert_allclose(runs[0], runs[1], rtol=0, atol=rtol)
+
+
+def test_chunked_equals_monolithic_with_approx():
+    p0 = init_particles(2, N, D)
+    ds = make_dist(4, p0=p0, exchange_impl="ring", kernel_approx="rff",
+                   phi_impl="xla")
+    mono = np.asarray(ds.run_steps(4, 0.05))
+    ds2 = make_dist(4, p0=p0, exchange_impl="ring", kernel_approx="rff",
+                    phi_impl="xla")
+    chunked = np.asarray(ds2.run_steps(4, 0.05, hops_per_dispatch=1))
+    assert ds2.last_run_stats["execution"] == "intra_step"
+    np.testing.assert_allclose(mono, chunked, rtol=0, atol=1e-6)
+
+
+def test_w2_sinkhorn_composes_with_approx():
+    p0 = init_particles(4, N, D)
+    ds = make_dist(4, p0=p0, include_wasserstein=True,
+                   wasserstein_solver="sinkhorn",
+                   kernel_approx=KernelApprox("nystrom", num_landmarks=16),
+                   phi_impl="xla")
+    out = np.asarray(ds.run_steps(3, 0.05, h=1.0))
+    assert np.all(np.isfinite(out))
+    assert ds.kernel_approx_active
+
+
+# --------------------------------------------------------------------- #
+# checkpoint / reshard compatibility
+
+
+def test_state_dict_carries_bank_key_and_resume_is_bitwise():
+    p0 = init_particles(0, N, D)
+    a = make_dist(4, p0=p0, seed=7, kernel_approx="rff", phi_impl="xla")
+    a.run_steps(3, 0.05)
+    st = a.state_dict()
+    assert st["approx_method"] is not None
+    np.testing.assert_array_equal(np.asarray(st["approx_bank_key"]),
+                                  np.asarray(approx_bank_key(7)))
+    a.run_steps(3, 0.05)
+    want = np.asarray(a.particles)
+
+    b = make_dist(4, p0=p0, seed=7, kernel_approx="rff", phi_impl="xla")
+    b.load_state_dict(st)
+    b.run_steps(3, 0.05)
+    np.testing.assert_array_equal(want, np.asarray(b.particles))
+
+    # a foreign construction seed ADOPTS the saved bank: still bitwise
+    c = make_dist(4, p0=p0, seed=99, kernel_approx="rff", phi_impl="xla")
+    c.load_state_dict(st)
+    c.run_steps(3, 0.05)
+    np.testing.assert_array_equal(want, np.asarray(c.particles))
+
+
+def test_nystrom_state_dict_carries_landmark_indices():
+    ds = make_dist(4, kernel_approx=KernelApprox("nystrom", num_landmarks=32),
+                   phi_impl="xla")
+    st = ds.state_dict()
+    np.testing.assert_array_equal(np.asarray(st["approx_landmark_idx"]),
+                                  nystrom_landmark_indices(N, 32))
+
+
+def test_approx_config_mismatches_refused():
+    st = make_dist(4, seed=7, kernel_approx="rff", phi_impl="xla").state_dict()
+    with pytest.raises(ValueError, match="nystrom.*rff|rff.*nystrom"):
+        make_dist(4, kernel_approx="nystrom", phi_impl="xla").load_state_dict(st)
+    with pytest.raises(ValueError, match="dial"):
+        make_dist(4, kernel_approx=KernelApprox("rff", num_features=64),
+                  phi_impl="xla").load_state_dict(st)
+    with pytest.raises(ValueError, match="exact"):
+        make_dist(4).load_state_dict(st)
+    with pytest.raises(ValueError, match="exact"):
+        make_dist(4, kernel_approx="rff",
+                  phi_impl="xla").load_state_dict(make_dist(4).state_dict())
+
+
+def test_reshard_state_passes_approx_entries_through():
+    st = make_dist(4, seed=7, kernel_approx="rff", phi_impl="xla").state_dict()
+    out = ck.reshard_state(dict(st), 2)
+    np.testing.assert_array_equal(np.asarray(out["approx_bank_key"]),
+                                  np.asarray(st["approx_bank_key"]))
+    assert int(np.asarray(out["approx_method"])) == int(
+        np.asarray(st["approx_method"]))
+
+
+# --------------------------------------------------------------------- #
+# residual gauges (the svgd_diag_* posterior-health channel)
+
+
+def test_residual_report_and_gauges():
+    from dist_svgd_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    ds = make_dist(4, kernel_approx="rff", phi_impl="xla")
+    ds.run_steps(2, 0.05)
+    report = ds.approx_residual(max_points=64, registry=reg)
+    assert report["phi_approx_within_budget"] == 1.0
+    assert report["n_eval"] <= 64
+    text = reg.exposition()
+    assert "svgd_diag_phi_approx_rel_err" in text
+    assert "svgd_diag_phi_residual_total 1" in text
+
+    with pytest.raises(ValueError, match="kernel_approx"):
+        make_dist(4).approx_residual()
+
+
+def test_sampler_residual_probe():
+    from dist_svgd_tpu.telemetry import MetricsRegistry
+
+    s = dt.Sampler(D, gmm_logp, kernel_approx="nystrom", phi_impl="xla")
+    report = s.approx_residual(max_points=64, registry=MetricsRegistry())
+    assert report["phi_approx_within_budget"] == 1.0
+
+
+def test_sampler_residual_probe_does_not_mutate_live_state():
+    """Review-caught: the probe must not rebind the live run's bank or
+    re-pin its crossover from the probe subsample's tiny shape."""
+    s = dt.Sampler(D, gmm_logp, kernel_approx=KernelApprox("rff", 16),
+                   phi_impl="xla")
+    s.run(N, 2, 0.05, seed=7, record=False)
+    key_before = np.asarray(s.kernel_approx.key)
+    assert s.kernel_approx_active
+    report = s.approx_residual(max_points=32, seed=0)
+    assert report["active"] is True  # reports the LIVE pin, not the probe's
+    np.testing.assert_array_equal(key_before, np.asarray(s.kernel_approx.key))
+    assert s.kernel_approx_active
+
+
+def test_sampler_residual_probe_uses_median_bandwidth_for_adaptive():
+    """Review-caught: a median_step run must be probed at the current
+    median bandwidth, not RBF(1.0) — mirror DistSampler.approx_residual."""
+    from dist_svgd_tpu.ops.kernels import median_bandwidth_approx
+    from dist_svgd_tpu.ops.svgd import phi as phi_exact_fn
+
+    s = dt.Sampler(D, gmm_logp, kernel="median_step",
+                   kernel_approx=KernelApprox("nystrom", num_landmarks=16),
+                   phi_impl="xla")
+    probe = 4.0 * init_particles(0, 64, D)  # median bandwidth far from 1
+    report = s.approx_residual(particles=probe, max_points=64)
+    h = float(median_bandwidth_approx(probe))
+    scores = jax.vmap(jax.grad(gmm_logp))(probe)
+    want = phi_rel_error(
+        phi_exact_fn(probe, probe, scores, RBF(h)),
+        make_approx_phi_fn(RBF(h), KernelApprox("nystrom",
+                                                num_landmarks=16))(
+            probe, probe, scores))
+    assert np.isclose(report["phi_approx_rel_err"], want, rtol=1e-6)
+
+
+def test_load_adopts_saved_crossover_pin_in_partitions_mode():
+    """Review-caught: the partitions-mode 'auto' crossover depends on the
+    block size, so a resharded resume must adopt the SAVED pin instead of
+    silently flipping φ backends at the new topology."""
+    spec = KernelApprox("rff", num_features=16)  # F=32: active at S=2
+    p0 = init_particles(0, N, D)
+
+    def mk(s_count):
+        return make_dist(s_count, p0=p0, exchange_particles=False,
+                         kernel_approx=spec, phi_impl="auto")
+
+    a = mk(2)
+    assert a.kernel_approx_active  # 128·64 ≥ (128+64)·32
+    st = a.state_dict()
+    assert int(np.asarray(st["approx_active"])) == 1
+    b = mk(8)
+    assert not b.kernel_approx_active  # 128·16 < (128+16)·32 at S=8
+    b.load_state_dict(ck.reshard_state(dict(st), 8))
+    assert b.kernel_approx_active  # the saved pin won
+    b.run_steps(2, 0.05)  # and the rebuilt programs run
+
+
+def test_residual_report_shape_contract():
+    x, s, kernel = error_pin_probe(256, 3, seed=0)
+    spec = KernelApprox("rff", 1024).with_key(approx_bank_key(0))
+    r = phi_residual_report(x, s, kernel, spec, max_points=64)
+    assert r["n_eval"] == 64
+    assert 0 <= r["phi_approx_rel_err"] <= r["phi_approx_budget"]
+
+
+# --------------------------------------------------------------------- #
+# steady state + donation
+
+
+def test_zero_steady_state_recompiles_with_approx():
+    from tools.jaxlint.sentry import retrace_sentry
+
+    ds = make_dist(4, kernel_approx="rff", phi_impl="xla")
+    ds.run_steps(2, 0.05)  # warm/compile
+    with retrace_sentry("approx steady state") as sentry:
+        for _ in range(3):
+            ds.run_steps(2, 0.05)
+    if sentry.supported:
+        assert sentry.compiles == 0
+
+
+@pytest.mark.parametrize("wasserstein", [False, True])
+def test_distsampler_donation_bitwise(wasserstein):
+    p0 = init_particles(0, N, D)
+    runs = []
+    for donate in (True, False):
+        kw = dict(include_wasserstein=wasserstein)
+        if wasserstein:
+            kw["wasserstein_solver"] = "sinkhorn"
+        ds = make_dist(4, p0=p0, donate_carries=donate, **kw)
+        ds.run_steps(3, 0.05, h=1.0)
+        ds.run_steps(3, 0.05, h=1.0)  # second call consumes donated state
+        runs.append(np.asarray(ds.particles))
+    np.testing.assert_array_equal(runs[0], runs[1])
+
+
+def test_donation_does_not_invalidate_caller_buffers():
+    p0 = init_particles(0, N, D)
+    ds = make_dist(4, p0=p0, donate_carries=True)
+    ds.run_steps(2, 0.05)
+    np.asarray(p0)  # caller's array survives (constructor copied)
+
+    s = dt.Sampler(D, gmm_logp, donate_carries=True)
+    mine = init_particles(1, N, D)
+    s.run(N, 2, 0.05, record=False, initial_particles=mine)
+    out1 = np.asarray(mine)  # run() copied before donating
+    s.run(N, 2, 0.05, record=False, initial_particles=mine)
+    np.testing.assert_array_equal(out1, np.asarray(mine))
+
+
+def test_sampler_donation_bitwise_with_record_and_chunks(monkeypatch):
+    from dist_svgd_tpu.utils import history as _history
+
+    # force the record path into chunked dispatches so the chunk chain's
+    # carry donation is exercised too
+    monkeypatch.setattr(_history, "record_chunk_steps", lambda n, d: 2)
+    outs = []
+    for donate in (True, False):
+        s = dt.Sampler(D, gmm_logp, donate_carries=donate)
+        final, hist = s.run(64, 5, 0.05, seed=0, record=True)
+        outs.append((np.asarray(final), np.asarray(hist)))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+
+
+def test_intra_step_chunk_donation_bitwise():
+    p0 = init_particles(3, N, D)
+    runs = []
+    for donate in (True, False):
+        ds = make_dist(4, p0=p0, exchange_impl="ring", donate_carries=donate)
+        ds.run_steps(3, 0.05, hops_per_dispatch=2)
+        runs.append(np.asarray(ds.particles))
+    np.testing.assert_array_equal(runs[0], runs[1])
+
+
+# --------------------------------------------------------------------- #
+# perf-gate helpers (the TPU row's CPU-testable logic)
+
+
+def test_approx_row_ok_gates():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import large_n
+
+    good = {"within_budget": True, "sentry_supported": True, "recompiles": 0,
+            "wall_per_step_s": 0.5, "kernel_approx_active": True}
+    ok, why = large_n.approx_row_ok(good)
+    assert ok and not why
+    for bad, frag in (
+        (dict(good, within_budget=False), "budget"),
+        (dict(good, recompiles=2), "recompile"),
+        (dict(good, wall_per_step_s=float("nan")), "wall"),
+        (dict(good, kernel_approx_active=False), "not active"),
+    ):
+        ok, why = large_n.approx_row_ok(bad)
+        assert not ok and any(frag in w for w in why), (bad, why)

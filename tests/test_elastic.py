@@ -276,6 +276,31 @@ def test_reshard_equivalence_grow(tmp_path):
     assert np.isclose(db["ess"], de["ess"], rtol=1e-4)
 
 
+def test_reshard_equivalence_with_kernel_approx(tmp_path):
+    """Approx-kernel resume (ISSUE 13 satellite): a ``kernel_approx='rff'``
+    run checkpointed at 8 shards and resumed at 4 after an injected shrink
+    pins to the never-resharded run — the RFF bank key rides the
+    checkpoint through ``reshard_state``, so the resumed φ uses the
+    identical feature bank (the bank keys pin bitwise)."""
+    kw = dict(kernel_approx="rff", phi_impl="xla")
+    base, rb = run_supervised(make_dist(8, **kw), tmp_path, "abase")
+    want = np.asarray(base.particles)
+
+    sup, r = run_supervised(
+        make_dist(8, **kw), tmp_path, "am4",
+        reshard=ReshardPolicy(lambda s: make_dist(s, **kw)),
+        faults=FaultPlan(MeshShrinkAt(6, 4)))
+    assert r["num_shards"] == 4 and r["reshards"] == 1
+    np.testing.assert_allclose(want, np.asarray(sup.particles),
+                               rtol=0, atol=ATOL)
+    st_b = base._harness.state_dict()
+    st_e = sup._harness.state_dict()
+    np.testing.assert_array_equal(st_b["approx_bank_key"],
+                                  st_e["approx_bank_key"])
+    assert int(np.asarray(st_e["approx_method"])) == int(
+        np.asarray(st_b["approx_method"]))
+
+
 def test_reshard_equivalence_corrupt_manifest_fallback(tmp_path):
     """A checkpoint whose manifest was corrupted still reshards (with the
     inference warning) and reproduces the baseline within tolerance."""

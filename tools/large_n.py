@@ -31,6 +31,7 @@ still clears the watchdog).  Every row is also emitted as a JSON record
 
 import argparse
 import json
+import math
 import sys
 import time
 from pathlib import Path
@@ -45,6 +46,139 @@ import dist_svgd_tpu as dt
 from dist_svgd_tpu.distsampler import W2_GLOBAL_PAIRING_MAX_N
 from dist_svgd_tpu.models.logreg import make_logreg_logp
 from dist_svgd_tpu.utils.datasets import load_benchmark
+
+
+def run_approx_row(n: int, method: str = "rff", num_features: int = 4096,
+                   num_landmarks: int = 4096, steps: int = 5,
+                   samples: int = 2, stepsize: float = 3e-3,
+                   pin_n: int = 2048, exact_probe_n: int = 0,
+                   seed: int = 0) -> dict:
+    """The ``large_n_approx`` bench row: the sub-quadratic φ sampler step at
+    a particle count the exact O(n²) kernel cannot touch on the same
+    budget, with the approximation pinned against the exact kernel at
+    small n.  Three measurements in one record:
+
+    - **throughput** — full fused sampler steps (banana logreg scores +
+      approximate φ) at ``n``, the repo's chained-dispatch protocol, under
+      the retrace sentry (any steady-state compile in the timed window ⇒
+      ``recompiles`` > 0, an unconditional ``perf_regress`` FAIL);
+    - **error pin** — relative φ error of THIS configuration (same method,
+      dial, and — for RFF — the same ``seed``-derived bank) vs the exact
+      kernel on the canonical small-n probe
+      (``ops/approx.py:error_pin_probe``), judged against the declared
+      budget (``default_error_budget``): outside budget ⇒ unconditional
+      FAIL;
+    - **exact extrapolation** — the exact kernel measured at
+      ``exact_probe_n`` (default ``min(n, 65536)``), giving a pairs/sec
+      rate that extrapolates quadratically to ``n`` —
+      ``exact_est_wall_per_step_s`` / ``est_speedup_vs_exact`` quantify
+      the wall the approximation removes.
+    """
+    from dist_svgd_tpu.ops.approx import (
+        KernelApprox,
+        default_error_budget,
+        error_pin_probe,
+        make_approx_phi_fn,
+        phi_rel_error,
+    )
+    from dist_svgd_tpu.ops.svgd import phi as phi_exact
+    from dist_svgd_tpu.utils.rng import approx_bank_key, init_particles
+    from tools.jaxlint.sentry import retrace_sentry
+
+    if method == "rff":
+        spec = KernelApprox("rff", num_features=num_features)
+        dial = num_features
+    else:
+        spec = KernelApprox("nystrom", num_landmarks=num_landmarks)
+        dial = num_landmarks
+    fold = load_benchmark("banana", 42)
+    d = 1 + fold.x_train.shape[1]
+    logp = make_logreg_logp(fold.x_train, fold.t_train.reshape(-1))
+    sampler = dt.Sampler(d, logp, kernel_approx=spec, phi_impl="xla")
+
+    def chain(s, parts, num_steps):
+        out, _ = s.run(parts.shape[0], num_steps, stepsize, seed=seed,
+                       record=False, initial_particles=parts)
+        return out
+
+    parts = init_particles(seed, n, d, dtype=jnp.float32)
+    parts = chain(sampler, parts, steps)
+    np.asarray(parts)[0, 0]  # compile + fence, untimed
+    best = float("inf")
+    with retrace_sentry("large_n_approx timed window") as sentry:
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            parts = chain(sampler, parts, steps)
+            np.asarray(parts)[0, 0]
+            best = min(best, (time.perf_counter() - t0) / steps)
+
+    # error pin at small n: same method/dial/bank as the measured config
+    pin_spec = spec
+    if method == "rff":
+        pin_spec = spec.with_key(approx_bank_key(seed))
+    px, ps, pk = error_pin_probe(pin_n, d, seed)
+    err = phi_rel_error(phi_exact(px, px, ps, pk),
+                        make_approx_phi_fn(pk, pin_spec)(px, px, ps))
+    budget = default_error_budget(pin_spec, d)
+
+    # exact-kernel probe → quadratic extrapolation to n
+    probe_n = exact_probe_n or min(n, 65_536)
+    exact = dt.Sampler(d, logp)
+    eparts = init_particles(seed, probe_n, d, dtype=jnp.float32)
+    eparts = chain(exact, eparts, steps)
+    np.asarray(eparts)[0, 0]
+    ebest = float("inf")
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        eparts = chain(exact, eparts, steps)
+        np.asarray(eparts)[0, 0]
+        ebest = min(ebest, (time.perf_counter() - t0) / steps)
+    pairs_per_sec = probe_n * probe_n / ebest
+    exact_est = n * n / pairs_per_sec
+
+    return {
+        "bench": "large_n_approx", "n": n, "method": method, "dial": dial,
+        "d": d, "stepsize": stepsize, "steps_per_dispatch": steps,
+        "wall_per_step_s": round(best, 6),
+        "updates_per_sec": round(n / best, 1),
+        "approx_rel_err": round(err, 6),
+        "error_budget": round(budget, 6),
+        "within_budget": bool(err <= budget),
+        "pin_n": pin_n,
+        "recompiles": sentry.compiles if sentry.supported else None,
+        "sentry_supported": sentry.supported,
+        "exact_probe_n": probe_n,
+        "exact_probe_wall_per_step_s": round(ebest, 6),
+        "exact_pairs_per_sec": round(pairs_per_sec, 1),
+        "exact_est_wall_per_step_s": round(exact_est, 3),
+        "est_speedup_vs_exact": round(exact_est / best, 1),
+        "kernel_approx_active": sampler.kernel_approx_active,
+    }
+
+
+def approx_row_ok(row: dict) -> tuple:
+    """Unconditional correctness gates of the ``large_n_approx`` row (the
+    ``perf_regress`` discipline: these FAIL regardless of throughput).
+    Returns ``(ok, reasons)``."""
+    why = []
+    if not row.get("within_budget"):
+        why.append(
+            f"approximation error {row.get('approx_rel_err')} exceeds the "
+            f"declared budget {row.get('error_budget')} at the small-n pin"
+        )
+    if row.get("sentry_supported") and row.get("recompiles"):
+        why.append(
+            f"{row['recompiles']} steady-state recompile(s) in the timed "
+            "window — a retrace bug contaminating the measurement"
+        )
+    wall = row.get("wall_per_step_s")
+    if not (isinstance(wall, (int, float)) and math.isfinite(wall)
+            and wall > 0):
+        why.append(f"non-finite wall_per_step_s {wall!r}")
+    if not row.get("kernel_approx_active"):
+        why.append("the approximate backend was not active — the row "
+                   "measured the exact kernel")
+    return (not why), why
 
 
 def resolve_ring_pairing(n: int, exchange: str, exchange_impl: str,
@@ -142,11 +276,48 @@ def main():
     ap.add_argument("--ab", action="store_true",
                     help="chunked-vs-monolithic A/B: measure both "
                          "executions at this config and emit both records")
+    ap.add_argument("--kernel-approx", default=None,
+                    choices=["rff", "nystrom"],
+                    help="measure the sub-quadratic φ instead of the exact "
+                         "kernel: the large_n_approx row (throughput at n, "
+                         "small-n error pin vs the exact kernel, quadratic "
+                         "exact-wall extrapolation)")
+    ap.add_argument("--num-features", type=int, default=4096,
+                    help="RFF accuracy dial R (kernel-approx rff)")
+    ap.add_argument("--num-landmarks", type=int, default=4096,
+                    help="Nyström accuracy dial L (kernel-approx nystrom)")
+    ap.add_argument("--approx-pin-n", type=int, default=2048,
+                    help="small-n size of the exact-vs-approx error pin")
+    ap.add_argument("--exact-probe-n", type=int, default=0,
+                    help="exact-kernel probe size for the quadratic wall "
+                         "extrapolation (0 = min(n, 65536))")
     ap.add_argument("--json-out", type=str, default=None,
                     help="append one JSON record per measured row here")
     args = ap.parse_args()
 
     print("devices:", jax.devices(), flush=True)
+    if args.kernel_approx is not None:
+        record = run_approx_row(
+            args.n, method=args.kernel_approx,
+            num_features=args.num_features,
+            num_landmarks=args.num_landmarks, steps=args.steps,
+            samples=args.samples, stepsize=args.stepsize,
+            pin_n=args.approx_pin_n, exact_probe_n=args.exact_probe_n,
+        )
+        emit(record, args.json_out)
+        ok, why = approx_row_ok(record)
+        print(
+            f"n={args.n} {args.kernel_approx} (dial {record['dial']}): "
+            f"{record['wall_per_step_s']*1e3:.1f} ms/step "
+            f"({record['updates_per_sec']/1e6:.2f}M updates/s), pin err "
+            f"{record['approx_rel_err']:.4f} <= budget "
+            f"{record['error_budget']:.4f}: {record['within_budget']}; "
+            f"exact est {record['exact_est_wall_per_step_s']:.1f} s/step "
+            f"(~{record['est_speedup_vs_exact']:.0f}x)"
+            + ("" if ok else f"  GATE: {'; '.join(why)}"),
+            flush=True,
+        )
+        return
     fold = load_benchmark("banana", 42)
     d = 1 + fold.x_train.shape[1]
     n = args.n

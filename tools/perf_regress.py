@@ -89,6 +89,16 @@ A/B timing protocol those notes derived:
   propagation enabled: while tracing is on, every batcher submit mints
   and threads a trace id, so the tracer-on A/B arm prices propagation in.
 
+- **sub-quadratic φ gates (round 17)** — ``large_n_approx``
+  (``tools/large_n.py:run_approx_row``: the RFF feature-space φ at a
+  particle count whose exact O(n²) step is off the dispatch budget
+  entirely, extrapolated from a same-run exact probe) FAILs
+  unconditionally when the small-n exact-vs-approx error pin breaches the
+  declared budget (``ops/approx.py:default_error_budget`` — approximation
+  drift is wrongness, not slowness) or when the timed window holds ANY
+  steady-state recompile; its throughput gates against a median+MAD
+  window like the other compute rows.
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -149,7 +159,10 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
               "fleet_detect_s": 2.0, "fleet_readmit_s": 2.0,
               # the federation sweep is N sequential HTTP scrapes + a
               # dump merge — host-scheduling-noisy like the other walls
-              "fleet_federation_scrape_ms": 2.0}
+              "fleet_federation_scrape_ms": 2.0,
+              # the approx row is one big chained dispatch like the compute
+              # rows, but includes the exact-probe leg — modest widening
+              "large_n_approx": 1.5}
 
 #: Hard ceiling on the span tracer's measured serve-bench cost (round 10):
 #: the interleaved tracer-off/on A/B (``serve_bench.
@@ -176,6 +189,16 @@ SERVE_BENCH_KW = dict(model="logreg", n_particles=10_000, n_features=54,
 #: the ensemble particle-sharded across every device on the host and the
 #: batcher running multiple dispatch lanes over the shared engine.
 SERVE_SHARDED_LANES = 4
+
+#: large_n_approx row config (round 17): the sub-quadratic RFF φ at a
+#: particle count whose exact O(n²) step (extrapolated from the same-run
+#: exact probe) would blow the single-dispatch watchdog outright — the
+#: regime ROADMAP item 2 exists for.  The row's correctness gates are
+#: unconditional: the small-n error pin must land inside the declared
+#: budget and the timed window must hold zero steady-state recompiles;
+#: throughput gates against its own median+MAD window.
+LARGE_N_APPROX_KW = dict(n=2_000_000, method="rff", num_features=4096,
+                         steps=3, samples=2, exact_probe_n=131_072)
 
 #: serve_multitenant row config (round 14): 10 heterogeneous tenants
 #: (mixed logreg/BNN/GMM shapes) behind one registry, the same client /
@@ -812,6 +835,44 @@ def main():
                     failures += 1
                 results[key] = value
             print(json.dumps(row), flush=True)
+
+    # sub-quadratic φ gates (round 17): the large_n_approx row — RFF φ at
+    # a particle count whose exact step (extrapolated quadratically from
+    # the same-run exact probe) is off the dispatch budget entirely.  Two
+    # unconditional correctness gates (the small-n error pin must land
+    # inside the declared budget — an approximation drifting out of its
+    # budget is wrongness, not slowness; and zero steady-state recompiles
+    # in the timed window) plus a median+MAD throughput window.
+    import large_n as large_n_mod
+
+    arow = large_n_mod.run_approx_row(**LARGE_N_APPROX_KW)
+    a_ok, a_why = large_n_mod.approx_row_ok(arow)
+    ln_key = "large_n_approx"
+    row = {"bench": ln_key, "value": arow["updates_per_sec"],
+           "unit": "updates/sec", "n": arow["n"], "method": arow["method"],
+           "dial": arow["dial"],
+           "approx_rel_err": arow["approx_rel_err"],
+           "error_budget": arow["error_budget"],
+           "within_budget": arow["within_budget"],
+           "recompiles": arow["recompiles"],
+           "exact_est_wall_per_step_s": arow["exact_est_wall_per_step_s"],
+           "est_speedup_vs_exact": arow["est_speedup_vs_exact"]}
+    if not a_ok:
+        row["status"] = "FAIL"
+        row["error"] = "; ".join(a_why)
+        failures += 1
+    else:
+        tol = min(args.tol * TOL_FACTOR.get(ln_key, 1.0), 0.9)
+        status, info = judge_row(
+            arow["updates_per_sec"], incumbent_history(incumbents, ln_key),
+            tol, True,
+        )
+        row.update(info)
+        row["status"] = status
+        if status == "FAIL":
+            failures += 1
+        results[ln_key] = arow["updates_per_sec"]
+    print(json.dumps(row), flush=True)
 
     # fleet-failover gates (round 15): the real-subprocess drill — 3 CPU
     # replica processes behind the router, SIGKILL one under open-loop

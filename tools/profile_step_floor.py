@@ -68,6 +68,48 @@ def timed_scan(body, particles, iters, reps=3, samples=3):
     return best
 
 
+def donate_ab(n: int, iters: int = 100, chain: int = 32, samples: int = 3,
+              seed: int = 0) -> dict:
+    """Donated-vs-undonated A/B of the training-scan carry (ROADMAP item 1:
+    the step carries donate through the single Plan compile site).  Two
+    identical samplers — ``donate_carries`` on/off — run the same chained
+    ``run()`` schedule; the record carries both walls, the ratio, and the
+    **bitwise** agreement of the final particle arrays (donation is pure
+    buffer aliasing: any numeric difference is a bug)."""
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.utils.datasets import load_benchmark as _lb
+
+    fold = _lb("banana", 42)
+    x = jnp.asarray(fold.x_train)
+    t = jnp.asarray(fold.t_train.reshape(-1))
+    d = 1 + x.shape[1]
+    logp = lambda th: logreg_logp(th, (x, t))
+    walls, finals = {}, {}
+    for donate in (True, False):
+        s = dt.Sampler(d, logp, donate_carries=donate)
+        out = init_particles(seed, n, d)
+        out, _ = s.run(n, iters, 3e-3, seed=seed, record=False,
+                       initial_particles=out)  # compile, untimed
+        best = float("inf")
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            for _ in range(chain):
+                out, _ = s.run(n, iters, 3e-3, seed=seed, record=False,
+                               initial_particles=out)
+            np.asarray(out)[0, 0]
+            best = min(best, (time.perf_counter() - t0) / chain)
+        walls[donate] = best
+        finals[donate] = np.asarray(out)
+    return {
+        "bench": "donate_ab", "n": n, "iters_per_dispatch": iters,
+        "chain": chain,
+        "donated_ms_per_dispatch": round(walls[True] * 1e3, 4),
+        "undonated_ms_per_dispatch": round(walls[False] * 1e3, 4),
+        "speedup": round(walls[False] / walls[True], 4),
+        "bitwise_equal": bool(np.array_equal(finals[True], finals[False])),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=100)
@@ -76,7 +118,21 @@ def main():
                     help="capture a jax.profiler device trace of the "
                          "measured sections into DIR (TensorBoard/xprof-"
                          "readable); off when omitted")
+    ap.add_argument("--donate-ab", action="store_true",
+                    help="measure the donated-vs-undonated training-carry "
+                         "A/B (identical schedules, donate_carries on/off) "
+                         "and pin the final states bitwise; skips the "
+                         "floor decomposition")
     args = ap.parse_args()
+
+    if args.donate_ab:
+        import json
+
+        row = donate_ab(args.n)
+        print(json.dumps(row), flush=True)
+        if not row["bitwise_equal"]:
+            raise SystemExit("donation changed the numerics — bug")
+        return
 
     print("devices:", jax.devices(), flush=True)
     fold = load_benchmark("banana", 42)
