@@ -42,6 +42,7 @@ import numpy as np
 
 from dist_svgd_tpu.ops.approx import (
     APPROX_METHOD_CODES,
+    RFF_REDRAW_MODES,
     approx_preferred,
     as_kernel_approx,
     nystrom_landmark_indices,
@@ -895,6 +896,13 @@ class DistSampler:
             )
             if self._approx.method == "rff":
                 state["approx_bank_key"] = np.asarray(self._approx.key)
+                # the bank lifetime is part of the trajectory: a per-step
+                # redraw run resumed as a per-run-bank sampler (or vice
+                # versa) would silently switch φ randomness mid-trajectory
+                state["approx_rff_redraw"] = np.asarray(
+                    RFF_REDRAW_MODES.index(self._approx.rff_redraw),
+                    dtype=np.int8,
+                )
             else:
                 m_interact = (self._num_particles
                               if self._mode != PARTITIONS
@@ -1097,6 +1105,19 @@ class DistSampler:
                     f"{saved_dial} but this sampler runs "
                     f"{self._approx.method!r} at "
                     f"{self._approx.accuracy_dial}: the accuracy dial is "
+                    "part of the trajectory — match the saved configuration"
+                )
+            redraw_code = state.get("approx_rff_redraw")
+            # absent in pre-redraw checkpoints, which could only have been
+            # written by a per-run-bank sampler
+            saved_redraw = (RFF_REDRAW_MODES[int(np.asarray(redraw_code))]
+                            if redraw_code is not None else "run")
+            if (self._approx.method == "rff"
+                    and saved_redraw != self._approx.rff_redraw):
+                raise ValueError(
+                    f"checkpoint was written with rff_redraw="
+                    f"{saved_redraw!r} but this sampler runs "
+                    f"{self._approx.rff_redraw!r}: the bank lifetime is "
                     "part of the trajectory — match the saved configuration"
                 )
             rebuild = False

@@ -35,10 +35,20 @@ memory story as the exact ring.
 Bandwidth discipline: the closed forms above are functions of ONE static
 bandwidth.  ``kernel='median'`` therefore resolves the bandwidth *before*
 the bank/landmark machinery is built (the samplers order it that way), and
-``AdaptiveRBF`` (``kernel='median_step'``) is refused for ``'rff'`` — the
-bank is drawn at a frozen bandwidth, and per-step drift would silently
-decalibrate it (re-drawing per step is future work).  ``'nystrom'``
-composes with the adaptive bandwidth through the exact rescaling identity
+``AdaptiveRBF`` (``kernel='median_step'``) is refused for ``'rff'`` at the
+default ``rff_redraw='run'`` — the bank is drawn once at a frozen
+bandwidth, and per-step drift would silently decalibrate it.
+``rff_redraw='step'`` (round 18) lifts that refusal: the bank is re-drawn
+**inside the compiled program every step** from ``fold_in(bank_root, t)``,
+so under the adaptive rescaling identity each step's fresh bandwidth-1
+bank estimates the step's own median-bandwidth kernel — and the per-step
+randomness is independent across steps (no frozen-bank error correlation).
+A redraw-per-step φ needs the step index: its ``phi_fn`` carries
+``needs_step = True`` and the samplers bind ``t`` via :func:`bind_phi_step`
+at the one place each step program knows its absolute index (the same
+``(root, t)`` fold the minibatch stream uses, so chunk boundaries and
+reshards are invisible to the bank stream too).  ``'nystrom'`` composes
+with the adaptive bandwidth through the exact rescaling identity
 (landmarks are re-selected and re-factored per call anyway).
 """
 
@@ -54,6 +64,10 @@ import numpy as np
 from dist_svgd_tpu.ops.kernels import RBF, squared_distances
 
 APPROX_METHODS = ("rff", "nystrom")
+
+#: RFF bank lifetimes: one bank per run (a compile-time constant) or a
+#: fresh bank per step (``fold_in(bank_root, t)`` inside the program).
+RFF_REDRAW_MODES = ("run", "step")
 
 #: ``state_dict`` encoding of the approximation method (orbax/tensorstore
 #: cannot serialise unicode arrays — same convention as ``W2_PAIRING_CODES``).
@@ -87,6 +101,15 @@ class KernelApprox:
         key: PRNG key the RFF bank is drawn from (``utils/rng.py:
             approx_bank_key``).  The samplers derive it from the run seed;
             direct ``resolve_phi_fn`` users must supply it for ``'rff'``.
+        rff_redraw: ``'run'`` (default — one bank per run, an eager
+            compile-time constant shared by every shard and step) or
+            ``'step'`` (the bank is re-drawn inside the compiled program
+            each step from ``fold_in(key, t)`` — ``key`` becomes the *bank
+            root*; the resulting φ carries ``needs_step = True`` and must
+            be bound with :func:`bind_phi_step`).  ``'step'`` is what
+            composes with the per-step median bandwidth
+            (``kernel='median_step'``); it costs one (R, d) normal draw
+            per step inside the program.
 
     Instances are static configuration (close over them, like
     :class:`~dist_svgd_tpu.ops.kernels.RBF`); :meth:`cache_token` is the
@@ -94,7 +117,8 @@ class KernelApprox:
     """
 
     def __init__(self, method: str, num_features: int = 2048,
-                 num_landmarks: int = 1024, ridge: float = 1e-4, key=None):
+                 num_landmarks: int = 1024, ridge: float = 1e-4, key=None,
+                 rff_redraw: str = "run"):
         if method not in APPROX_METHODS:
             raise ValueError(
                 f"unknown kernel_approx method {method!r} "
@@ -106,11 +130,23 @@ class KernelApprox:
             raise ValueError(f"num_landmarks must be >= 1, got {num_landmarks}")
         if ridge < 0:
             raise ValueError(f"ridge must be >= 0, got {ridge}")
+        if rff_redraw not in RFF_REDRAW_MODES:
+            raise ValueError(
+                f"unknown rff_redraw {rff_redraw!r} "
+                f"(expected one of {RFF_REDRAW_MODES})"
+            )
+        if rff_redraw != "run" and method != "rff":
+            raise ValueError(
+                f"rff_redraw={rff_redraw!r} applies to method='rff' only "
+                f"(got method={method!r}: Nyström landmarks re-factor every "
+                "call already)"
+            )
         self.method = method
         self.num_features = int(num_features)
         self.num_landmarks = int(num_landmarks)
         self.ridge = float(ridge)
         self.key = key
+        self.rff_redraw = rff_redraw
 
     @property
     def feature_count(self) -> int:
@@ -128,7 +164,8 @@ class KernelApprox:
         """A copy bound to ``key`` (the samplers bind the per-run bank key
         here; idempotent when the key is unchanged)."""
         out = KernelApprox(self.method, self.num_features,
-                           self.num_landmarks, self.ridge, key)
+                           self.num_landmarks, self.ridge, key,
+                           self.rff_redraw)
         return out
 
     def cache_token(self):
@@ -137,7 +174,7 @@ class KernelApprox:
         kb = (None if self.key is None
               else np.asarray(self.key).tobytes())
         return (self.method, self.num_features, self.num_landmarks,
-                self.ridge, kb)
+                self.ridge, kb, self.rff_redraw)
 
     def __repr__(self) -> str:  # pragma: no cover
         dial = (f"num_features={self.num_features}" if self.method == "rff"
@@ -323,12 +360,31 @@ def phi_nystrom(updated: jax.Array, interacting: jax.Array,
 # φ-backend construction (the resolve_phi_fn plug-in)
 
 
+def bind_phi_step(phi_fn, t):
+    """Bind the absolute step index ``t`` into a redraw-per-step φ
+    (``phi_fn.needs_step``); a no-op passthrough for every other backend.
+    The samplers call this at the one place each step program knows its
+    absolute index — the same spot the minibatch key folds ``(root, t)`` —
+    so chunked, scanned, and resumed executions all fold the identical
+    bank stream."""
+    if getattr(phi_fn, "needs_step", False):
+        return lambda y, x, s: phi_fn(y, x, s, t=t)
+    return phi_fn
+
+
 def make_approx_phi_fn(kernel: RBF, approx: KernelApprox):
     """Build the approximate ``phi_fn(updated, interacting, scores)`` for a
     fixed-bandwidth RBF kernel.  The RFF bank is derived lazily per feature
     dimension from the spec's key at trace time (a concrete key ⇒ the bank
     is an eager constant baked into the compiled program, shared by every
-    shard/lane); Nyström needs no bank."""
+    shard/lane); Nyström needs no bank.
+
+    ``rff_redraw='step'`` instead returns a φ with ``needs_step = True``
+    whose signature is ``phi_fn(updated, interacting, scores, t=...)``:
+    the bank is drawn inside the traced program from ``fold_in(key, t)``
+    (``key`` is the *bank root*), so every step uses a fresh, independent
+    bank at zero recompiles (``t`` is a traced scan operand, not a Python
+    scalar).  Bind the step index with :func:`bind_phi_step`."""
     if not isinstance(kernel, RBF):
         raise ValueError(
             "kernel_approx requires an RBF kernel (the feature and landmark "
@@ -349,6 +405,20 @@ def make_approx_phi_fn(kernel: RBF, approx: KernelApprox):
             "samplers derive it from the run seed automatically"
         )
     key, num_f = approx.key, approx.num_features
+    if approx.rff_redraw == "step":
+
+        def rff_step_fn(y, x, s, t=None):
+            if t is None:
+                raise ValueError(
+                    "rff_redraw='step' needs the step index: bind it with "
+                    "ops.approx.bind_phi_step(phi_fn, t) before calling"
+                )
+            freqs = rff_frequencies(jax.random.fold_in(key, t), num_f,
+                                    x.shape[1], bw)
+            return phi_rff(y, x, s, freqs)
+
+        rff_step_fn.needs_step = True
+        return rff_step_fn
     banks = {}
 
     def rff_fn(y, x, s):
@@ -381,7 +451,8 @@ def phi_rel_error(exact, approx) -> float:
 
 
 def phi_residual_report(particles, scores, kernel: RBF,
-                        approx: KernelApprox, max_points: int = 512) -> dict:
+                        approx: KernelApprox, max_points: int = 512,
+                        step: int = 0) -> dict:
     """Measure the feature-space φ residual on an evenly-strided subsample
     of the current ensemble: exact φ vs the configured approximation, both
     over the same ≤``max_points`` rows.  O(max_points²) — the diagnostics
@@ -398,7 +469,9 @@ def phi_residual_report(particles, scores, kernel: RBF,
         stride = -(-n // max_points)
         particles = particles[::stride]
         scores = scores[::stride]
-    approx_fn = make_approx_phi_fn(kernel, approx)
+    # a redraw-per-step spec probes the bank of ``step`` (the fold a live
+    # run would use at that index); run-lifetime banks ignore the binding
+    approx_fn = bind_phi_step(make_approx_phi_fn(kernel, approx), step)
     exact = phi_exact(particles, particles, scores, kernel)
     est = approx_fn(particles, particles, scores)
     err = phi_rel_error(exact, est)

@@ -539,11 +539,15 @@ def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1,
       feature-space matmuls ARE XLA programs);
     - ``'pallas'``/``'pallas_bf16'`` are refused — the approximation has
       no Pallas tier; ``'auto'`` is how exact-Pallas composes with it;
-    - ``AdaptiveRBF`` + ``'rff'`` is refused in one line (the bank is
-      drawn at a frozen bandwidth; per-step drift would silently
-      decalibrate it until a re-draw mechanism exists), while
-      ``'nystrom'`` composes through the rescaling identity (landmarks
-      are re-selected and re-factored every call anyway).
+    - ``AdaptiveRBF`` + ``'rff'`` at the default ``rff_redraw='run'`` is
+      refused in one line (the bank is drawn once at a frozen bandwidth;
+      per-step drift would silently decalibrate it), while
+      ``KernelApprox('rff', rff_redraw='step')`` composes: the bank is
+      re-folded from ``(bank_root, t)`` inside the program each step, so
+      the returned φ carries ``needs_step = True`` and the step builders
+      bind the index via ``ops.approx.bind_phi_step``; ``'nystrom'``
+      composes through the rescaling identity (landmarks are re-selected
+      and re-factored every call anyway).
 
     Returns ``phi_fn(updated, interacting, scores)``:
 
@@ -583,13 +587,16 @@ def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1,
                 "Pallas below the crossover, features/landmarks above) or "
                 "'xla' (always approximate)"
             )
-        if isinstance(kernel, AdaptiveRBF) and kernel_approx.method == "rff":
+        if (isinstance(kernel, AdaptiveRBF) and kernel_approx.method == "rff"
+                and kernel_approx.rff_redraw != "step"):
             raise ValueError(
                 "kernel_approx='rff' with the per-step median bandwidth "
-                "(kernel='median_step' / AdaptiveRBF) is refused: the RFF "
-                "bank is drawn at a frozen bandwidth and per-step drift "
-                "would silently decalibrate it until the bank is re-drawn "
-                "— use kernel='median' (frozen per run) or "
+                "(kernel='median_step' / AdaptiveRBF) is refused at "
+                "rff_redraw='run': the bank is drawn once at a frozen "
+                "bandwidth and per-step drift would silently decalibrate "
+                "it — use KernelApprox('rff', rff_redraw='step') (fresh "
+                "bank folded from (bank_root, t) every step), "
+                "kernel='median' (frozen per run), or "
                 "kernel_approx='nystrom' (re-factored every step)"
             )
     if isinstance(kernel, AdaptiveRBF):
@@ -604,6 +611,18 @@ def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1,
         # which IS the rescaled landmark set, so the identity holds exactly.
         base = resolve_phi_fn(RBF(1.0), phi_impl, batch_hint, kernel_approx)
         max_points = kernel.max_points
+
+        if getattr(base, "needs_step", False):
+            # redraw-per-step RFF under the identity: each step's fresh
+            # bandwidth-1 bank sees that step's rescaled inputs, so the
+            # estimate is calibrated to the step's own median bandwidth
+            def adaptive_step_fn(y, x, s, t=None):
+                h = median_bandwidth_approx(x, max_points)
+                sh = jnp.sqrt(h.astype(y.dtype))
+                return base(y / sh, x / sh, s * sh, t=t) / sh
+
+            adaptive_step_fn.needs_step = True
+            return adaptive_step_fn
 
         def adaptive_fn(y, x, s):
             h = median_bandwidth_approx(x, max_points)
@@ -622,6 +641,17 @@ def resolve_phi_fn(kernel, phi_impl: str, batch_hint: int = 1,
             return approx_fn
         exact_fn = resolve_phi_fn(kernel, "auto", batch_hint)
         feature_count = kernel_approx.feature_count
+
+        if getattr(approx_fn, "needs_step", False):
+
+            def auto_approx_step_fn(y, x, s, t=None):
+                if approx_preferred(y.shape[0] * batch_hint, x.shape[0],
+                                    feature_count):
+                    return approx_fn(y, x, s, t=t)
+                return exact_fn(y, x, s)
+
+            auto_approx_step_fn.needs_step = True
+            return auto_approx_step_fn
 
         def auto_approx_fn(y, x, s):
             if approx_preferred(y.shape[0] * batch_hint, x.shape[0],

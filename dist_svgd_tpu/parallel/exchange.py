@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dist_svgd_tpu.ops.approx import bind_phi_step as _bind_phi_step
 from dist_svgd_tpu.ops.kernels import (
     RBF,
     AdaptiveRBF,
@@ -480,6 +481,11 @@ def _build_core(
     def core(block, data, t, key):
         r = lax.axis_index(AXIS)
         data_local = resolve_data(data, t, r)
+        # redraw-per-step RFF (ops/approx.py): fold the bank from the
+        # absolute step index, here — the one spot every execution shape
+        # (eager, scanned, scan-chunked) knows t, so the bank stream is
+        # chunk- and reshard-invariant like the minibatch stream
+        phi_step = _bind_phi_step(phi_fn, t)
 
         # One minibatch per shard per step, shared across every use of this
         # shard's data within the step (keeps ring ≡ gather exactly).
@@ -496,9 +502,9 @@ def _build_core(
         interacting = None
         if mode == PARTITIONS:
             scores = score_scale * lik_score_of(block) + batched_prior(block)
-            delta = phi_fn(block, block, scores)
+            delta = phi_step(block, block, scores)
         elif ring:
-            hop_phi = phi_fn
+            hop_phi = phi_step
             if ring_adaptive:
                 h = _ring_median_bandwidth(
                     block, num_shards, kernel.max_points
@@ -507,7 +513,7 @@ def _build_core(
                 # φ_h(y; x, s) = φ₁(y/√h; x/√h, √h·s)/√h, per hop — linear
                 # in the hop accumulation, so the summed ring φ carries the
                 # same identity (resolve_phi_fn's AdaptiveRBF wrapper)
-                hop_phi = lambda y, x, s_: phi_fn(y / sh, x / sh, s_ * sh) / sh
+                hop_phi = lambda y, x, s_: phi_step(y / sh, x / sh, s_ * sh) / sh
             if mode == ALL_SCORES:
                 delta = _ring_phi_exact_scores(
                     block, lik_score_of, batched_prior, hop_phi, num_shards
@@ -523,7 +529,7 @@ def _build_core(
             else:
                 scores = score_scale * local_scores
             scores = scores + batched_prior(interacting)
-            delta = phi_fn(block, interacting, scores)
+            delta = phi_step(block, interacting, scores)
 
         return delta, interacting
 
@@ -605,6 +611,15 @@ def make_chunked_ring_step_fns(
         logp, kernel, phi_impl, log_prior, batch_size, n_local_data,
         phi_batch_hint, kernel_approx,
     )
+    if getattr(phi_fn, "needs_step", False) and mode == ALL_SCORES:
+        raise ValueError(
+            "chunked all_scores ring stepping does not thread the step "
+            "index through its φ-pass chunks (exact_phi_hops carries only "
+            "the rotating (block, score, acc) state), which "
+            "rff_redraw='step' needs for its per-step bank fold — use "
+            "rff_redraw='run', kernel_approx='nystrom', or the "
+            "all_particles mode"
+        )
     resolve_data = _shard_data_resolver(mode, num_shards, n_local_data, shard_data)
 
     def lik_score_env(dtype, data, t, key):
@@ -627,7 +642,8 @@ def make_chunked_ring_step_fns(
             lik = lik_score_env(block.dtype, data, t, key)
             score_of = lambda th: score_scale * lik(th) + batched_prior(th)
             return _ring_local_hops(
-                block, (visiting, acc), score_of, phi_fn, num_shards,
+                block, (visiting, acc), score_of,
+                _bind_phi_step(phi_fn, t), num_shards,
                 num_hops, rotate_last,
             )
 
@@ -750,7 +766,9 @@ def make_shard_step_lagged(
                 mb_scale = jnp.asarray(scale, dtype=blk.dtype)
             scores = score_scale * mb_scale * batched_score(view, dl)
             scores = scores + batched_prior(view)
-            delta = phi_fn(blk, view, scores)
+            # sub-step i of this macro is absolute step t + i (t is the
+            # first sub-step's counter) — the redraw-per-step bank folds it
+            delta = _bind_phi_step(phi_fn, t + i)(blk, view, scores)
             return blk + step_size * delta, (blk if record else None)
 
         blk, hist = lax.scan(

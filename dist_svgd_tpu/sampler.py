@@ -21,7 +21,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dist_svgd_tpu.ops.approx import approx_preferred, as_kernel_approx
+from dist_svgd_tpu.ops.approx import (
+    approx_preferred,
+    as_kernel_approx,
+    bind_phi_step,
+)
 from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.svgd import svgd_step_sequential
 from dist_svgd_tpu.parallel.plan import Plan
@@ -85,7 +89,10 @@ class Sampler:
             (``utils/rng.py:approx_bank_key``) at the bandwidth frozen by
             then — ``kernel='median'`` resolves *before* the bank is
             built, and ``'median_step'`` + ``'rff'`` is refused in one
-            line (``'nystrom'`` composes).  Jacobi only.
+            line at the default ``rff_redraw='run'``
+            (``KernelApprox('rff', rff_redraw='step')`` lifts it: the
+            bank re-folds from ``(bank_root, t)`` every step inside the
+            program; ``'nystrom'`` composes either way).  Jacobi only.
         donate_carries: donate the scan carry (the particle array) to XLA
             at every run/chunk dispatch — no per-dispatch re-allocation;
             bitwise-identical results (``tools/profile_step_floor.py
@@ -367,13 +374,17 @@ class Sampler:
 
         phi_fn = self._phi
 
-        def one_step(parts, step_size, step_key):
+        def one_step(parts, step_size, step_key, step_idx):
+            # redraw-per-step RFF folds its bank from the same absolute
+            # index the minibatch key uses (ops/approx.py:bind_phi_step) —
+            # a no-op wrapper for every other φ backend
+            phi_t = bind_phi_step(phi_fn, step_idx)
             if minibatch:
                 scores = self._minibatch_scores(parts, step_key)
-                return parts + step_size * phi_fn(parts, parts, scores)
+                return parts + step_size * phi_t(parts, parts, scores)
             if update_rule == "jacobi":
                 scores = batched_score(parts)
-                return parts + step_size * phi_fn(parts, parts, scores)
+                return parts + step_size * phi_t(parts, parts, scores)
             return svgd_step_sequential(parts, self._score_fn, step_size, kernel)
 
         def scan_run(particles, step_size, batch_key, i0):
@@ -382,7 +393,7 @@ class Sampler:
             # monolithic scan — chunk boundaries are invisible to the RNG
             def body(parts, i):
                 new = one_step(parts, step_size,
-                               jax.random.fold_in(batch_key, i0 + i))
+                               jax.random.fold_in(batch_key, i0 + i), i0 + i)
                 if record:
                     return new, parts  # pre-update snapshot (reference convention)
                 return new, None

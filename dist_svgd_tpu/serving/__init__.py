@@ -33,6 +33,13 @@ turns a checkpointed ensemble into a low-latency prediction service:
   429-backpressure honoring, optional tail hedging, and graceful 503
   degradation — the unit of failure becomes a whole process and the
   system keeps serving (``tools/fleet_drill.py`` measures it);
+- :mod:`autoscale` — :class:`AutoscaleController`: the **control plane**
+  — watches SLO burn rates and queue/latency windows from the metrics
+  registry and retunes the batcher's lanes, its coalescing window, and
+  per-tenant quotas live (bounded hysteresis, injectable clock), so the
+  system sheds and widens *before* p99 breaches instead of recovering
+  after; served at ``/autoscale`` (``tools/workload_replay.py`` measures
+  it under production-shaped traffic);
 - :mod:`registry` — :class:`ModelRegistry`: **multi-tenant serving** —
   many heterogeneous posteriors (logreg/BNN/GMM, different shapes, steps,
   dtypes, plans) hosted as named tenants behind ONE process: one shared
@@ -52,6 +59,10 @@ The load generator lives in ``tools/serve_bench.py``; the covertype
 train → checkpoint → serve demo in ``experiments/serve_covertype.py``.
 """
 
+from dist_svgd_tpu.serving.autoscale import (
+    AutoscaleController,
+    AutoscalePolicy,
+)
 from dist_svgd_tpu.serving.batcher import MicroBatcher, Overloaded
 from dist_svgd_tpu.serving.engine import (
     CheckpointHotReloader,
@@ -74,6 +85,8 @@ from dist_svgd_tpu.serving.registry import (
 from dist_svgd_tpu.serving.server import PredictionServer
 
 __all__ = [
+    "AutoscaleController",
+    "AutoscalePolicy",
     "PredictiveEngine",
     "CheckpointHotReloader",
     "EnsembleRejected",

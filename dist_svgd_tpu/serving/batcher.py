@@ -62,6 +62,18 @@ label for tenant requests (tenant-less series stay unlabelled — the
 single-tenant deployment is byte-identical), plus
 ``svgd_serve_quota_sheds_total{tenant=...}`` and the per-tenant queued-
 rows gauge.
+
+Live capacity retune (round 18): :meth:`MicroBatcher.set_lanes` spawns or
+retires dispatch workers while the batcher serves (retiring lanes finish
+their in-flight batch, re-check the live target, and exit — lock-safe
+against concurrent submits), and :meth:`MicroBatcher.set_max_wait_ms`
+changes the coalescing window for batches already waiting (collectors
+re-derive the flush deadline from the live window every wakeup).  These
+are the :mod:`~dist_svgd_tpu.serving.autoscale` controller's actuation
+seams; the current targets are scrapeable as ``svgd_serve_lanes`` /
+``svgd_serve_max_wait_ms`` gauges, and every :class:`Overloaded` drain
+estimate reads the live knobs (window, queue depth, lane count) at shed
+time so Retry-After stays honest across retunes.
 """
 
 from __future__ import annotations
@@ -209,6 +221,9 @@ class MicroBatcher:
             raise ValueError("max_queue_rows must be >= max_batch")
         self._dispatch = dispatch
         self.max_batch = int(max_batch)
+        #: Live lane target (round 18): :meth:`set_lanes` retunes it while
+        #: the batcher runs — lanes at index >= the target retire after
+        #: their in-flight batch; missing lanes spawn.  Read-only outside.
         self.lanes = int(lanes)
         self._max_wait_s = float(max_wait_ms) / 1e3
         self.max_queue_rows = int(max_queue_rows)
@@ -224,6 +239,14 @@ class MicroBatcher:
         # the ModelRegistry that mutates it), queued rows and quota-shed
         # counts per tenant — all guarded by _cond's lock
         self._quotas = quotas if quotas is not None else {}
+        # 'overflow' (round 14, default): quotas bite only when the
+        # bounded queue fills.  'admission' (round 18): an over-quota
+        # tenant is refused at submit time even with queue room — the
+        # autoscale controller flips this on WHILE quotas are tightened
+        # under overload, so a flooding tenant's queue occupancy (and
+        # therefore everyone's queue delay) stays bounded between
+        # overflow events, and flips it back when calm restores quotas.
+        self._quota_mode = "overflow"
         self._tenant_queued: Dict[str, int] = {}
         # rows collected into a batch but not yet resolved: the drain
         # condition on tenant removal is queued AND inflight == 0 (a
@@ -305,8 +328,23 @@ class MicroBatcher:
         self._m_tenant_queued = reg.gauge(
             "svgd_serve_tenant_queued_rows",
             "rows queued per tenant, not yet dispatched")
+        # live capacity knobs (round 18): last-write-wins gauges so the
+        # autoscale controller's retunes are scrapeable next to the load
+        # they reacted to
+        self._m_lanes = reg.gauge(
+            "svgd_serve_lanes", "live dispatch-lane target per batcher")
+        self._m_max_wait = reg.gauge(
+            "svgd_serve_max_wait_ms", "live coalescing window per batcher")
+        self._m_lanes.set(self.lanes, batcher=self.metrics_instance)
+        self._m_max_wait.set(self._max_wait_s * 1e3,
+                             batcher=self.metrics_instance)
 
         self._threads: List[threading.Thread] = []
+        # lane id -> its current worker thread (a retired-then-regrown lane
+        # id gets a fresh thread; every thread ever spawned stays in
+        # _threads so close() can join them all)
+        self._lane_threads: Dict[int, threading.Thread] = {}
+        self._started = False
         if autostart:
             self.start()
 
@@ -349,6 +387,26 @@ class MicroBatcher:
             with self._cond:
                 if not self._open:
                     raise RuntimeError("batcher is closed")
+                if self._quota_mode == "admission" and tenant is not None:
+                    quota = self._quota_for(tenant)
+                    if (quota is not None
+                            and self._tenant_queued.get(tenant, 0) + rows
+                            > quota):
+                        # admission-time quota (round 18): while the
+                        # controller holds quotas tightened, an over-quota
+                        # tenant is refused BEFORE it occupies queue rows
+                        # other tenants will wait behind
+                        self._n_shed += 1
+                        self._quota_sheds[tenant] = (
+                            self._quota_sheds.get(tenant, 0) + 1)
+                        self._m_shed.inc(**tl)
+                        self._m_quota_shed.inc(tenant=tenant)
+                        raise Overloaded(
+                            f"tenant {tenant!r} is over its inflight-rows "
+                            f"quota ({quota}, admission-enforced); retry "
+                            "with backoff",
+                            retry_after_s=self._retry_after_s_locked(),
+                        )
                 if self._queued_rows + rows > self.max_queue_rows:
                     quota = self._quota_for(tenant)
                     if (quota is not None
@@ -408,12 +466,17 @@ class MicroBatcher:
 
     def _retry_after_s_locked(self) -> float:
         """Estimated seconds until the current backlog admits a retry:
-        ``(1 + ceil(queued_rows / max_batch)) · max_wait_s`` — the queue
-        drains at worst one ``max_batch`` batch per coalescing window, and
-        the retry itself waits one more window.  Floored at 1 ms so a
-        zero-wait batcher still emits a positive hint."""
+        ``(1 + ceil(ceil(queued_rows / max_batch) / lanes)) · max_wait_s``
+        — the queue drains at worst one ``max_batch`` batch *per lane* per
+        coalescing window, and the retry itself waits one more window.
+        Every term is read LIVE at shed time (round 18): after the
+        autoscale controller retunes ``max_wait_ms`` or the lane count,
+        the next shed's Retry-After describes the batcher as it now runs,
+        not as it was built.  Floored at 1 ms so a zero-wait batcher
+        still emits a positive hint."""
         batches = -(-self._queued_rows // self.max_batch)
-        return (1 + batches) * max(self._max_wait_s, 1e-3)
+        windows = -(-batches // max(self.lanes, 1))
+        return (1 + windows) * max(self._max_wait_s, 1e-3)
 
     def _quota_for(self, tenant: Optional[str]) -> Optional[int]:
         if tenant is None or not self._quotas:
@@ -478,27 +541,129 @@ class MicroBatcher:
     # worker side
 
     def start(self) -> None:
-        if not self._threads:
-            for lane in range(self.lanes):
+        with self._cond:
+            self._started = True
+            target = self.lanes
+        self._spawn_lanes(target)
+
+    def _spawn_lanes(self, target: int) -> None:
+        """Ensure a live worker thread exists for every lane id below
+        ``target`` (idempotent; called outside the condition lock — thread
+        starts must not run under it)."""
+        for lane in range(target):
+            t = self._lane_threads.get(lane)
+            if t is None or not t.is_alive():
                 t = threading.Thread(
                     target=self._loop, args=(lane,),
                     name=f"microbatcher-l{lane}", daemon=True,
                 )
+                self._lane_threads[lane] = t
                 self._threads.append(t)
                 t.start()
 
-    def _collect(self) -> Optional[List[_Chunk]]:
+    def set_lanes(self, lanes: int) -> int:
+        """Retune the dispatch-lane count LIVE (round 18, the autoscale
+        controller's seam).  Growing spawns workers for the missing lane
+        ids; shrinking retires the highest lanes — each retiring worker
+        finishes its in-flight batch, re-checks the target, and exits
+        (never mid-dispatch, never holding queued work: the surviving
+        lanes drain the shared queue).  Lock-safe against concurrent
+        submits and collects; per-lane metric lists grow monotonically so
+        a retired lane's counters stay visible.  Returns the previous
+        target."""
+        lanes = int(lanes)
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        with self._cond:
+            old = self.lanes
+            self.lanes = lanes
+            while len(self._lane_batches) < lanes:
+                self._lane_batches.append(0)
+                self._lane_requests.append(0)
+                self._lane_rows.append(0)
+            started = self._started
+            # wake every parked worker: retiring lanes must notice the
+            # shrunken target instead of sleeping in _collect forever
+            self._cond.notify_all()
+        self._m_lanes.set(lanes, batcher=self.metrics_instance)
+        if started:
+            self._spawn_lanes(lanes)
+        return old
+
+    @property
+    def max_wait_ms(self) -> float:
+        """The live coalescing window (milliseconds)."""
+        return self._max_wait_s * 1e3
+
+    def set_max_wait_ms(self, max_wait_ms: float) -> float:
+        """Retune the coalescing window LIVE.  Collectors re-derive their
+        flush deadline from the live window on every wakeup, so a retune
+        takes effect for batches already coalescing, and
+        :class:`Overloaded` drain estimates computed after it are honest
+        about the new window.  Returns the previous window (ms)."""
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        with self._cond:
+            old = self._max_wait_s * 1e3
+            self._max_wait_s = float(max_wait_ms) / 1e3
+            self._cond.notify_all()
+        self._m_max_wait.set(float(max_wait_ms),
+                             batcher=self.metrics_instance)
+        return old
+
+    def queued_rows(self) -> int:
+        """Rows queued and not yet collected into a batch (the controller's
+        cheap backlog probe — no full :meth:`stats` snapshot)."""
+        with self._cond:
+            return self._queued_rows
+
+    @property
+    def quota_mode(self) -> str:
+        """``'overflow'`` (quotas bite only when the queue fills — the
+        round-14 default) or ``'admission'`` (over-quota tenants refused
+        at submit time)."""
+        return self._quota_mode
+
+    def set_quota_mode(self, mode: str) -> str:
+        """Switch quota enforcement LIVE (round 18).  The autoscale
+        controller runs ``'admission'`` exactly while quotas are
+        tightened under overload — a flooding tenant then cannot occupy
+        queue rows that bound every other tenant's delay — and restores
+        ``'overflow'`` with the base quotas.  Returns the previous mode."""
+        if mode not in ("overflow", "admission"):
+            raise ValueError(
+                f"quota mode must be 'overflow' or 'admission', got {mode!r}")
+        with self._cond:
+            old = self._quota_mode
+            self._quota_mode = mode
+        return old
+
+    def _collect(self, lane: int = 0) -> Optional[List[_Chunk]]:
         """Block until a batch is ready (max_batch reached, max_wait expired,
-        or draining); None once closed and drained."""
+        or draining); None once closed and drained — or once this lane's id
+        is at or past the live lane target (retirement, ``set_lanes``)."""
         with self._cond:
             while True:
-                while not self._queue and self._open:
+                while (not self._queue and self._open
+                       and lane < self.lanes):
                     self._wait(self._cond, None)
+                if lane >= self.lanes:
+                    # retired by set_lanes (the queue, if any, belongs to
+                    # the surviving lanes).  Deregister NOW, under the
+                    # lock: a shrink-then-regrow racing this thread's
+                    # actual exit would otherwise see it still alive and
+                    # skip respawning the lane — a silently dead lane id
+                    # below the live target
+                    if self._lane_threads.get(lane) is threading.current_thread():
+                        del self._lane_threads[lane]
+                    return None
                 if not self._queue:
                     return None  # closed and drained
-                deadline = self._queue[0].req.enqueued + self._max_wait_s
+                # the deadline reads the LIVE window each pass so a
+                # set_max_wait_ms retune applies to batches mid-coalesce
                 while self._open and self._queue and self._queued_rows < self.max_batch:
-                    remaining = deadline - self._clock()
+                    remaining = (self._queue[0].req.enqueued
+                                 + self._max_wait_s) - self._clock()
                     if remaining <= 0:
                         break
                     self._wait(self._cond, remaining)
@@ -691,7 +856,7 @@ class MicroBatcher:
 
     def _loop(self, lane: int = 0) -> None:
         while True:
-            batch = self._collect()
+            batch = self._collect(lane)
             if batch is None:
                 return
             self._run_batch(batch, lane)

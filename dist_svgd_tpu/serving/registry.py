@@ -386,6 +386,13 @@ class ModelRegistry:
             tenant.quota_rows = quota_rows
             self._quotas[name] = quota_rows
 
+    def quota_snapshot(self) -> Dict[str, Optional[int]]:
+        """The current per-tenant quota mapping (a copy — the live view
+        the batcher reads is internal).  The autoscale controller
+        snapshots base quotas from here before tightening them."""
+        with self._lock:
+            return dict(self._quotas)
+
     def tenant(self, name: str) -> Tenant:
         with self._lock:
             tenant = self._tenants.get(name)

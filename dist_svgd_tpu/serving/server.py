@@ -23,7 +23,11 @@ JSON in/out:
 - ``GET  /slo``          — the declarative SLO engine's evaluation
   (``telemetry/slo.py``): burn rates for the serve-p99 / shed-rate /
   dispatch-error objectives over the window since the last ``/slo`` poll,
-  ``status`` ``ok``/``breach`` at the top.
+  ``status`` ``ok``/``breach`` at the top;
+- ``GET  /autoscale``    — the adaptive-capacity controller's status
+  (``serving/autoscale.py``: live lanes / coalescing window / quota
+  scale, bounds, streaks, recent decisions); 404 when the server runs
+  without a controller.
 
 No framework dependency by design: the container bakes only the jax_graft
 toolchain, and the request path is one ``json.loads`` + a batcher future —
@@ -83,6 +87,7 @@ class PredictionServer:
         registry: Optional[_metrics.MetricsRegistry] = None,
         slo=None,
         slo_p99_ms: float = 100.0,
+        autoscale=None,
     ):
         if isinstance(engine, ModelRegistry):
             self.model_registry: Optional[ModelRegistry] = engine
@@ -129,6 +134,27 @@ class PredictionServer:
         #: The declarative SLO engine served at ``/slo`` (pass ``slo=`` to
         #: replace the default serve-p99/shed/error objective set).
         self.slo_engine = slo
+        #: Optional :class:`~dist_svgd_tpu.serving.autoscale.
+        #: AutoscaleController` (round 18).  ``autoscale=True`` builds the
+        #: default controller over this server's batcher (+ registry
+        #: quotas in multi-tenant mode); a controller instance is used
+        #: as-is.  The server starts it with :meth:`start` (unless it
+        #: already runs) and stops it on :meth:`shutdown`; its status is
+        #: served at ``/autoscale``.
+        self.autoscale = None
+        if autoscale:
+            if autoscale is True:
+                from dist_svgd_tpu.serving.autoscale import (
+                    AutoscaleController,
+                    AutoscalePolicy,
+                )
+
+                autoscale = AutoscaleController(
+                    self.batcher, metrics=self.registry,
+                    model_registry=self.model_registry,
+                    policy=AutoscalePolicy(p99_target_ms=slo_p99_ms),
+                )
+            self.autoscale = autoscale
         self._started = time.time()
 
         server = self  # close over for the handler class
@@ -200,6 +226,12 @@ class PredictionServer:
                     self._reply(200, server.metrics())
                 elif path == "/slo":
                     self._reply(200, server.slo_engine.evaluate())
+                elif path == "/autoscale":
+                    if server.autoscale is None:
+                        self._reply(404, {"error": "no autoscale "
+                                          "controller on this server"})
+                    else:
+                        self._reply(200, server.autoscale.status())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -428,6 +460,8 @@ class PredictionServer:
                 target=self._httpd.serve_forever, name="http-serve", daemon=True
             )
             self._serve_thread.start()
+        if self.autoscale is not None and self.autoscale._thread is None:
+            self.autoscale.start()
         return self
 
     def serve_forever(self) -> None:
@@ -454,6 +488,10 @@ class PredictionServer:
         flush the batcher queue (and, in registry mode, stop the
         checkpoint scanner and close the registry)."""
         self.begin_drain()
+        if self.autoscale is not None:
+            # stop retuning first: a controller acting on a draining
+            # batcher would race the close below
+            self.autoscale.stop()
         self._httpd.shutdown()
         self._httpd.server_close()  # joins non-daemon handler threads
         if self._serve_thread is not None:
@@ -515,6 +553,16 @@ def main(argv=None):
                          "request/response stay f32)")
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--max-queue-rows", type=int, default=8192)
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the SLO-burn-driven capacity controller "
+                         "(serving/autoscale.py): retunes batcher lanes, "
+                         "the coalescing window, and tenant quotas live; "
+                         "status at /autoscale")
+    ap.add_argument("--autoscale-lanes-max", type=int, default=4)
+    ap.add_argument("--autoscale-wait-max-ms", type=float, default=16.0)
+    ap.add_argument("--autoscale-p99-ms", type=float, default=100.0,
+                    help="the latency objective the controller defends")
+    ap.add_argument("--autoscale-interval-s", type=float, default=0.25)
     ap.add_argument("--request-log", default=None,
                     help="JSONL per-request record path (utils/metrics.py)")
     ap.add_argument("--trace-export", default=None, metavar="PATH",
@@ -581,6 +629,21 @@ def main(argv=None):
         tracer.set_process(
             "replica",
             args.replica_name or f"{args.host}:{args.port}")
+    if args.autoscale:
+        from dist_svgd_tpu.serving.autoscale import (
+            AutoscaleController,
+            AutoscalePolicy,
+        )
+
+        srv.autoscale = AutoscaleController(
+            srv.batcher, metrics=srv.registry,
+            model_registry=srv.model_registry,
+            policy=AutoscalePolicy(
+                lanes_max=args.autoscale_lanes_max,
+                max_wait_ms_max=args.autoscale_wait_max_ms,
+                p99_target_ms=args.autoscale_p99_ms,
+            ),
+        ).start(args.autoscale_interval_s)
     print(json.dumps({"serving": srv.url, **srv.health()}), flush=True)
     try:
         srv.serve_forever()

@@ -44,6 +44,10 @@ __all__ = [
     "GaugeCeiling",
     "StalenessObjective",
     "SloEngine",
+    "HistogramWindow",
+    "CounterWindow",
+    "bucket_frac_over",
+    "bucket_quantile",
     "default_serving_slos",
     "default_training_slos",
 ]
@@ -113,6 +117,128 @@ def _count_delta(registry: MetricsRegistry, name: str, labels: dict,
     return max(now - before, 0.0)
 
 
+def bucket_frac_over(bounds, counts, threshold: float) -> float:
+    """Fraction of a bucketed distribution's observations OVER ``threshold``:
+    whole buckets below it count as under, plus a linear share of the
+    bucket the threshold lands in (the same within-bucket interpolation
+    ``Histogram.quantile`` uses); the overflow bucket is entirely over any
+    finite threshold.  ``counts`` has ``len(bounds) + 1`` entries."""
+    total = sum(counts)
+    if not total:
+        return 0.0
+    under = 0.0
+    lo = 0.0
+    for i, hi in enumerate(bounds):
+        c = counts[i]
+        if hi <= threshold:
+            under += c
+        elif lo < threshold:
+            under += c * (threshold - lo) / (hi - lo)
+        lo = hi
+    return max(0.0, 1.0 - under / total)
+
+
+def bucket_quantile(bounds, counts, q: float) -> float:
+    """Interpolated ``q``-quantile of a bucketed distribution (the
+    windowed-counts counterpart of ``Histogram.quantile``, which only
+    reads cumulative series).  Overflow-bucket hits clamp to the last
+    finite bound."""
+    total = sum(counts)
+    if not total:
+        return 0.0
+    target = q * total
+    seen = 0.0
+    lo = 0.0
+    for i, hi in enumerate(bounds):
+        c = counts[i]
+        if seen + c >= target and c > 0:
+            return lo + (hi - lo) * (target - seen) / c
+        seen += c
+        lo = hi
+    return lo  # landed in the overflow bucket
+
+
+class HistogramWindow:
+    """Stateful windowed accessor over one histogram series (round 18) —
+    the :mod:`~dist_svgd_tpu.serving.autoscale` controller's view of the
+    latency/queue-wait distributions *since its previous control step*,
+    with the same delta discipline the SLO objectives use but **its own
+    window state**: a controller polling at its own cadence must not
+    advance (and thereby starve) the ``/slo`` endpoint's objective
+    windows.
+
+    :meth:`poll` returns ``{count, frac_over(threshold_s), p99_s, ...}``
+    for the observations since the previous poll (cumulative on the
+    first); a reset (fresh registry, restarted process) clamps to an
+    empty window instead of going negative — the ``dump_delta``
+    discipline."""
+
+    def __init__(self, registry: MetricsRegistry, name: str,
+                 labels: Optional[dict] = None, aggregate: bool = False):
+        self.registry = registry
+        self.name = name
+        self.labels = dict(labels or {})
+        self.aggregate = bool(aggregate)
+        self._prev: Optional[List[int]] = None
+
+    def _current(self) -> Optional[List[int]]:
+        metric = self.registry._metrics.get(self.name)
+        if not isinstance(metric, Histogram):
+            return None
+        if not self.aggregate:
+            series = metric._snapshot(self.labels)
+            return list(series.counts) if series is not None else None
+        totals: Optional[List[int]] = None
+        for ls in _aggregate_label_sets(metric):
+            series = metric._snapshot(ls)
+            if series is None:
+                continue
+            if totals is None:
+                totals = list(series.counts)
+            else:
+                totals = [a + b for a, b in zip(totals, series.counts)]
+        return totals
+
+    def poll(self, threshold_s: Optional[float] = None) -> Dict:
+        metric = self.registry._metrics.get(self.name)
+        counts = self._current()
+        prev, self._prev = self._prev, counts
+        if counts is None or not isinstance(metric, Histogram):
+            return {"count": 0, "frac_over": 0.0, "p50_s": 0.0, "p99_s": 0.0}
+        if prev is not None and len(prev) == len(counts):
+            window = [max(c - p, 0) for c, p in zip(counts, prev)]
+        else:
+            window = counts
+        bounds = metric.buckets
+        out = {
+            "count": sum(window),
+            "p50_s": bucket_quantile(bounds, window, 0.50),
+            "p99_s": bucket_quantile(bounds, window, 0.99),
+            "frac_over": (bucket_frac_over(bounds, window, threshold_s)
+                          if threshold_s is not None else 0.0),
+        }
+        return out
+
+
+class CounterWindow:
+    """Stateful windowed delta of one counter series (sums across label
+    sets with ``aggregate=True`` — minus the federation ``replica``
+    identity); resets clamp to zero like every other window here."""
+
+    def __init__(self, registry: MetricsRegistry, name: str,
+                 labels: Optional[dict] = None, aggregate: bool = False):
+        self.registry = registry
+        self.name = name
+        self.labels = dict(labels or {})
+        self.aggregate = bool(aggregate)
+        self._prev: Dict[str, float] = {}
+
+    def poll(self) -> float:
+        delta = _count_delta(self.registry, self.name, self.labels,
+                             self._prev, "v", aggregate=self.aggregate)
+        return float(delta) if delta is not None else 0.0
+
+
 class LatencyObjective(_Objective):
     """``target`` fraction of observations must land at or under
     ``threshold_s``, judged per evaluation window.
@@ -177,20 +303,10 @@ class LatencyObjective(_Objective):
             row.update(status=NO_DATA, burn_rate=0.0, window_count=0)
             return row
         # observations at or under the threshold: whole buckets below it
-        # plus a linear share of the bucket the threshold lands in (the
-        # same within-bucket interpolation Histogram.quantile uses)
-        bounds = metric.buckets
-        under = 0.0
-        lo = 0.0
-        for i, hi in enumerate(bounds):
-            c = counts[i]
-            if hi <= self.threshold_s:
-                under += c
-            elif lo < self.threshold_s:
-                under += c * (self.threshold_s - lo) / (hi - lo)
-            lo = hi
-        # the overflow bucket is entirely over any finite threshold
-        frac_over = max(0.0, 1.0 - under / total)
+        # plus a linear share of the bucket the threshold lands in
+        # (bucket_frac_over — shared with the autoscale HistogramWindow)
+        frac_over = bucket_frac_over(metric.buckets, counts,
+                                     self.threshold_s)
         budget = 1.0 - self.target
         burn = frac_over / budget
         row.update(
@@ -324,11 +440,20 @@ class SloEngine:
     with zero traffic is healthy, not failing).  Verdicts are mirrored
     into the registry: ``svgd_slo_burn_rate{slo=name}`` gauges and
     ``svgd_slo_breaches_total{slo=name}`` counters.
+
+    ``mirror_metrics=False`` (round 18) evaluates without writing the
+    verdict series — for a SECOND engine over the same registry (the
+    autoscale controller runs its own objective windows at its own
+    cadence) whose verdicts must not clobber the ``/slo`` endpoint's
+    gauges or double-count its breach counters.  :attr:`last` keeps the
+    most recent evaluation document and :meth:`burn_rates` exposes its
+    per-objective burn numbers — the controller-facing accessors.
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  objectives: Sequence[_Objective] = (),
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 mirror_metrics: bool = True):
         import threading
 
         self.registry = (registry if registry is not None
@@ -338,15 +463,20 @@ class SloEngine:
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate objective names: {names}")
         self._clock = clock
+        self.mirror_metrics = bool(mirror_metrics)
+        #: The most recent :meth:`evaluate` document (None before the
+        #: first) — readable without advancing any objective window.
+        self.last: Optional[Dict] = None
         # the objectives' window snapshots are stateful: concurrent
         # evaluations (two scrapers on /slo — ThreadingHTTPServer runs one
         # thread per request) would double-judge one window and starve the
         # next; one engine lock serialises them
         self._lock = threading.Lock()
-        self._m_burn = self.registry.gauge(
-            "svgd_slo_burn_rate", "error-budget burn rate per objective")
-        self._m_breaches = self.registry.counter(
-            "svgd_slo_breaches_total", "SLO evaluations that breached")
+        if self.mirror_metrics:
+            self._m_burn = self.registry.gauge(
+                "svgd_slo_burn_rate", "error-budget burn rate per objective")
+            self._m_breaches = self.registry.counter(
+                "svgd_slo_breaches_total", "SLO evaluations that breached")
 
     def evaluate(self) -> Dict:
         with self._lock:
@@ -357,12 +487,27 @@ class SloEngine:
                 row = obj.evaluate(self.registry, now)
                 rows[obj.name] = row
                 burn = row.get("burn_rate", 0.0)
-                if isinstance(burn, (int, float)) and burn != float("inf"):
+                if (self.mirror_metrics
+                        and isinstance(burn, (int, float))
+                        and burn != float("inf")):
                     self._m_burn.set(burn, slo=obj.name)
                 if row["status"] == BREACH:
                     worst = BREACH
-                    self._m_breaches.inc(slo=obj.name)
-        return {"status": worst, "ts": round(now, 3), "objectives": rows}
+                    if self.mirror_metrics:
+                        self._m_breaches.inc(slo=obj.name)
+            doc = {"status": worst, "ts": round(now, 3), "objectives": rows}
+            self.last = doc
+        return doc
+
+    def burn_rates(self) -> Dict[str, Optional[float]]:
+        """Per-objective burn rates of the most recent evaluation (empty
+        before the first) — ``None`` marks an unbounded ratio (bad events
+        over a zero base), which callers must treat as the worst case,
+        not as zero."""
+        if self.last is None:
+            return {}
+        return {name: row.get("burn_rate")
+                for name, row in self.last["objectives"].items()}
 
 
 def default_serving_slos(registry: MetricsRegistry, *,
@@ -370,6 +515,7 @@ def default_serving_slos(registry: MetricsRegistry, *,
                          shed_budget: float = 0.01,
                          error_budget: float = 0.01,
                          aggregate: bool = False,
+                         mirror_metrics: bool = True,
                          clock: Callable[[], float] = time.time) -> SloEngine:
     """The serving server's standard objective set: request p99 under
     ``p99_ms``, sheds under ``shed_budget`` per resolved request, and
@@ -388,7 +534,7 @@ def default_serving_slos(registry: MetricsRegistry, *,
         RatioObjective("dispatch_errors", "svgd_serve_dispatch_errors_total",
                        "svgd_serve_batches_total", error_budget,
                        aggregate=aggregate),
-    ], clock=clock)
+    ], clock=clock, mirror_metrics=mirror_metrics)
 
 
 def default_training_slos(registry: MetricsRegistry, *,

@@ -508,3 +508,182 @@ def test_approx_row_ok_gates():
     ):
         ok, why = large_n.approx_row_ok(bad)
         assert not ok and any(frag in w for w in why), (bad, why)
+
+
+# --------------------------------------------------------------------- #
+# per-step RFF bank re-draw (round 18): rff_redraw='step'
+
+
+def test_rff_redraw_validation_and_identity():
+    spec = KernelApprox("rff", num_features=64, rff_redraw="step")
+    assert spec.rff_redraw == "step"
+    assert spec.with_key(approx_bank_key(0)).rff_redraw == "step"
+    # the bank lifetime is part of the compile-cache identity
+    run_spec = KernelApprox("rff", num_features=64)
+    assert spec.cache_token() != run_spec.cache_token()
+    with pytest.raises(ValueError):
+        KernelApprox("rff", rff_redraw="epoch")
+    with pytest.raises(ValueError):
+        KernelApprox("nystrom", rff_redraw="step")
+
+
+def test_rff_step_phi_needs_bound_index():
+    from dist_svgd_tpu.ops.approx import bind_phi_step
+
+    spec = KernelApprox("rff", num_features=128,
+                        rff_redraw="step").with_key(approx_bank_key(0))
+    fn = make_approx_phi_fn(RBF(2.0), spec)
+    assert fn.needs_step
+    x, s, _ = error_pin_probe(64, D)
+    with pytest.raises(ValueError, match="bind_phi_step"):
+        fn(x, x, s)
+    out0 = bind_phi_step(fn, 0)(x, x, s)
+    out0b = bind_phi_step(fn, 0)(x, x, s)
+    out1 = bind_phi_step(fn, 1)(x, x, s)
+    assert np.array_equal(np.asarray(out0), np.asarray(out0b))
+    assert not np.array_equal(np.asarray(out0), np.asarray(out1))
+    # every step's fresh bank stays inside the declared budget
+    exact = phi_exact(x, x, s, RBF(2.0))
+    budget = default_error_budget(spec, D)
+    for t in (0, 1, 7):
+        err = phi_rel_error(exact, bind_phi_step(fn, t)(x, x, s))
+        assert err <= budget
+    # bind_phi_step is a no-op passthrough for step-free backends
+    run_fn = make_approx_phi_fn(
+        RBF(2.0), KernelApprox("rff", num_features=128,
+                               key=approx_bank_key(0)))
+    assert bind_phi_step(run_fn, 3) is run_fn
+
+
+def test_median_step_rff_refusal_lifted_only_for_step_redraw():
+    """The PR-12 one-line refusal stands at rff_redraw='run'; 'step'
+    composes (the follow-up that PR named)."""
+    from dist_svgd_tpu.ops.kernels import AdaptiveRBF
+
+    with pytest.raises(ValueError, match="rff_redraw"):
+        resolve_phi_fn(AdaptiveRBF(), "xla", 1,
+                       KernelApprox("rff", key=approx_bank_key(0)))
+    fn = resolve_phi_fn(
+        AdaptiveRBF(), "xla", 1,
+        KernelApprox("rff", num_features=64,
+                     rff_redraw="step").with_key(approx_bank_key(0)))
+    assert fn.needs_step
+
+
+def test_median_step_rff_step_runs_and_is_deterministic():
+    spec = KernelApprox("rff", num_features=64, rff_redraw="step")
+    s1 = dt.Sampler(D, gmm_logp, kernel="median_step", phi_impl="xla",
+                    kernel_approx=spec)
+    f1, _ = s1.run(64, 5, 1e-2, seed=0, record=False)
+    s2 = dt.Sampler(D, gmm_logp, kernel="median_step", phi_impl="xla",
+                    kernel_approx=KernelApprox("rff", num_features=64,
+                                               rff_redraw="step"))
+    f2, _ = s2.run(64, 5, 1e-2, seed=0, record=False)
+    assert np.array_equal(np.asarray(f1), np.asarray(f2))
+    assert np.isfinite(np.asarray(f1)).all()
+
+
+def test_step_redraw_differs_from_run_bank_and_segments_compose():
+    """A re-drawn bank changes the trajectory vs the frozen bank, and a
+    segmented drive (step_offset) folds the identical (bank_root, t)
+    stream as the monolithic run — bitwise."""
+    step_spec = KernelApprox("rff", num_features=64, rff_redraw="step")
+    run_spec = KernelApprox("rff", num_features=64)
+    fa, _ = dt.Sampler(D, gmm_logp, kernel=RBF(2.0), phi_impl="xla",
+                       kernel_approx=run_spec).run(64, 5, 1e-2, seed=0,
+                                                   record=False)
+    fb, _ = dt.Sampler(D, gmm_logp, kernel=RBF(2.0), phi_impl="xla",
+                       kernel_approx=step_spec).run(64, 5, 1e-2, seed=0,
+                                                    record=False)
+    assert not np.array_equal(np.asarray(fa), np.asarray(fb))
+    mono, _ = dt.Sampler(D, gmm_logp, kernel=RBF(2.0), phi_impl="xla",
+                         kernel_approx=step_spec).run(64, 6, 1e-2, seed=0,
+                                                      record=False)
+    seg = dt.Sampler(D, gmm_logp, kernel=RBF(2.0), phi_impl="xla",
+                     kernel_approx=step_spec)
+    p1, _ = seg.run(64, 3, 1e-2, seed=0, record=False)
+    p2, _ = seg.run(64, 3, 1e-2, seed=0, record=False,
+                    initial_particles=p1, step_offset=3)
+    assert np.array_equal(np.asarray(mono), np.asarray(p2))
+
+
+def test_step_redraw_distsampler_ring_gather_and_shard_invariance():
+    """median_step × per-step-redraw RFF across the exchange seams:
+    ring ≡ gather and 1-vs-4-shard bitwise invariance under the vmap
+    emulation (``mesh=None`` — the legacy-XLA median_step+ring shard_map
+    gate is orthogonal to the redraw and stays refused)."""
+    spec = lambda: KernelApprox("rff", num_features=64, rff_redraw="step")
+    p0 = init_particles(0, N, D)
+    pg = make_dist(4, p0=p0, mesh=None, kernel="median_step",
+                   phi_impl="xla", exchange_impl="gather",
+                   kernel_approx=spec()).run_steps(4, 1e-2)
+    pr = make_dist(4, p0=p0, mesh=None, kernel="median_step",
+                   phi_impl="xla", exchange_impl="ring",
+                   kernel_approx=spec()).run_steps(4, 1e-2)
+    assert np.allclose(np.asarray(pg), np.asarray(pr), atol=1e-5)
+    p1 = make_dist(1, p0=p0, mesh=None, kernel="median_step",
+                   phi_impl="xla", exchange_impl="gather",
+                   kernel_approx=spec()).run_steps(4, 1e-2)
+    assert np.array_equal(
+        np.asarray(p1).reshape(N, D),
+        np.asarray(pg).reshape(N, D))  # bitwise shard invariance
+
+
+def test_step_redraw_rides_state_dict_and_mismatch_refused():
+    spec = KernelApprox("rff", num_features=64, rff_redraw="step")
+    d = make_dist(2, kernel=RBF(2.0), phi_impl="xla", kernel_approx=spec)
+    d.run_steps(2, 1e-2)
+    state = d.state_dict()
+    assert int(np.asarray(state["approx_rff_redraw"])) == 1
+    d2 = make_dist(2, kernel=RBF(2.0), phi_impl="xla",
+                   kernel_approx=KernelApprox("rff", num_features=64,
+                                              rff_redraw="step"))
+    d2.load_state_dict(state)
+    d2.run_steps(1, 1e-2)
+    mismatch = make_dist(2, kernel=RBF(2.0), phi_impl="xla",
+                         kernel_approx=KernelApprox("rff", num_features=64))
+    with pytest.raises(ValueError, match="rff_redraw"):
+        mismatch.load_state_dict(state)
+    # a pre-redraw checkpoint (field absent) restores as 'run' — and is
+    # refused by a 'step' sampler
+    legacy = {k: v for k, v in state.items() if k != "approx_rff_redraw"}
+    run_sampler = make_dist(2, kernel=RBF(2.0), phi_impl="xla",
+                            kernel_approx=KernelApprox("rff",
+                                                       num_features=64))
+    run_sampler.load_state_dict(legacy)
+    step_sampler = make_dist(2, kernel=RBF(2.0), phi_impl="xla",
+                             kernel_approx=KernelApprox(
+                                 "rff", num_features=64,
+                                 rff_redraw="step"))
+    with pytest.raises(ValueError, match="rff_redraw"):
+        step_sampler.load_state_dict(legacy)
+
+
+def test_step_redraw_chunked_ring_hops_and_all_scores_refusal():
+    spec = KernelApprox("rff", num_features=64, rff_redraw="step")
+    p0 = init_particles(0, N, D)
+    mono = make_dist(2, p0=p0, kernel=RBF(2.0), phi_impl="xla",
+                     exchange_impl="ring", kernel_approx=spec
+                     ).run_steps(2, 1e-2)
+    chunked = make_dist(2, p0=p0, kernel=RBF(2.0), phi_impl="xla",
+                        exchange_impl="ring", kernel_approx=spec
+                        ).run_steps(2, 1e-2, hops_per_dispatch=1)
+    assert np.array_equal(np.asarray(mono), np.asarray(chunked))
+    from dist_svgd_tpu.parallel.exchange import make_chunked_ring_step_fns
+
+    with pytest.raises(ValueError, match="rff_redraw"):
+        make_chunked_ring_step_fns(
+            dist_logp, RBF(2.0), "all_scores", 2, 0, 1.0,
+            phi_impl="xla",
+            kernel_approx=spec.with_key(approx_bank_key(0)))
+
+
+def test_step_redraw_residual_report_probes_folded_bank():
+    spec = KernelApprox("rff", num_features=256,
+                        rff_redraw="step").with_key(approx_bank_key(0))
+    x, s, kernel = error_pin_probe(96, D)
+    r0 = phi_residual_report(x, s, kernel, spec, step=0)
+    r5 = phi_residual_report(x, s, kernel, spec, step=5)
+    assert r0["phi_approx_rel_err"] != r5["phi_approx_rel_err"]
+    assert r0["phi_approx_within_budget"] == 1.0
+    assert r5["phi_approx_within_budget"] == 1.0

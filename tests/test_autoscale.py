@@ -1,0 +1,503 @@
+"""Adaptive capacity (round 18): the batcher's live-retune seams
+(``set_lanes`` / ``set_max_wait_ms`` / quota modes), the SLO window
+accessors, and the ``serving/autoscale.py`` controller — every decision
+path driven through the injectable clock, no real waiting beyond worker
+scheduling.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dist_svgd_tpu.serving import (
+    AutoscaleController,
+    AutoscalePolicy,
+    MicroBatcher,
+    ModelRegistry,
+    Overloaded,
+    PredictionServer,
+    PredictiveEngine,
+)
+from dist_svgd_tpu.telemetry import metrics as _metrics
+from dist_svgd_tpu.telemetry.slo import (
+    CounterWindow,
+    HistogramWindow,
+    bucket_frac_over,
+    bucket_quantile,
+    default_serving_slos,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _echo(x):
+    return {"y": np.asarray(x).sum(axis=1, keepdims=True)}
+
+
+def _slow_echo(delay_s):
+    def dispatch(x):
+        time.sleep(delay_s)
+        return _echo(x)
+
+    return dispatch
+
+
+# --------------------------------------------------------------------- #
+# batcher live-retune seams
+
+
+def test_set_lanes_grows_and_retires_under_load():
+    """set_lanes spawns workers live; shrinking retires the high lanes
+    (their threads exit) while requests keep resolving; regrowing
+    respawns fresh threads for the same lane ids."""
+    reg = _metrics.MetricsRegistry()
+    b = MicroBatcher(_slow_echo(0.002), max_batch=8, max_wait_ms=1.0,
+                     max_queue_rows=128, registry=reg)
+    stop, errs = [False], []
+
+    def pound():
+        while not stop[0]:
+            try:
+                b.submit(np.ones((2, 3), np.float32)).result(timeout=10)
+            except Exception as e:  # pragma: no cover - diagnostic
+                errs.append(e)
+                return
+
+    threads = [threading.Thread(target=pound) for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.05)
+        assert b.set_lanes(3) == 1
+        time.sleep(0.15)
+        st = b.stats()
+        assert st["lanes"] == 3
+        assert sum(1 for v in st["lane_batches"].values() if v > 0) >= 2
+        b.set_lanes(1)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            alive = [ln for ln, t in b._lane_threads.items() if t.is_alive()]
+            if alive == [0]:
+                break
+            time.sleep(0.01)
+        assert alive == [0]
+        # still serving after retirement
+        b.submit(np.ones((2, 3), np.float32)).result(timeout=5)
+        b.set_lanes(2)
+        time.sleep(0.1)
+        alive = sorted(ln for ln, t in b._lane_threads.items()
+                       if t.is_alive())
+        assert alive == [0, 1]
+    finally:
+        stop[0] = True
+        for t in threads:
+            t.join(timeout=5)
+        b.close(drain=True)
+    assert not errs
+    assert reg.gauge("svgd_serve_lanes").value(
+        batcher=b.metrics_instance) == 2
+    with pytest.raises(ValueError):
+        b.set_lanes(0)
+
+
+def test_set_max_wait_live_and_gauge():
+    b = MicroBatcher(_echo, max_batch=8, max_wait_ms=4.0,
+                     max_queue_rows=64, registry=_metrics.MetricsRegistry(),
+                     autostart=False)
+    assert b.max_wait_ms == 4.0
+    assert b.set_max_wait_ms(1.0) == 4.0
+    assert b.max_wait_ms == 1.0
+    assert b.registry.gauge("svgd_serve_max_wait_ms").value(
+        batcher=b.metrics_instance) == 1.0
+    with pytest.raises(ValueError):
+        b.set_max_wait_ms(-1.0)
+    b.start()
+    b.close(drain=True)
+
+
+def test_retry_after_reads_live_knobs_at_shed_time():
+    """Round-18 regression pin: the Overloaded drain estimate must
+    describe the batcher as it runs NOW — after a set_max_wait_ms or
+    set_lanes retune, the next shed's Retry-After reflects the live
+    window, queue depth, and lane count (a stale construction-time hint
+    would mis-steer every backpressure-honoring client)."""
+    b = MicroBatcher(_echo, max_batch=4, max_wait_ms=10.0,
+                     max_queue_rows=8, autostart=False,
+                     registry=_metrics.MetricsRegistry())
+    b.submit(np.zeros((8, 3), np.float32))  # fill: workers never started
+    with pytest.raises(Overloaded) as ei:
+        b.submit(np.zeros((1, 3), np.float32))
+    # 8 rows = 2 batches, 1 lane -> (1 + 2) * 10 ms (the round-15 pin)
+    assert ei.value.retry_after_s == pytest.approx(0.030)
+    b.set_max_wait_ms(2.0)
+    with pytest.raises(Overloaded) as ei:
+        b.submit(np.zeros((1, 3), np.float32))
+    assert ei.value.retry_after_s == pytest.approx(0.006)  # live window
+    b.set_lanes(2)  # not started: no threads spawn, but the estimate
+    # honors the lane target (2 batches drain in 1 window across 2 lanes)
+    with pytest.raises(Overloaded) as ei:
+        b.submit(np.zeros((1, 3), np.float32))
+    assert ei.value.retry_after_s == pytest.approx(0.004)
+    assert not any(t.is_alive() for t in b._lane_threads.values())
+    b.start()
+    b.close(drain=True)
+
+
+def test_admission_quota_mode():
+    """'admission' refuses an over-quota tenant at submit time with queue
+    room to spare (counted as a quota shed); 'overflow' (default)
+    admits the same request — the round-14 inert-until-full contract is
+    unchanged until a controller opts in."""
+    quotas = {"hog": 8}
+    b = MicroBatcher(lambda x, tenant=None: _echo(x), max_batch=8,
+                     max_wait_ms=1.0, max_queue_rows=64, quotas=quotas,
+                     autostart=False, registry=_metrics.MetricsRegistry())
+    assert b.quota_mode == "overflow"
+    b.submit(np.zeros((8, 3), np.float32), tenant="hog")  # at quota, queued
+    # overflow mode: queue has room -> over-quota submit still admitted
+    b.submit(np.zeros((4, 3), np.float32), tenant="hog")
+    assert b.tenant_queued_rows("hog") == 12
+    assert b.set_quota_mode("admission") == "overflow"
+    with pytest.raises(Overloaded) as ei:
+        b.submit(np.zeros((1, 3), np.float32), tenant="hog")
+    assert "admission-enforced" in str(ei.value)
+    assert ei.value.retry_after_s > 0
+    # under-quota tenants and tenant-less requests are untouched
+    b.submit(np.zeros((2, 3), np.float32), tenant="polite")
+    b.submit(np.zeros((2, 3), np.float32))
+    assert b.stats()["quota_sheds"]["hog"] == 1
+    with pytest.raises(ValueError):
+        b.set_quota_mode("bogus")
+    b.set_quota_mode("overflow")
+    b.start()
+    b.close(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# SLO window accessors
+
+
+def test_bucket_helpers():
+    bounds = [0.01, 0.1, 1.0]
+    counts = [10, 80, 10, 0]
+    assert bucket_frac_over(bounds, counts, 1.0) == pytest.approx(0.0)
+    assert bucket_frac_over(bounds, counts, 0.01) == pytest.approx(0.9)
+    # interpolated: halfway through the middle bucket
+    assert bucket_frac_over(bounds, counts, 0.055) == pytest.approx(
+        1.0 - (10 + 40) / 100)
+    assert bucket_quantile(bounds, counts, 0.5) == pytest.approx(0.055)
+    assert bucket_frac_over(bounds, [0, 0, 0, 0], 0.5) == 0.0
+    assert bucket_quantile(bounds, [0, 0, 0, 5], 0.99) == pytest.approx(1.0)
+
+
+def test_histogram_and_counter_windows_are_deltas():
+    reg = _metrics.MetricsRegistry()
+    h = reg.histogram("svgd_serve_request_latency_seconds", "t")
+    c = reg.counter("svgd_serve_shed_total", "t")
+    hw = HistogramWindow(reg, "svgd_serve_request_latency_seconds")
+    cw = CounterWindow(reg, "svgd_serve_shed_total")
+    for _ in range(10):
+        h.observe(0.005)
+    c.inc(3)
+    w = hw.poll(threshold_s=0.1)
+    assert w["count"] == 10 and w["frac_over"] == pytest.approx(0.0)
+    assert cw.poll() == 3.0
+    # second poll sees only the delta
+    for _ in range(4):
+        h.observe(0.5)
+    w = hw.poll(threshold_s=0.1)
+    assert w["count"] == 4
+    assert w["frac_over"] == pytest.approx(1.0)
+    assert w["p99_s"] > 0.1
+    assert cw.poll() == 0.0
+    # a controller's windows never disturb the /slo engine's own windows
+    slo = default_serving_slos(reg, p99_ms=100.0)
+    doc = slo.evaluate()
+    assert doc["objectives"]["serve_p99"]["window_count"] == 14
+
+
+def test_slo_engine_mirror_off_and_burn_accessors():
+    reg = _metrics.MetricsRegistry()
+    h = reg.histogram("svgd_serve_request_latency_seconds", "t")
+    for _ in range(20):
+        h.observe(0.5)  # far over the objective
+    mirrored = default_serving_slos(reg, p99_ms=10.0)
+    silent = default_serving_slos(reg, p99_ms=10.0, mirror_metrics=False)
+    assert silent.last is None and silent.burn_rates() == {}
+    d1 = mirrored.evaluate()
+    d2 = silent.evaluate()
+    assert d1["status"] == d2["status"] == "breach"
+    assert silent.last is d2
+    assert silent.burn_rates()["serve_p99"] > 1.0
+    # only the mirroring engine wrote verdict series
+    breaches = reg.counter("svgd_slo_breaches_total")
+    assert breaches.value(slo="serve_p99") == 1.0
+
+
+# --------------------------------------------------------------------- #
+# controller decision paths (injectable clock, explicit step())
+
+
+def _make_controller(policy=None, **kw):
+    reg = _metrics.MetricsRegistry()
+    bat = MicroBatcher(_echo, max_batch=8, max_wait_ms=2.0,
+                       max_queue_rows=100, registry=reg, autostart=False)
+    clock = [0.0]
+    c = AutoscaleController(
+        bat, metrics=reg,
+        policy=policy or AutoscalePolicy(
+            lanes_max=4, max_wait_ms_max=16.0, p99_target_ms=50.0,
+            cooldown_s=1.0, up_consecutive=1, down_consecutive=3),
+        clock=lambda: clock[0], **kw)
+    hist = reg.histogram("svgd_serve_request_latency_seconds", "t")
+    return c, bat, hist, clock
+
+
+def test_scale_up_on_burn_then_bounded():
+    c, bat, hist, clock = _make_controller()
+    for _ in range(50):
+        hist.observe(0.005)
+    r = c.step()
+    assert not r["overload"] and r["actions"] == []
+    # sustained burn scales up one notch per cooldown, to the bounds
+    for i in range(12):
+        clock[0] += 1.1
+        for _ in range(50):
+            hist.observe(0.300)
+        c.step()
+    assert bat.lanes == 4 and bat.max_wait_ms == 16.0  # bounded, no runaway
+    st = c.status()
+    assert st["bounds"] == {"lanes": [1, 4], "max_wait_ms": [2.0, 16.0]}
+    assert st["actions"] >= 2
+    bat.start()
+    bat.close(drain=True)
+
+
+def test_cooldown_blocks_immediate_repeat():
+    c, bat, hist, clock = _make_controller()
+    for _ in range(50):
+        hist.observe(0.300)
+    r = c.step()
+    assert r["overload"] and any("lanes" in a for a in r["actions"])
+    for _ in range(50):
+        hist.observe(0.300)
+    r = c.step()  # same instant: cooldown holds
+    assert r["overload"] and r["actions"] == []
+    bat.start()
+    bat.close(drain=True)
+
+
+def test_hysteresis_no_flap_and_baseline_floor():
+    """Scale-down needs down_consecutive calm windows; an in-between
+    window resets the streak; scale-down stops at the construction
+    baseline, not the absolute minimum."""
+    c, bat, hist, clock = _make_controller()
+    reqs = c.metrics.counter("svgd_serve_requests_total", "t")
+    # drive up to lanes 2 / wait 4
+    reqs.inc(500)
+    for _ in range(50):
+        hist.observe(0.300)
+    clock[0] += 1.1
+    c.step()
+    assert bat.lanes == 2
+    # demand released, quiet: calm windows accumulate the down streak
+    for i in range(2):
+        clock[0] += 1.1
+        reqs.inc(10)
+        for _ in range(5):
+            hist.observe(0.004)
+        r = c.step()
+        assert r["calm"]
+        assert r["actions"] == []  # streak not yet at down_consecutive
+    # a boundary window: demand back near the overload level while burn
+    # sits between the thresholds (2/301 over the 50 ms target -> ~0.66)
+    # and the p99 exceeds the window floor — neither overload nor calm
+    clock[0] += 1.1
+    reqs.inc(450)
+    for _ in range(295):
+        hist.observe(0.004)
+    for _ in range(4):
+        hist.observe(0.020)
+    for _ in range(2):
+        hist.observe(0.060)
+    r = c.step()
+    assert not r["overload"] and not r["calm"], r
+    assert r["actions"] == []
+    # the reset means the next TWO calm windows still do not act
+    for i in range(2):
+        clock[0] += 1.1
+        reqs.inc(10)
+        for _ in range(5):
+            hist.observe(0.004)
+        r = c.step()
+        assert r["actions"] == [], r
+    # third consecutive calm window acts
+    clock[0] += 1.1
+    reqs.inc(10)
+    for _ in range(5):
+        hist.observe(0.004)
+    r = c.step()
+    assert any("lanes 2->1" in a for a in r["actions"])
+    assert bat.lanes == 1
+    # already at baseline: further calm never goes below
+    for i in range(5):
+        clock[0] += 1.1
+        for _ in range(5):
+            hist.observe(0.004)
+        c.step()
+    assert bat.lanes == 1 and bat.max_wait_ms == 2.0
+    bat.start()
+    bat.close(drain=True)
+
+
+def test_demand_guard_holds_wide_window_while_burst_serves_well():
+    """A wide window serving a burst WELL has a quiet burn — the demand
+    guard must keep the provisioning until the offered rate actually
+    falls (and release within a few steps once it does)."""
+    c, bat, hist, clock = _make_controller()
+    reqs = c.metrics.counter("svgd_serve_requests_total", "t")
+    # overload at high request rate
+    for _ in range(2):
+        clock[0] += 1.1
+        reqs.inc(500)
+        for _ in range(50):
+            hist.observe(0.300)
+        c.step()
+    assert bat.max_wait_ms > 2.0
+    wide = bat.max_wait_ms
+    # burst continues at the same rate, now served well (low burn):
+    # NOT calm — the guard holds
+    for _ in range(6):
+        clock[0] += 1.1
+        reqs.inc(500)
+        for _ in range(50):
+            hist.observe(0.004)
+        r = c.step()
+        assert not r["calm"], r
+    assert bat.max_wait_ms == wide
+    # demand falls: released after the decay + consecutive calm windows
+    for _ in range(10):
+        clock[0] += 1.1
+        reqs.inc(50)
+        for _ in range(5):
+            hist.observe(0.004)
+        c.step()
+    assert bat.max_wait_ms < wide
+    bat.start()
+    bat.close(drain=True)
+
+
+def test_shed_signal_is_overload_and_window_floor_is_not():
+    c, bat, hist, clock = _make_controller()
+    shed = c.metrics.counter("svgd_serve_shed_total", "t")
+    shed.inc(3)
+    r = c.step()
+    assert r["overload"] and r["shed_delta"] == 3.0
+    # p99 within 2*window + slack reads as the controller's own floor,
+    # never burn-overload — even with the burn rate itself sky-high
+    c2, bat2, hist2, clock2 = _make_controller(
+        policy=AutoscalePolicy(lanes_max=4, max_wait_ms_max=16.0,
+                               p99_target_ms=10.0, cooldown_s=1.0))
+    bat2.set_max_wait_ms(16.0)
+    for _ in range(50):
+        hist2.observe(0.020)  # every obs over the 10 ms target (burn >> 1)
+        # but p99 ~25 ms < 2*16 + 10 slack: self-inflicted window latency
+    r = c2.step()
+    assert r["burn"] > 1.0
+    assert not r["overload"] and r["window_floor_ok"]
+    for b in (bat, bat2):
+        b.start()
+        b.close(drain=True)
+
+
+def test_quota_retune_tightens_and_restores(rng):
+    """Overload tightens every quota'd tenant to ceil(base*frac) and
+    flips the batcher to admission enforcement; calm restores both."""
+    metrics = _metrics.MetricsRegistry()
+    reg = ModelRegistry(metrics=metrics, batcher_autostart=False)
+    parts = rng.normal(size=(16, 5)).astype(np.float32)
+    reg.add_tenant("a", "logreg", particles=parts, quota_rows=10)
+    reg.add_tenant("b", "logreg", particles=parts.copy())  # no quota
+    clock = [0.0]
+    c = AutoscaleController(
+        reg.batcher, metrics=metrics, model_registry=reg,
+        policy=AutoscalePolicy(p99_target_ms=50.0, cooldown_s=0.0,
+                               down_consecutive=2,
+                               quota_tighten_frac=0.5),
+        clock=lambda: clock[0])
+    hist = metrics.histogram("svgd_serve_request_latency_seconds", "t")
+    for _ in range(50):
+        hist.observe(0.300)
+    clock[0] += 1.0
+    c.step()
+    assert reg.tenant("a").quota_rows == 5
+    assert reg.tenant("b").quota_rows is None
+    assert reg.batcher.quota_mode == "admission"
+    assert c.quota_scale == 0.5
+    for _ in range(6):
+        clock[0] += 1.0
+        for _ in range(3):
+            hist.observe(0.002)
+        c.step()
+    assert reg.tenant("a").quota_rows == 10
+    assert reg.batcher.quota_mode == "overflow"
+    assert c.quota_scale == 1.0
+    reg.close(drain=False)
+
+
+def test_controller_primes_windows_on_existing_registry():
+    """Attached to a registry with history, the first control step judges
+    the delta since construction — not the registry's whole past as one
+    giant overload window."""
+    reg = _metrics.MetricsRegistry()
+    h = reg.histogram("svgd_serve_request_latency_seconds", "t")
+    shed = reg.counter("svgd_serve_shed_total", "t")
+    for _ in range(500):
+        h.observe(5.0)  # ancient awful history
+    shed.inc(100)
+    bat = MicroBatcher(_echo, max_batch=8, max_wait_ms=2.0,
+                       max_queue_rows=64, registry=reg, autostart=False)
+    c = AutoscaleController(bat, metrics=reg, clock=lambda: 0.0)
+    r = c.step()
+    assert not r["overload"]
+    assert r["shed_delta"] == 0.0 and r["window_count"] == 0
+    bat.start()
+    bat.close(drain=True)
+
+
+def test_status_and_server_route(rng):
+    """/autoscale serves the controller's status; 404 without one; the
+    server lifecycle starts and stops the control thread."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    parts = rng.normal(size=(16, 5)).astype(np.float32)
+    eng = PredictiveEngine("logreg", parts, min_bucket=4, max_bucket=16)
+    srv = PredictionServer(eng, port=0, max_wait_ms=1.0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/autoscale", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+    eng2 = PredictiveEngine("logreg", parts.copy(), min_bucket=4,
+                            max_bucket=16)
+    srv2 = PredictionServer(eng2, port=0, max_wait_ms=1.0,
+                            autoscale=True).start()
+    try:
+        assert srv2.autoscale._thread is not None  # started with serve
+        doc = _json.loads(urllib.request.urlopen(
+            srv2.url + "/autoscale", timeout=10).read())
+        assert doc["lanes"] == 1
+        assert doc["bounds"]["lanes"][1] >= 1
+        assert "last_signals" in doc
+    finally:
+        srv2.shutdown()
+    assert srv2.autoscale._thread is None  # stopped on shutdown

@@ -1,0 +1,233 @@
+"""Trace-driven workload replay (tools/workload_replay.py): seeded trace
+determinism, the open-loop replay's record classification, the storm
+metric helpers, and the serve_storm gate logic.  The mini end-to-end
+storm keeps its phases short (the full-size A/B is the bench's job, not
+tier-1's); the 3-arm variant is slow-marked.
+"""
+
+import os
+import sys
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import workload_replay as wr  # noqa: E402
+
+from dist_svgd_tpu.serving.batcher import Overloaded  # noqa: E402
+
+
+def _cfg(**kw):
+    base = dict(duration_s=5.0, base_rps=120.0, seed=3,
+                bursts=((2.0, 1.0, 2.5),), tenants=("a", "b", "c"),
+                flash_crowds=((2.0, 1.0, 2, 0.7),))
+    base.update(kw)
+    return wr.TraceConfig(**base)
+
+
+# --------------------------------------------------------------------- #
+# trace model
+
+
+def test_trace_determinism_and_seed_sensitivity():
+    """Same config ⇒ identical arrival schedule, sizes, tenant mix, and
+    pool picks (the serve_storm A/B's identical-trace contract); a
+    different seed ⇒ a different trace."""
+    e1 = wr.generate_trace(_cfg())
+    e2 = wr.generate_trace(_cfg())
+    assert len(e1) == len(e2)
+    assert all(a.t == b.t and a.rows == b.rows and a.tenant == b.tenant
+               and a.pick == b.pick for a, b in zip(e1, e2))
+    e3 = wr.generate_trace(_cfg(seed=4))
+    assert len(e3) != len(e1) or any(
+        a.t != b.t for a, b in zip(e1, e3))
+
+
+def test_trace_shape_burst_flash_and_heavy_tail():
+    events = wr.generate_trace(_cfg(duration_s=6.0, base_rps=200.0))
+    pre = sum(1 for e in events if e.t < 2.0) / 2.0
+    burst = sum(1 for e in events if 2.0 <= e.t < 3.0)
+    assert burst > 1.6 * pre  # the 2.5x burst window is denser
+    crowd = [e.tenant for e in events if 2.0 <= e.t < 3.0]
+    assert crowd.count("c") / len(crowd) > 0.5  # flash mass shifted to c
+    outside = [e.tenant for e in events if e.t < 2.0]
+    assert outside.count("a") > outside.count("c")  # zipf rank order
+    sizes = [e.rows for e in events]
+    assert sizes.count(1) > sizes.count(32)  # power-law tail
+
+
+def test_trace_regular_arrivals_and_rate_envelope():
+    cfg = _cfg(arrival="regular", tenants=(), flash_crowds=(),
+               diurnal_amp=0.0)
+    events = wr.generate_trace(cfg)
+    # deterministic spacing at the instantaneous rate: counts match the
+    # envelope's integral almost exactly
+    pre = sum(1 for e in events if e.t < 2.0)
+    assert abs(pre - 240) <= 2
+    assert cfg.rate_at(2.5) == pytest.approx(300.0)
+    assert cfg.rate_at(4.0) == pytest.approx(120.0)
+    assert cfg.peak_rate() == pytest.approx(300.0)
+
+
+def test_trace_config_validation():
+    with pytest.raises(ValueError):
+        wr.TraceConfig(duration_s=0)
+    with pytest.raises(ValueError):
+        wr.TraceConfig(arrival="bursty")
+    with pytest.raises(ValueError):
+        wr.TraceConfig(bursts=((0.0, -1.0, 2.0),))
+    with pytest.raises(ValueError):
+        wr.TraceConfig(flash_crowds=((0.0, 1.0, 0, 0.5),))  # no tenants
+    with pytest.raises(ValueError):
+        wr.TraceConfig(tenants=("a",),
+                       flash_crowds=((0.0, 1.0, 3, 0.5),))  # bad index
+
+
+# --------------------------------------------------------------------- #
+# replay mechanics
+
+
+def test_replay_classifies_ok_shed_error_lost():
+    events = [wr.ReplayEvent(0.001 * i, 1, None, i) for i in range(4)]
+
+    def submit(ev):
+        fut = Future()
+        if ev.pick == 0:
+            fut.set_result({"y": np.zeros((1, 1))})
+        elif ev.pick == 1:
+            raise Overloaded("full")
+        elif ev.pick == 2:
+            fut.set_exception(RuntimeError("boom"))
+        # pick == 3: never resolves -> lost
+        return fut
+
+    records = wr.replay(events, submit, drain_timeout_s=0.2)
+    statuses = [r["status"] for r in records]
+    assert statuses == ["ok", "shed", "error", "lost"]
+    assert records[0]["lat_ms"] >= 0.0
+    assert records[1]["lat_ms"] is None
+    assert "boom" in records[2]["error"]
+
+
+def test_window_metrics_and_breach_and_recover():
+    records = [
+        # healthy first second
+        {"t": 0.2, "rows": 1, "tenant": None, "status": "ok", "lat_ms": 5.0},
+        {"t": 0.7, "rows": 1, "tenant": None, "status": "ok", "lat_ms": 8.0},
+        # second 1: p99 breaches + a shed
+        {"t": 1.2, "rows": 1, "tenant": None, "status": "ok",
+         "lat_ms": 90.0},
+        {"t": 1.5, "rows": 2, "tenant": None, "status": "shed",
+         "lat_ms": None},
+        # second 2: starvation (offered, nothing completed)
+        {"t": 2.5, "rows": 1, "tenant": None, "status": "shed",
+         "lat_ms": None},
+        # second 3: healthy again
+        {"t": 3.4, "rows": 1, "tenant": None, "status": "ok",
+         "lat_ms": 6.0},
+    ]
+    m = wr.window_metrics(records, 0.0, 4.0, good_ms=25.0)
+    assert m["offered"] == 6 and m["completed"] == 4
+    assert m["good"] == 3 and m["shed"] == 2
+    assert m["goodput_rps"] == pytest.approx(0.8)
+    assert wr.p99_breach_seconds(records, 25.0, 4.0) == 2
+    # burst ended at t=1: second 2 is starved, second 3 is the first
+    # healthy one -> 2 s to recover
+    assert wr.time_to_recover(records, 1.0, 25.0, 4.0) == pytest.approx(2.0)
+    # never recovering reads as the full remaining window
+    bad = [dict(r, lat_ms=500.0) for r in records if r["status"] == "ok"]
+    assert wr.time_to_recover(bad, 1.0, 25.0, 4.0) == pytest.approx(3.0)
+
+
+def test_storm_ok_gates():
+    row = {"lost_requests": 0, "recompiles": 0, "sentry_compiles": 0,
+           "arms": {"adaptive": {"phases": {"steady": {
+               "offered": 10, "completed": 8, "shed": 2, "errors": 0,
+               "lost": 0}}}}}
+    ok, why = wr.storm_ok(row)
+    assert ok and why == []
+    bad = dict(row, lost_requests=2)
+    ok, why = wr.storm_ok(bad)
+    assert not ok and "lost" in why[0]
+    bad = dict(row, recompiles=1)
+    assert not wr.storm_ok(bad)[0]
+    bad = dict(row, sentry_compiles=3)
+    assert not wr.storm_ok(bad)[0]
+    leaky = {"lost_requests": 0, "recompiles": 0, "sentry_compiles": 0,
+             "arms": {"adaptive": {"phases": {"steady": {
+                 "offered": 10, "completed": 7, "shed": 2, "errors": 0,
+                 "lost": 0}}}}}
+    ok, why = wr.storm_ok(leaky)
+    assert not ok and "accounted" in why[0]
+
+
+def test_run_storm_requires_two_tenants():
+    with pytest.raises(ValueError):
+        wr.run_storm(tenants=1)
+
+
+def test_default_lanes_max_is_host_derived():
+    assert 1 <= wr.default_lanes_max() <= 4
+
+
+# --------------------------------------------------------------------- #
+# end-to-end storms (tiny)
+
+
+def _storm_kw(**kw):
+    base = dict(n_particles=256, n_features=8, seed=5,
+                steady_s=1.2, burst_s=1.2, recover_s=1.2,
+                max_batch=32, max_queue_rows=128,
+                rows_sizes=(1, 2, 4), flash_rows_sizes=(8, 16),
+                tenants=2, calib_requests=90, interval_s=0.1)
+    base.update(kw)
+    return base
+
+
+def test_mini_storm_adaptive_arm_schema_and_gates():
+    """A tiny adaptive-only storm end to end: every admitted request
+    resolves, zero steady-state recompiles under the sentry, and the row
+    carries the full gated schema.  (The adaptive-vs-static A/B verdict
+    is the full-size bench's claim — a 1-second mini phase is noise.)"""
+    row = wr.run_storm(include_static=False, **_storm_kw())
+    ok, why = wr.storm_ok(row)
+    assert ok, why
+    assert row["metric"] == "serve_storm"
+    assert row["lost_requests"] == 0
+    assert row["recompiles"] == 0
+    assert row["sentry_compiles"] in (0, None)
+    assert row["ab"] is None
+    for key in ("storm_goodput_2x", "storm_p99_breach_s",
+                "storm_recover_s", "capacity_rows_per_s", "trace",
+                "bounds", "p99_target_ms"):
+        assert key in row
+    arm = row["arms"]["adaptive"]
+    assert arm["adaptive"] is True
+    assert "controller" in arm
+    assert set(arm["phases"]) == {"steady", "burst_polite", "recover"}
+    offered = sum(p["offered"] for p in arm["phases"].values())
+    assert offered > 0
+    assert row["trace"]["hog_burst_rps"] > 0
+
+
+@pytest.mark.slow
+def test_full_storm_three_arms():
+    """The 3-arm storm (static_base / static_burst / adaptive) on the
+    identical trace: per-arm schema, identical offered counts, and the
+    A/B block present.  Slow-marked: ~3 replay walls plus settles."""
+    row = wr.run_storm(**_storm_kw(steady_s=2.0, burst_s=2.0,
+                                   recover_s=2.0, tenants=3))
+    ok, why = wr.storm_ok(row)
+    assert ok, why
+    assert set(row["arms"]) == {"static_base", "static_burst", "adaptive"}
+    offered = {name: arm["hog"]["offered"] + sum(
+        p["offered"] for p in arm["phases"].values())
+        for name, arm in row["arms"].items()}
+    assert len(set(offered.values())) == 1  # the identical trace
+    ab = row["ab"]
+    assert set(ab) >= {"best_static_polite_goodput_rps", "adaptive_wins",
+                       "goodput_ratio", "breach_delta_s"}
+    assert isinstance(ab["adaptive_wins"], bool)
