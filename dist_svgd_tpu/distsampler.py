@@ -660,6 +660,13 @@ class DistSampler:
         return self._particles
 
     @property
+    def t(self) -> int:
+        """Absolute step counter (drives the ``partitions`` rotation and
+        the per-step minibatch key fold; rides :meth:`state_dict`, so a
+        resumed run continues on the same absolute grid)."""
+        return int(self._t)
+
+    @property
     def num_particles(self) -> int:
         return self._num_particles
 
@@ -874,10 +881,19 @@ class DistSampler:
         }
         # topology manifest (elastic capacity): loaders compare it against
         # the requested topology BEFORE any array op, and reshard_state
-        # reshapes the save for a different mesh (utils/checkpoint.py)
+        # reshapes the save for a different mesh (utils/checkpoint.py).
+        # The process layout (how many processes held the mesh, shards per
+        # granule) is stamped from the mesh itself — global values, bitwise
+        # identical in every process's save (assemble_full_state contract)
+        process_count, granule_shards = 1, None
+        if self._mesh is not None and self._mesh.size == self._num_shards:
+            from dist_svgd_tpu.parallel.multihost import mesh_process_layout
+
+            process_count, granule_shards = mesh_process_layout(self._mesh)
         state.update(_ckpt.topology_manifest(
             self._num_shards, self._num_particles, self._d,
             self._rows_per_shard,
+            process_count=process_count, granule_shards=granule_shards,
         ))
         if self._approx is not None:
             # the approximation identity: method + dial + (rff) the bank

@@ -93,6 +93,30 @@ def _ring_perm(num_shards: int):
     return [(j, (j + 1) % num_shards) for j in range(num_shards)]
 
 
+def ring_hops_per_step(mode: str, num_shards: int) -> dict:
+    """``{'hops': H, 'arrays_per_hop': A}``: how many ``lax.ppermute``
+    rotations one ring-mode step issues, and how many arrays each rotates —
+    the static comm profile drivers multiply by the mesh's DCN-boundary
+    crossing count (``parallel/multihost.py:dcn_boundary_crossings``) to
+    report slow-network traffic per step.
+
+    Counts mirror the hop primitives exactly, terminal-chunk elision
+    included: the ``all_particles`` single pass runs S hops with a
+    rotation-free tail (S−1 rotations of 1 array,
+    :func:`_ring_phi_local_scores`); ``all_scores`` adds a score pass of S
+    full rotations of 2 arrays before its S−1-rotation φ pass
+    (:func:`_ring_phi_exact_scores`); ``partitions`` never rotates.
+    """
+    S = int(num_shards)
+    if mode == PARTITIONS or S < 2:
+        return {"hops": 0, "arrays_per_hop": 0}
+    if mode == ALL_PARTICLES:
+        return {"hops": S - 1, "arrays_per_hop": 1}
+    if mode == ALL_SCORES:
+        return {"hops": (S - 1) + S, "arrays_per_hop": 2}
+    raise ValueError(f"unknown exchange mode {mode!r}")
+
+
 def _shard_data_resolver(mode, num_shards, n_local_data, shard_data):
     """Shared per-shard data resolution: ``resolve(data, t, r) -> data_local``.
 

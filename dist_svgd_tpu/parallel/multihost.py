@@ -30,6 +30,7 @@ single process both degrade to the trivial case, so drivers are written once.
 
 from __future__ import annotations
 
+import os
 import warnings
 from typing import Optional, Sequence, Tuple
 
@@ -38,6 +39,54 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dist_svgd_tpu.parallel.mesh import AXIS
+
+
+def _version_tuple(version: str) -> Tuple[int, ...]:
+    parts = []
+    for piece in version.split(".")[:3]:
+        digits = ""
+        for ch in piece:
+            if not ch.isdigit():
+                break
+            digits += ch
+        if not digits:
+            break
+        parts.append(int(digits))
+    return tuple(parts)
+
+
+def multiprocess_gap(
+    num_processes: Optional[int] = None, platform: Optional[str] = None
+) -> Optional[str]:
+    """One-line reason an explicit ``num_processes``-way rendezvous cannot
+    work in this runtime, or None when it can.
+
+    The known gap: jax < 0.5 has no multi-process collectives on the CPU
+    backend — rendezvous *succeeds* and the failure surfaces mid-run as
+    XLA's "Multiprocess computations aren't implemented on the CPU backend"
+    inside the first jitted collective.  Detecting it up front lets
+    :func:`initialize` (and drivers) refuse cleanly before any work is done.
+    ``platform`` defaults to the configured platform (``jax.config`` /
+    ``JAX_PLATFORMS``) so the probe stays legal before device init.
+    """
+    if num_processes is None or num_processes <= 1:
+        return None
+    if platform is None:
+        platform = (
+            getattr(jax.config, "jax_platforms", None)
+            or os.environ.get("JAX_PLATFORMS", "")
+            or ""
+        )
+        platform = platform.split(",")[0].strip().lower()
+    if platform != "cpu":
+        return None
+    if _version_tuple(jax.__version__) >= (0, 5):
+        return None
+    return (
+        f"jax {jax.__version__} cannot run multi-process collectives on the "
+        f"CPU backend (needs jax>=0.5); refusing the {num_processes}-process "
+        "rendezvous up front"
+    )
 
 
 def _distributed_initialized() -> bool:
@@ -75,6 +124,11 @@ def initialize(
     """
     if _distributed_initialized():
         return False
+    gap = multiprocess_gap(num_processes)
+    if gap is not None:
+        # refuse a doomed explicit multi-process request up front (the PR-1
+        # clean-refusal pattern) instead of letting XLA fail mid-run
+        raise RuntimeError(gap)
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -344,3 +398,46 @@ def replicate(value, mesh: Mesh) -> jax.Array:
     equivalent of the reference's every-rank-loads-the-full-dataset pattern,
     experiments/logreg.py:28)."""
     return jax.device_put(np.asarray(value), NamedSharding(mesh, P()))
+
+
+def mesh_process_layout(mesh: Mesh) -> Tuple[int, Tuple[int, ...]]:
+    """``(process_count, per-process shard counts)`` of a particle mesh —
+    the granule layout the topology manifest stamps so a restore can verify
+    it reassembles the same global shape the saves came from.
+
+    The counts are ordered by ``process_index`` (mesh order under
+    :func:`make_particle_mesh`'s granule-major placement), so the tuple is
+    identical in every process — safe to stamp into replicated manifest
+    entries (``assemble_full_state`` requires those to be bitwise equal
+    across per-process files)."""
+    counts: dict = {}
+    for d in mesh.devices.flat:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return len(counts), tuple(counts[p] for p in sorted(counts))
+
+
+def dcn_boundary_crossings(mesh_or_devices) -> int:
+    """Number of ring-adjacent device pairs (wrap included) that sit on
+    different DCN granules — how many of a ring pass's hops ride the slow
+    network instead of ICI.
+
+    Granule = TPU slice on multi-slice jobs, process otherwise (the same
+    boundary rule :func:`make_particle_mesh` orders around).  Granule-major
+    ordering makes this exactly the granule count — the minimum for a ring;
+    an interleaved mesh scores higher, which is the point of measuring it.
+    """
+    if isinstance(mesh_or_devices, Mesh):
+        devs = list(mesh_or_devices.devices.flat)
+    else:
+        devs = list(mesh_or_devices)
+    if len(devs) < 2:
+        return 0
+    slice_ids = {getattr(d, "slice_index", None) for d in devs}
+    if len(slice_ids) > 1:
+        granule = lambda d: d.slice_index
+    else:
+        granule = lambda d: d.process_index
+    return sum(
+        granule(devs[i]) != granule(devs[(i + 1) % len(devs)])
+        for i in range(len(devs))
+    )

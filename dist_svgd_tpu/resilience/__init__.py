@@ -18,6 +18,11 @@ already has for overload:
   process-level fleet faults: replica kill / hang / slowdown / network
   partition, consumed by ``serving/fleet.py``'s fake transport) so every
   recovery path runs in tier-1 on CPU;
+- :mod:`federation` — :class:`FederationSupervisor`: the coordinator loop
+  for W-process multi-host jobs, recovering the failure unit nothing
+  in-process can (an entire worker dying) by tearing down the rendezvous
+  and relaunching at W−1 against the host-sharded checkpoints
+  (``tools/multihost_train.py`` drives it fake and real);
 - :mod:`backoff` — the ONE capped-exponential-backoff implementation
   (jitter optional, RNG injectable) shared by the supervisor's
   :class:`RetryPolicy` and the serving fleet's router;
@@ -35,6 +40,12 @@ overhead as one BENCH-style JSON row, and
 """
 
 from dist_svgd_tpu.resilience.backoff import Backoff, capped_delay
+from dist_svgd_tpu.resilience.federation import (
+    FakeWorker,
+    FederationDead,
+    FederationSupervisor,
+    SubprocessWorker,
+)
 from dist_svgd_tpu.resilience.faults import (
     DeviceLossAt,
     FaultPlan,
@@ -53,6 +64,7 @@ from dist_svgd_tpu.resilience.faults import (
     SlowSegmentAt,
     TopologyFault,
     TransientDispatchError,
+    WorkerLossAt,
 )
 from dist_svgd_tpu.resilience.guards import GuardConfig, GuardViolation, check_state
 from dist_svgd_tpu.resilience.supervisor import (
@@ -79,11 +91,16 @@ __all__ = [
     "DeviceLossAt",
     "MeshShrinkAt",
     "MeshGrowAt",
+    "WorkerLossAt",
     "TopologyFault",
     "TransientDispatchError",
     "SimulatedHardKill",
     "Backoff",
     "capped_delay",
+    "FederationSupervisor",
+    "FederationDead",
+    "FakeWorker",
+    "SubprocessWorker",
     "FleetFault",
     "ReplicaKillAt",
     "ReplicaHangAt",

@@ -174,6 +174,46 @@ class MeshGrowAt(Fault):
         )
 
 
+class WorkerLossAt(Fault):
+    """Loss of whole federation worker process(es) — host SIGKILL, node
+    death — on a ``processes``-way multi-host run: every shard of the lost
+    process's DCN granule leaves the mesh at once, not one device.  Raises
+    :class:`TopologyFault` with the surviving shard count under the equal
+    granule layout (``make_particle_mesh``'s contract), so the supervisor's
+    :class:`~dist_svgd_tpu.resilience.supervisor.ReshardPolicy` resumes the
+    run at the W−1 federation's shard count on the same absolute step grid.
+    The kill-one-host leg of ``tools/multihost_train.py`` fires this in
+    fake mode; real mode delivers an actual SIGKILL instead."""
+
+    def __init__(self, step: int, processes: int, lost: int = 1):
+        super().__init__(step)
+        if processes < 2:
+            raise ValueError(f"processes must be >= 2, got {processes}")
+        if not 1 <= lost < processes:
+            raise ValueError(
+                f"lost must be in [1, {processes - 1}], got {lost}"
+            )
+        self.processes = int(processes)
+        self.lost = int(lost)
+
+    def fire(self, ctx) -> None:
+        S = ctx.num_shards
+        if S % self.processes:
+            raise ValueError(
+                f"WorkerLossAt(processes={self.processes}) on a {S}-shard "
+                "mesh: the granule layout must be equal per process"
+            )
+        per_granule = S // self.processes
+        surviving_p = self.processes - self.lost
+        raise TopologyFault(
+            f"injected loss of {self.lost} worker process(es) at step "
+            f"{ctx.t} ({self.processes} -> {surviving_p} processes, "
+            f"{S} -> {per_granule * surviving_p} shards)",
+            surviving=per_granule * surviving_p,
+            lost_devices=per_granule * self.lost,
+        )
+
+
 class SlowSegmentAt(Fault):
     """Artificial slow dispatch: advances the supervisor's (injectable)
     clock by ``seconds`` so the next segment wall measures slow — exercises

@@ -188,6 +188,20 @@ class ReshardPolicy:
         return sampler
 
 
+def _sampler_process_count(sampler) -> int:
+    """Process count of a sampler's mesh (1 for meshless/single-host) —
+    the process dimension the elastic metrics and flight records carry so
+    a multi-host transition (kill-one-host → W−1 federation) is
+    distinguishable from a same-host shard shrink in the telemetry."""
+    mesh = getattr(sampler, "_mesh", None)
+    if mesh is None:
+        return 1
+    try:
+        return len({d.process_index for d in mesh.devices.flat})
+    except Exception:  # pragma: no cover - exotic mesh-like stand-ins
+        return 1
+
+
 # --------------------------------------------------------------------- #
 # Sampler harnesses: one segmented-drive surface over both sampler kinds
 
@@ -489,6 +503,11 @@ class RunSupervisor:
             "svgd_elastic_shards",
             "current shard count of the supervised run's mesh")
         self._g_shards.set(self._harness.num_shards)
+        self._g_processes = reg.gauge(
+            "svgd_elastic_processes",
+            "current process count of the supervised run's mesh "
+            "(1 = single-host)")
+        self._g_processes.set(_sampler_process_count(sampler))
         self._reshard_events: list = []
         self._pending_recovery: Optional[dict] = None
         if diagnostics is not None and diagnostics.enabled:
@@ -683,6 +702,7 @@ class RunSupervisor:
         self._spend_restart(err)
         self._m_restarts.inc(kind="topology")
         from_shards = self._harness.num_shards
+        from_processes = _sampler_process_count(self.sampler)
         n_particles = int(self._harness.particles.shape[0])
         requested = err.target_shards
         if requested is None:
@@ -717,17 +737,21 @@ class RunSupervisor:
             self._diag_last_t = min(self._diag_last_t, harness.t)
         reshard_wall = self._clock() - clock0
         steps_lost = t_detected - harness.t
+        to_processes = _sampler_process_count(sampler)
         direction = ("grow" if to_shards > from_shards
                      else "shrink" if to_shards < from_shards else "same")
         self._m_reshards.inc(direction=direction)
         self._m_steps_lost.inc(steps_lost)
         self._g_shards.set(to_shards)
+        self._g_processes.set(to_processes)
         event = {
             "t_detected": t_detected,
             "resumed_from": harness.t,
             "from_shards": from_shards,
             "requested_shards": requested,
             "to_shards": to_shards,
+            "from_processes": from_processes,
+            "to_processes": to_processes,
             "steps_lost": steps_lost,
             "reshard_wall_s": round(reshard_wall, 4),
             # filled when the run regains the detection step (replay done)
@@ -743,9 +767,12 @@ class RunSupervisor:
         self._pending_recovery = event
         self._flight("topology_transition", t=t_detected,
                      from_shards=from_shards, to_shards=to_shards,
+                     from_processes=from_processes,
+                     to_processes=to_processes,
                      steps_lost=steps_lost, reason=str(err))
         self._log(event="reshard", t=t_detected, resumed_from=harness.t,
                   from_shards=from_shards, to_shards=to_shards,
+                  from_processes=from_processes, to_processes=to_processes,
                   steps_lost=steps_lost, reshard_wall_s=round(reshard_wall, 4),
                   error=f"{type(err).__name__}: {err}")
         self._sleep(self._retry.delay_s(self._consecutive_failures))

@@ -51,6 +51,8 @@ MANIFEST_KEYS = (
     "topo_d",
     "topo_particles_per_shard",
     "topo_data_rows_per_shard",
+    "topo_process_count",
+    "topo_granule_shards",
 )
 
 
@@ -63,14 +65,39 @@ class TopologyMismatch(ValueError):
 
 
 def topology_manifest(n_shards: int, n_particles: int, d: int,
-                      data_rows_per_shard: int = 0) -> Dict[str, np.ndarray]:
+                      data_rows_per_shard: int = 0,
+                      process_count: int = 1,
+                      granule_shards=None) -> Dict[str, np.ndarray]:
     """The manifest entries a sampler ``state_dict`` stamps into every save:
     shard count, global particle count and dimension, per-shard particle
     counts (equal blocks — the drop-remainder policy runs at construction),
-    and the per-shard data partition (0 = no data)."""
+    the per-shard data partition (0 = no data), and the **process layout**
+    — how many processes held the mesh and how many shards each granule
+    owned (``granule_shards`` defaults to an equal split; the granule-major
+    ``make_particle_mesh`` guarantees one exists).
+
+    The process-layout entries are *global* values, identical in every
+    process's save — never per-process (``assemble_full_state`` requires
+    replicated entries to be bitwise equal across the per-process files)."""
     s = int(n_shards)
     if s < 1:
         raise ValueError(f"n_shards must be >= 1, got {s}")
+    w = int(process_count)
+    if w < 1:
+        raise ValueError(f"process_count must be >= 1, got {w}")
+    if granule_shards is None:
+        if s % w:
+            raise ValueError(
+                f"process_count {w} does not divide n_shards {s}: pass the "
+                "explicit granule_shards layout"
+            )
+        granule_shards = (s // w,) * w
+    g = np.asarray(granule_shards, dtype=np.int64).reshape(-1)
+    if g.shape[0] != w or int(g.sum()) != s or int(g.min()) < 1:
+        raise ValueError(
+            f"granule_shards {tuple(int(x) for x in g)} does not lay out "
+            f"{s} shards over {w} processes"
+        )
     return {
         "topo_n_shards": np.asarray(s, dtype=np.int64),
         "topo_n_particles": np.asarray(int(n_particles), dtype=np.int64),
@@ -79,6 +106,8 @@ def topology_manifest(n_shards: int, n_particles: int, d: int,
                                             dtype=np.int64),
         "topo_data_rows_per_shard": np.asarray(int(data_rows_per_shard),
                                                dtype=np.int64),
+        "topo_process_count": np.asarray(w, dtype=np.int64),
+        "topo_granule_shards": g,
     }
 
 
@@ -86,10 +115,12 @@ def read_manifest(state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Parse the topology manifest out of a loaded state dict.
 
     Returns ``{'n_shards', 'n_particles', 'd', 'particles_per_shard',
-    'data_rows_per_shard'}`` or ``None`` when the save predates the manifest
-    **or** the manifest entries are unreadable/internally inconsistent (a
-    corrupt manifest must degrade to the manifest-less path, not crash the
-    restore — the caller warns and falls back to shape inference)."""
+    'data_rows_per_shard', 'process_count', 'granule_shards'}`` or ``None``
+    when the save predates the manifest **or** the manifest entries are
+    unreadable/internally inconsistent (a corrupt manifest must degrade to
+    the manifest-less path, not crash the restore — the caller warns and
+    falls back to shape inference).  The process-layout entries default to a
+    single-process layout for saves that predate them."""
     if state.get("topo_n_shards") is None:
         return None
     try:
@@ -103,12 +134,24 @@ def read_manifest(state: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             "data_rows_per_shard": int(
                 np.asarray(state.get("topo_data_rows_per_shard", 0))
             ),
+            "process_count": int(
+                np.asarray(state.get("topo_process_count", 1))
+            ),
         }
+        gs = state.get("topo_granule_shards")
+        man["granule_shards"] = (
+            np.full(1, man["n_shards"], dtype=np.int64) if gs is None
+            else np.asarray(gs, dtype=np.int64).reshape(-1)
+        )
     except (KeyError, TypeError, ValueError, OverflowError):
         return None
     if (man["n_shards"] < 1
             or man["particles_per_shard"].shape[0] != man["n_shards"]
             or int(man["particles_per_shard"].sum()) != man["n_particles"]):
+        return None
+    if (man["process_count"] < 1
+            or man["granule_shards"].shape[0] != man["process_count"]
+            or int(man["granule_shards"].sum()) != man["n_shards"]):
         return None
     return man
 
@@ -521,7 +564,83 @@ def assemble_full_state(paths,
                 )
             cursor += rows.shape[0]
         out[key] = np.concatenate([rows for _, rows in parts])
+    # the assembled dict IS the full-global state: restamp the process
+    # layout as single-process so the manifest describes what the dict now
+    # holds, not the federation that wrote the blocks
+    man = read_manifest(out)
+    if man is not None:
+        out["topo_process_count"] = np.asarray(1, dtype=np.int64)
+        out["topo_granule_shards"] = np.full(1, man["n_shards"],
+                                             dtype=np.int64)
     return out
+
+
+#: State keys a multi-process ``DistSampler.state_dict`` saves as
+#: per-process blocks (``host_addressable_block``); everything else is
+#: replicated verbatim in every process's file.
+BLOCK_KEYS = ("particles", "previous", "w2_g")
+
+
+def split_state_for_processes(state: Dict[str, Any],
+                              process_count: int) -> List[Dict[str, Any]]:
+    """Split a FULL single-process state dict into the ``process_count``
+    per-process block dicts the same run would have saved from a
+    multi-process federation — the emulation seam for exercising the
+    host-sharded checkpoint path (save blocks → ``assemble_full_state`` →
+    restore) without a real multi-process runtime.
+
+    Mirrors ``DistSampler.state_dict``: :data:`BLOCK_KEYS` arrays are cut
+    along axis 0 at this layout's shard boundaries (each process owns an
+    equal contiguous run of shards, the granule-major mesh contract) with
+    ``<key>_start`` offsets; every other entry — including the topology
+    manifest, restamped with the process layout — is replicated bitwise in
+    every block, exactly what ``assemble_full_state`` requires."""
+    W = int(process_count)
+    if W < 1:
+        raise ValueError(f"process_count must be >= 1, got {W}")
+    if int(np.asarray(state.get("particles_start", 0))) != 0:
+        raise ValueError(
+            "split_state_for_processes needs the FULL global state, but "
+            "this dict is already a per-process block (particles_start != 0)"
+        )
+    man = read_manifest(state)
+    if man is None:
+        raise ValueError(
+            "split_state_for_processes needs a manifest-stamped state "
+            "(topo_* entries) to know the shard layout"
+        )
+    S, n, d = man["n_shards"], man["n_particles"], man["d"]
+    if S % W:
+        raise ValueError(f"process_count {W} must divide n_shards {S}")
+    shards_per = S // W
+    stamp = topology_manifest(
+        S, n, d, man["data_rows_per_shard"],
+        process_count=W, granule_shards=(shards_per,) * W,
+    )
+    blocks: List[Dict[str, Any]] = []
+    for p in range(W):
+        blk: Dict[str, Any] = {}
+        for key, value in state.items():
+            if key in stamp or key.endswith("_start"):
+                continue
+            arr = None if value is None else np.asarray(value)
+            if key in BLOCK_KEYS and arr is not None and arr.ndim >= 1:
+                L = arr.shape[0]
+                if L % S:
+                    raise ValueError(
+                        f"state entry {key!r} has leading dim {L} not "
+                        f"divisible by n_shards {S} — not a sharded array?"
+                    )
+                per_shard = L // S
+                lo = p * shards_per * per_shard
+                hi = (p + 1) * shards_per * per_shard
+                blk[key] = arr[lo:hi]
+                blk[key + "_start"] = np.asarray(lo, dtype=np.int64)
+            else:
+                blk[key] = value
+        blk.update(stamp)
+        blocks.append(blk)
+    return blocks
 
 
 class CheckpointManager:

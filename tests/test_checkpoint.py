@@ -468,3 +468,39 @@ def test_assemble_full_state_mixed_key_presence_is_valueerror(tmp_path):
     # dependent: KeyError only when the poor file came first)
     with pytest.raises(ValueError, match="complete multi-host save"):
         assemble_full_state([b, a])
+
+
+def test_topology_manifest_process_stamp_roundtrip():
+    """Round 19: the manifest carries the writing federation's process
+    layout; read_manifest surfaces it (defaulting pre-round-19 files to a
+    single-process layout) and rejects an inconsistent stamp."""
+    from dist_svgd_tpu.utils.checkpoint import read_manifest, topology_manifest
+
+    man = topology_manifest(8, 64, 2, process_count=4)
+    assert int(man["topo_process_count"]) == 4
+    np.testing.assert_array_equal(man["topo_granule_shards"], [2, 2, 2, 2])
+    got = read_manifest(dict(man))
+    assert got["process_count"] == 4
+    assert got["granule_shards"].tolist() == [2, 2, 2, 2]
+
+    # pre-round-19 manifest (no process keys): single-process defaults
+    legacy = {k: v for k, v in man.items()
+              if k not in ("topo_process_count", "topo_granule_shards")}
+    got = read_manifest(legacy)
+    assert got["process_count"] == 1
+    assert got["granule_shards"].tolist() == [8]
+
+    # uneven explicit layout is allowed when it sums correctly...
+    man = topology_manifest(8, 64, 2, process_count=2,
+                            granule_shards=[6, 2])
+    assert read_manifest(dict(man))["granule_shards"].tolist() == [6, 2]
+    # ...but a layout that does not add up must be refused
+    with pytest.raises(ValueError, match="granule"):
+        topology_manifest(8, 64, 2, process_count=2, granule_shards=[6, 3])
+    with pytest.raises(ValueError, match="divide"):
+        topology_manifest(8, 64, 2, process_count=3)  # 8 % 3 != 0
+
+    # a stamped-but-corrupt manifest reads as None (the corruption gate)
+    bad = dict(topology_manifest(8, 64, 2, process_count=4))
+    bad["topo_granule_shards"] = np.asarray([2, 2, 2, 3], dtype=np.int64)
+    assert read_manifest(bad) is None

@@ -253,3 +253,19 @@ def test_serving_engine_steady_state_compiles_zero():
         pytest.skip("jax.monitoring events unavailable on this jax")
     assert sentry.compiles == 0, sentry.report()
     assert eng.stats()["bucket_misses"] == 3  # warmup's 4..16, nothing since
+
+
+def test_scope_covers_round19_multihost_tools():
+    """The two round-19 tools files sit inside the gated ``tools/`` tree —
+    the repo gate above lints them — and each passes standalone with zero
+    non-allowlisted findings (JL002 RNG discipline included: the worker's
+    same-seed full init draws through numpy, never a raw PRNGKey)."""
+    for name in ("multihost_train.py", "multihost_worker.py"):
+        path = os.path.join(REPO_ROOT, "tools", name)
+        assert os.path.exists(path), path
+        assert any(path.startswith(tree) for tree in GATED_TREES)
+        findings = [
+            f for f in lint_paths([path])
+            if not allowlist_mod.is_allowlisted(f.path, f.rule, f.line)
+        ]
+        assert not findings, "\n".join(f.format() for f in findings)

@@ -299,3 +299,112 @@ def test_distsampler_runs_on_multihost_mesh():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref.make_step(0.1)), rtol=1e-12, atol=1e-12
     )
+
+
+# ---- round-19 cross-host additions ---------------------------------- #
+
+
+def test_multiprocess_gap_matrix():
+    """The capability probe: single-process and TPU requests are never
+    gapped; an explicit CPU federation is gapped exactly on legacy jax,
+    and the reason names both the installed and the required version."""
+    assert multihost.multiprocess_gap(1) is None
+    assert multihost.multiprocess_gap(None) is None
+    assert multihost.multiprocess_gap(4, platform="tpu") is None
+    gap = multihost.multiprocess_gap(2)
+    if SHARD_MAP_LEGACY:
+        assert gap is not None
+        assert jax.__version__ in gap
+        assert "jax>=0.5" in gap
+    else:
+        assert gap is None
+
+
+@pytest.mark.skipif(
+    not SHARD_MAP_LEGACY,
+    reason="the up-front refusal only fires on the legacy-jax CPU gap",
+)
+def test_initialize_refuses_doomed_multiprocess_cpu():
+    # An explicit CPU rendezvous that XLA would kill mid-run must refuse
+    # BEFORE contacting the coordinator, naming the version gap — not a
+    # connect timeout, not a mid-run XlaRuntimeError.
+    with pytest.raises(RuntimeError, match="refusing the 2-process"):
+        multihost.initialize(
+            coordinator_address="127.0.0.1:1",
+            num_processes=2,
+            process_id=0,
+        )
+
+
+def test_mesh_process_layout_single_process():
+    assert multihost.mesh_process_layout(
+        multihost.make_particle_mesh(8)) == (1, (8,))
+    assert multihost.mesh_process_layout(
+        multihost.make_particle_mesh(1)) == (1, (1,))
+
+
+def test_dcn_boundary_crossings_counts_granule_edges():
+    class Dev:
+        def __init__(self, p):
+            self.process_index = p
+
+    # degenerate sizes never cross
+    assert multihost.dcn_boundary_crossings([]) == 0
+    assert multihost.dcn_boundary_crossings([Dev(0)]) == 0
+    # granule-major 2x2: exactly one boundary + the wrap
+    assert multihost.dcn_boundary_crossings(
+        [Dev(0), Dev(0), Dev(1), Dev(1)]) == 2
+    # interleaved placement pays DCN on EVERY hop — the failure mode the
+    # granule-major mesh ordering exists to avoid
+    assert multihost.dcn_boundary_crossings(
+        [Dev(0), Dev(1), Dev(0), Dev(1)]) == 4
+    # in-process mesh: one granule, zero crossings
+    assert multihost.dcn_boundary_crossings(
+        multihost.make_particle_mesh(8)) == 0
+
+
+def test_global_local_roundtrip_nondividing_rows():
+    """Rows that do not divide the mesh must be REJECTED at placement (on
+    legacy jax uneven row sharding raises at device_put — a silent pad
+    would corrupt the checkpoint row accounting), while a ragged
+    non-power-of-two mesh that does divide round-trips exactly."""
+    rows = np.arange(10 * 2, dtype=np.float64).reshape(10, 2)
+    with pytest.raises(ValueError, match="divisible"):
+        multihost.make_global_from_local(
+            rows, multihost.make_particle_mesh(8), (10, 2))
+    mesh = multihost.make_particle_mesh(5)
+    arr = multihost.make_global_from_local(rows, mesh, (10, 2))
+    block, start = multihost.host_addressable_block(arr)
+    assert start == 0
+    np.testing.assert_array_equal(block, rows)
+
+
+def test_global_local_roundtrip_single_device_mesh():
+    """W=1 degeneracy: a one-device mesh is the trivial federation — the
+    same driver recipe must round-trip unchanged."""
+    mesh = multihost.make_particle_mesh(1)
+    rows = np.arange(6 * 3, dtype=np.float64).reshape(6, 3)
+    start, count = multihost.process_local_rows(6, mesh)
+    assert (start, count) == (0, 6)
+    arr = multihost.make_global_particles(rows, mesh, n_global=6)
+    block, b_start = multihost.host_addressable_block(arr)
+    assert b_start == 0
+    np.testing.assert_array_equal(block, rows)
+
+
+def test_ring_hops_per_step_accounting():
+    from dist_svgd_tpu.parallel.exchange import (
+        ALL_PARTICLES,
+        ring_hops_per_step,
+    )
+
+    assert ring_hops_per_step(ALL_PARTICLES, 8) == {
+        "hops": 7, "arrays_per_hop": 1}
+    assert ring_hops_per_step("all_scores", 8) == {
+        "hops": 15, "arrays_per_hop": 2}
+    assert ring_hops_per_step("partitions", 8) == {
+        "hops": 0, "arrays_per_hop": 0}
+    assert ring_hops_per_step("all_particles", 1) == {
+        "hops": 0, "arrays_per_hop": 0}
+    with pytest.raises(ValueError):
+        ring_hops_per_step("nonsense", 8)

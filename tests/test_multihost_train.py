@@ -1,0 +1,314 @@
+"""tools/multihost_train.py: the multihost_train row — fake-mode drill in
+tier-1 (bitwise multi-process-topology resume, kill-one W−1 elastic resume
+on the same step grid, zero post-restart steady-state recompiles), the
+FederationSupervisor coordinator loop on scripted workers, per-process
+checkpoint split/assemble round-trips, and the real-mode clean refusal on
+the legacy-jax CPU multiprocess gap."""
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import multihost_train
+
+from dist_svgd_tpu.parallel import multihost
+from dist_svgd_tpu.parallel.mesh import SHARD_MAP_LEGACY
+from dist_svgd_tpu.resilience import (
+    FakeWorker,
+    FederationDead,
+    FederationSupervisor,
+    TopologyFault,
+    WorkerLossAt,
+)
+from dist_svgd_tpu.telemetry import MetricsRegistry
+from dist_svgd_tpu.utils import checkpoint as ckpt
+
+
+@pytest.fixture(scope="module")
+def fake_row(tmp_path_factory):
+    return multihost_train.run_drill(
+        mode="fake", processes=4, devcount=2, n=48, num_steps=12,
+        checkpoint_every=4,
+        root=str(tmp_path_factory.mktemp("mh_drill")))
+
+
+def test_fake_drill_row_schema(fake_row):
+    for key in ("metric", "mode", "processes", "devcount", "shards",
+                "shards_after_loss", "updates_per_s_gather",
+                "updates_per_s_ring", "ring_step_wall_ms",
+                "ring_hops_per_step", "ring_hop_wall_ms",
+                "dcn_crossings_per_hop", "variants_ok", "manifest_stamped",
+                "single_block_rejected", "resume_bitwise",
+                "rng_layout_free", "kill_step", "steps_lost",
+                "expected_steps_lost", "killone_max_dev",
+                "killone_within_tol", "post_restart_recompiles",
+                "federation_restarts", "federation_transitions"):
+        assert key in fake_row, key
+    assert fake_row["metric"] == "multihost_train"
+    assert fake_row["mode"] == "fake"
+    assert fake_row["shards"] == 8
+    assert fake_row["shards_after_loss"] == 6
+
+
+def test_fake_drill_passes_its_own_gates(fake_row):
+    ok, reasons = multihost_train.row_ok(fake_row)
+    assert ok, reasons
+
+
+def test_fake_drill_resume_is_bitwise_and_layout_free(fake_row):
+    # the tentpole invariant: a multi-process-topology checkpoint (split
+    # into per-process blocks, saved, assembled) resumes BITWISE equal to
+    # the uninterrupted run, and the minibatch RNG root is identical —
+    # process layout is an execution detail, not semantics
+    assert fake_row["resume_bitwise"] is True
+    assert fake_row["rng_layout_free"] is True
+    assert fake_row["manifest_stamped"] is True
+    assert fake_row["single_block_rejected"] is True
+
+
+def test_fake_drill_killone_grid_and_recompiles(fake_row):
+    # kill between checkpoints: exactly the steps since the last save are
+    # lost, the W−1 resume lands back on the same absolute grid within
+    # the drill tolerance, and steady state after the restart compiles
+    # nothing
+    assert fake_row["steps_lost"] == fake_row["expected_steps_lost"] == 2
+    assert fake_row["killone_within_tol"] is True
+    if fake_row["sentry_supported"]:
+        assert fake_row["post_restart_recompiles"] == 0
+
+
+def test_fake_drill_federation_transition(fake_row):
+    assert fake_row["federation_restarts"] == 1
+    assert fake_row["federation_final_processes"] == 3
+    (tr,) = fake_row["federation_transitions"]
+    assert (tr["from_processes"], tr["to_processes"]) == (4, 3)
+    assert tr["restart_wall_s"] is not None
+
+
+def test_fake_drill_comm_profile(fake_row):
+    # 8-shard gather ring: 7 hops/step; in-process mesh: one granule, so
+    # zero DCN boundary crossings (the granule-major minimum)
+    assert fake_row["ring_hops_per_step"] == 7
+    assert fake_row["dcn_crossings_per_hop"] == 0
+    assert fake_row["updates_per_s_gather"] > 0
+    assert fake_row["updates_per_s_ring"] > 0
+
+
+@pytest.mark.skipif(
+    not SHARD_MAP_LEGACY,
+    reason="the refusal row only exists on the legacy-jax CPU gap",
+)
+def test_real_mode_refuses_cleanly_on_legacy_jax():
+    row = multihost_train.run_drill(mode="real", processes=2)
+    assert row["status"] == "unsupported"
+    assert "jax>=0.5" in row["unsupported_reason"]
+    ok, reasons = multihost_train.row_ok(row)
+    assert ok  # an honest refusal is the contract, not a failure
+    assert "unsupported" in reasons[0]
+
+
+def test_row_ok_fails_on_each_broken_gate(fake_row):
+    for key, bad in (("resume_bitwise", False),
+                     ("rng_layout_free", False),
+                     ("manifest_stamped", False),
+                     ("single_block_rejected", False),
+                     ("variants_ok", False),
+                     ("steps_lost", 99),
+                     ("killone_within_tol", False),
+                     ("post_restart_recompiles", 3)):
+        row = dict(fake_row)
+        row[key] = bad
+        ok, reasons = multihost_train.row_ok(row)
+        assert not ok, key
+        assert reasons, key
+
+
+# ---- FederationSupervisor on scripted workers ------------------------ #
+
+
+def _fake_clock():
+    state = {"t": 0.0}
+
+    def clock():
+        state["t"] += 0.01
+        return state["t"]
+
+    return clock
+
+
+def test_federation_clean_finish_no_restarts():
+    launches = []
+
+    def launcher(width, attempt):
+        launches.append((width, attempt))
+        return [FakeWorker(f"w{i}", [None, 0]) for i in range(width)]
+
+    sup = FederationSupervisor(launcher, processes=3,
+                               registry=MetricsRegistry(),
+                               clock=_fake_clock(), sleep=lambda s: None)
+    report = sup.run()
+    assert report["status"] == "ok"
+    assert report["processes"] == 3
+    assert report["restarts"] == 0
+    assert report["transitions"] == []
+    assert launches == [(3, 0)]
+
+
+def test_federation_kill_one_relaunches_at_w_minus_1():
+    launches = []
+
+    def launcher(width, attempt):
+        launches.append((width, attempt))
+        if attempt == 0:
+            return [FakeWorker(f"w{i}",
+                               [None, -9 if i == 1 else None, None, 0])
+                    for i in range(width)]
+        return [FakeWorker(f"w{i}", [None, 0]) for i in range(width)]
+
+    reg = MetricsRegistry()
+    sup = FederationSupervisor(launcher, processes=4, restart_budget=1,
+                               registry=reg,
+                               clock=_fake_clock(), sleep=lambda s: None)
+    report = sup.run()
+    assert report["status"] == "ok"
+    assert report["processes"] == 3
+    assert report["restarts"] == 1
+    assert launches == [(4, 0), (3, 1)]
+    (tr,) = report["transitions"]
+    assert tr["from_processes"] == 4
+    assert tr["to_processes"] == 3
+    assert tr["lost"] == {"w1": -9}
+    assert tr["restart_wall_s"] is not None and tr["restart_wall_s"] > 0
+    # the process dimension lands in the shared svgd_elastic_* metrics
+    assert reg.gauge("svgd_elastic_processes").value() == 3
+    assert reg.counter("svgd_elastic_worker_losses_total").value() == 1
+    assert reg.counter(
+        "svgd_elastic_federation_restarts_total").value() == 1
+
+
+def test_federation_restart_budget_exhaustion_raises():
+    def launcher(width, attempt):
+        # every generation loses its last worker
+        return [FakeWorker(f"w{i}",
+                           [None, -9 if i == width - 1 else None, None])
+                for i in range(width)]
+
+    sup = FederationSupervisor(launcher, processes=4, restart_budget=1,
+                               registry=MetricsRegistry(),
+                               clock=_fake_clock(), sleep=lambda s: None)
+    with pytest.raises(FederationDead, match="budget"):
+        sup.run()
+
+
+def test_federation_min_processes_floor_raises():
+    def launcher(width, attempt):
+        # three of four die at once: survivors < min_processes
+        return [FakeWorker(f"w{i}", [None, -9 if i else None, None])
+                for i in range(width)]
+
+    sup = FederationSupervisor(launcher, processes=2, min_processes=2,
+                               restart_budget=5,
+                               registry=MetricsRegistry(),
+                               clock=_fake_clock(), sleep=lambda s: None)
+    with pytest.raises(FederationDead, match="min_processes"):
+        sup.run()
+
+
+def test_federation_launcher_width_mismatch_raises():
+    sup = FederationSupervisor(
+        lambda width, attempt: [FakeWorker("only")],
+        processes=3, registry=MetricsRegistry(),
+        clock=_fake_clock(), sleep=lambda s: None)
+    with pytest.raises(ValueError, match="returned 1 workers"):
+        sup.run()
+
+
+def test_worker_loss_fault_maps_processes_to_shards():
+    fault = WorkerLossAt(5, processes=4, lost=1)
+    ctx = types.SimpleNamespace(t=5, num_shards=8)
+    with pytest.raises(TopologyFault) as ei:
+        fault.fire(ctx)
+    assert ei.value.surviving == 6
+    assert ei.value.lost_devices == 2
+    with pytest.raises(ValueError, match="granule layout"):
+        fault.fire(types.SimpleNamespace(t=5, num_shards=6))
+    with pytest.raises(ValueError, match="processes"):
+        WorkerLossAt(5, processes=1)
+    with pytest.raises(ValueError, match="lost"):
+        WorkerLossAt(5, processes=4, lost=4)
+
+
+# ---- per-process checkpoint split/assemble --------------------------- #
+
+
+def _small_state(num_shards=8, n=16):
+    sampler = multihost_train.build_sampler(
+        n, num_shards, multihost.make_particle_mesh(num_shards))
+    sampler.run_steps(2, 0.05)
+    return sampler, sampler.state_dict()
+
+
+def test_split_state_roundtrip_bitwise(tmp_path):
+    _, state = _small_state()
+    blocks = ckpt.split_state_for_processes(state, 4)
+    assert len(blocks) == 4
+    paths = []
+    for r, blk in enumerate(blocks):
+        man = ckpt.read_manifest(blk)
+        assert man["process_count"] == 4
+        assert man["granule_shards"].tolist() == [2, 2, 2, 2]
+        assert blk["particles"].shape[0] == 4  # 16 rows / 8 shards * 2
+        assert int(blk["particles_start"]) == r * 4
+        paths.append(ckpt.save_state(str(tmp_path / f"rank_{r}"), blk))
+    full = ckpt.assemble_full_state(paths)
+    for key, val in state.items():
+        if key.endswith("_start") or key.startswith("topo_"):
+            continue
+        if val is None:  # e.g. `previous` with W2 off — dropped on save
+            assert full.get(key) is None
+            continue
+        np.testing.assert_array_equal(np.asarray(full[key]),
+                                      np.asarray(val), err_msg=key)
+
+
+def test_split_state_w1_is_identity_block():
+    _, state = _small_state()
+    (blk,) = ckpt.split_state_for_processes(state, 1)
+    np.testing.assert_array_equal(np.asarray(blk["particles"]),
+                                  np.asarray(state["particles"]))
+    assert ckpt.read_manifest(blk)["process_count"] == 1
+
+
+def test_split_state_refusals():
+    _, state = _small_state()
+    with pytest.raises(ValueError, match="divide"):
+        ckpt.split_state_for_processes(state, 3)
+    blocks = ckpt.split_state_for_processes(state, 4)
+    with pytest.raises(ValueError, match="per-process"):
+        ckpt.split_state_for_processes(blocks[1], 2)
+    with pytest.raises(ValueError, match="manifest"):
+        ckpt.split_state_for_processes({"particles": np.zeros((8, 2))}, 2)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    SHARD_MAP_LEGACY,
+    reason="jax < 0.5 CPU backend lacks multiprocess collectives",
+)
+def test_real_mode_kill_one_drill(tmp_path):
+    """The real leg: 2 worker subprocesses rendezvous, train, one takes a
+    real SIGKILL after its first complete per-process save, and the
+    FederationSupervisor relaunches the survivor with --resume."""
+    row = multihost_train.run_drill(
+        mode="real", processes=2, devcount=2, n=48, num_steps=8,
+        checkpoint_every=4, root=str(tmp_path))
+    ok, reasons = multihost_train.row_ok(row)
+    assert ok, reasons
+    assert row["federation_restarts"] == 1
+    assert row["killone_within_tol"]

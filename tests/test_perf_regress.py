@@ -11,10 +11,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools"))
 
 from perf_regress import (  # noqa: E402
+    WINDOWED_ROWS,
     _mad,
     _median,
     incumbent_history,
     judge_row,
+    missing_rows,
     record_result,
 )
 
@@ -91,3 +93,23 @@ def test_record_result_fresh_key():
 def test_record_result_rejects_bad_window():
     with pytest.raises(ValueError, match="window"):
         record_result({}, "k", 1.0, window=0)
+
+
+def test_missing_rows_empty_incumbents_lists_every_windowed_row():
+    assert missing_rows({}) == list(WINDOWED_ROWS)
+
+
+def test_missing_rows_respects_history_and_legacy_scalars():
+    inc = {"_history": {"north_star_ups": [100.0]}, "config1_ups": 5.0}
+    missing = missing_rows(inc)
+    assert "north_star_ups" not in missing    # window counts
+    assert "config1_ups" not in missing       # legacy scalar counts
+    assert "multihost_updates_per_s" in missing
+    # order is the row print order, not alphabetical
+    assert missing == [k for k in WINDOWED_ROWS if k in set(missing)]
+
+
+def test_windowed_rows_include_the_multihost_gates():
+    assert "multihost_ring_hop_wall_ms" in WINDOWED_ROWS
+    assert "multihost_updates_per_s" in WINDOWED_ROWS
+    assert len(WINDOWED_ROWS) == len(set(WINDOWED_ROWS))

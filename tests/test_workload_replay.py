@@ -231,3 +231,31 @@ def test_full_storm_three_arms():
     assert set(ab) >= {"best_static_polite_goodput_rps", "adaptive_wins",
                        "goodput_ratio", "breach_delta_s"}
     assert isinstance(ab["adaptive_wins"], bool)
+
+
+def test_fleet_replay_flash_crowd_sheds_without_losses():
+    """A flash crowd replayed through the round-15 FleetRouter front door
+    (fake transport, bounded per-replica row budgets): the overload must
+    surface as fleet-level 429s that replay books as `shed` — never as a
+    lost or errored request, because an admitted request always resolves
+    and a shed is the replica protecting itself, not failing."""
+    router, close = wr.build_fake_fleet(
+        2, max_replica_rows=8, tenants=("t0", "t1"))
+    cfg = wr.TraceConfig(
+        duration_s=1.2, base_rps=150.0, seed=3,
+        bursts=((0.2, 0.6, 8.0),), rows_sizes=(4, 8),
+        tenants=("t0", "t1"))
+    events = wr.generate_trace(cfg)
+    pools = {r: [np.zeros((r, 4), dtype=np.float32)] for r in (4, 8)}
+    transport = wr.make_router_submit(router)
+    try:
+        records = wr.replay(events, transport(pools))
+    finally:
+        transport.shutdown(wait=False)
+        close()
+    m = wr.window_metrics(records, 0.0, cfg.duration_s, good_ms=1000.0)
+    assert m["offered"] > 50
+    assert m["shed"] > 0      # the burst hit the row budgets
+    assert m["lost"] == 0
+    assert m["errors"] == 0
+    assert m["completed"] + m["shed"] == m["offered"]

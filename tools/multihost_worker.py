@@ -1,0 +1,198 @@
+"""Worker process for ``tools/multihost_train.py``'s real-mode federation.
+
+Not a test module and not imported by the driver — invoked as::
+
+    python tools/multihost_worker.py --rank R --nprocs W \
+        --coordinator HOST:PORT --root DIR [--devcount K] [--resume] ...
+
+Each worker joins the ``jax.distributed`` rendezvous
+(``parallel/multihost.py:initialize`` — which refuses up front on the
+legacy-jax CPU-backend multiprocess gap), builds the granule-major particle
+mesh spanning every process, and drives ``DistSampler`` in
+``checkpoint-every``-sized segments on the absolute step grid, saving ONLY
+its addressable block each segment (``state_dict`` per-process blocks) to
+``<root>/step_<t>/rank_<r>``.
+
+``--resume`` is the elastic path: the worker discovers the newest COMPLETE
+step save (every rank file of the writing federation present — the saved
+manifest's ``topo_process_count`` says how many), assembles the blocks
+(``utils/checkpoint.py:assemble_full_state``), reshards to this
+federation's mesh size (``reshard_state`` — the different-W route), and
+continues from the saved step counter.  On the same layout the assembled
+restore is bitwise-identical to a per-rank restore, so one code path
+serves both.
+
+On TPU hosts pass ``--devcount 0`` to keep the real platform; any positive
+count forces that many virtual CPU devices (the CPU-federation mode).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+
+def _setup_cpu(device_count: int) -> None:
+    """Force this process onto ``device_count`` virtual CPU devices before
+    any JAX use (the same workaround tests/_jax_env.py applies: the image
+    pre-registers an ``axon`` TPU plugin that CPU-only processes must drop
+    or their init blocks on the TPU tunnel)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={device_count}"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+
+
+def _latest_complete_save(root: str):
+    """Newest ``step_<t>`` dir whose rank-file set is complete for the
+    federation that wrote it; returns ``(t, [rank paths])`` or ``None``."""
+    from dist_svgd_tpu.utils.checkpoint import load_state, read_manifest
+
+    best = None
+    for d in glob.glob(os.path.join(root, "step_*")):
+        m = re.match(r"^step_(\d+)$", os.path.basename(d))
+        if not m:
+            continue
+        ranks = sorted(glob.glob(os.path.join(d, "rank_*")))
+        if not ranks:
+            continue
+        try:
+            man = read_manifest(load_state(ranks[0]))
+        except Exception:
+            continue
+        if man is None or len(ranks) != man["process_count"]:
+            continue  # incomplete (a rank died mid-save) or unreadable
+        t = int(m.group(1))
+        if best is None or t > best[0]:
+            best = (t, ranks)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--devcount", type=int, default=2,
+                    help="virtual CPU devices per worker (0 = keep the "
+                         "real platform, e.g. TPU)")
+    ap.add_argument("--n", type=int, default=288)
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--checkpoint-every", type=int, default=8)
+    ap.add_argument("--step-size", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--exchange-impl", choices=("gather", "ring"),
+                    default="gather")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the newest complete per-rank save "
+                         "(assemble + reshard to this federation's size)")
+    args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if args.devcount > 0:
+        _setup_cpu(args.devcount)
+
+    import jax
+    import numpy as np
+
+    import dist_svgd_tpu as dt
+    from dist_svgd_tpu.models.gmm import gmm_logp
+    from dist_svgd_tpu.parallel import multihost
+    from dist_svgd_tpu.utils.checkpoint import (
+        assemble_full_state,
+        read_manifest,
+        reshard_state,
+        save_state,
+    )
+
+    gap = multihost.multiprocess_gap(args.nprocs)
+    if gap is not None:  # the driver refuses earlier; workers double-check
+        print(f"multihost_worker: {gap}", file=sys.stderr)
+        sys.exit(3)
+    assert multihost.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.nprocs,
+        process_id=args.rank,
+    )
+    assert jax.process_count() == args.nprocs
+
+    mesh = multihost.make_particle_mesh()
+    n = args.n
+    start, count = multihost.process_local_rows(n, mesh)
+    # same seed in every process ⇒ one well-defined global init to slice
+    full = np.random.default_rng(args.seed).normal(size=(n, 2))
+    full = full.astype(np.float32)
+    particles = multihost.make_global_particles(
+        full[start : start + count], mesh, n_global=n
+    )
+    ds = dt.DistSampler(
+        mesh.size, lambda th, _: gmm_logp(th), None, particles,
+        exchange_particles=True, exchange_scores=False,
+        include_wasserstein=False,
+        exchange_impl="ring" if args.exchange_impl == "ring" else "gather",
+        mesh=mesh,
+    )
+
+    if args.resume:
+        found = _latest_complete_save(args.root)
+        if found is None:
+            print("multihost_worker: --resume but no complete save under "
+                  f"{args.root}", file=sys.stderr)
+            sys.exit(4)
+        _, rank_paths = found
+        state = assemble_full_state(rank_paths)
+        man = read_manifest(state)
+        if man is not None and man["n_shards"] != mesh.size:
+            state = reshard_state(state, mesh.size)
+        ds.load_state_dict(state)
+
+    step_walls = []
+    while ds.t < args.steps:
+        seg = min(args.checkpoint_every, args.steps - ds.t)
+        w0 = time.perf_counter()
+        ds.run_steps(seg, args.step_size)
+        jax.block_until_ready(ds.particles)
+        step_walls.append((time.perf_counter() - w0) / seg)
+        save_state(
+            os.path.join(args.root, f"step_{ds.t}", f"rank_{args.rank}"),
+            ds.state_dict(),
+        )
+
+    rows, r_start = multihost.host_addressable_block(ds.particles)
+    np.save(os.path.join(args.root, f"final_rows_{args.rank}.npy"), rows)
+    with open(os.path.join(args.root, f"done_rank{args.rank}.json"),
+              "w") as fh:
+        json.dump({
+            "rank": args.rank,
+            "nprocs": args.nprocs,
+            "t": int(ds.t),
+            "row_start": int(r_start),
+            "rows": int(rows.shape[0]),
+            "step_wall_s": float(np.median(step_walls)) if step_walls else None,
+            "updates_per_s": (
+                float(n / np.median(step_walls)) if step_walls else None),
+            "dcn_crossings_per_hop": multihost.dcn_boundary_crossings(mesh),
+        }, fh)
+
+
+if __name__ == "__main__":
+    main()

@@ -116,6 +116,20 @@ A/B timing protocol those notes derived:
   steady-state recompile; its throughput gates against a median+MAD
   window like the other compute rows.
 
+- **cross-host training gates (round 19)** — ``multihost_train``
+  (``tools/multihost_train.py:run_drill``: W-process DCN-mesh training
+  with host-sharded per-process checkpoints and a kill-one-worker elastic
+  resume at W−1 on the same absolute step grid).  Unconditional FAILs:
+  a non-bitwise multi-process-topology resume, a minibatch RNG root that
+  changed across process layouts, steps lost differing from the
+  checkpoint-grid expectation, a kill-one resume diverging past the
+  drill tolerance, or ANY post-restart steady-state recompile.
+  ``multihost_ring_hop_wall_ms`` (ring-exchange wall per hop) and
+  ``multihost_updates_per_s`` (the gather arm) gate against their own
+  median+MAD windows; an honest up-front refusal on a platform that
+  cannot run the federation (``status='unsupported'`` naming the jax
+  version) is reported UNSUPPORTED, not FAILed — the NO_MESH pattern.
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -183,7 +197,29 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
               # the storm rows measure open-loop scheduling + the
               # controller's real-time reactions — the most host-noisy
               # rows in the suite
-              "storm_goodput_2x": 2.0, "storm_recover_s": 2.0}
+              "storm_goodput_2x": 2.0, "storm_recover_s": 2.0,
+              # the multihost walls include cross-process DCN hops and
+              # host checkpoint I/O — as host-noisy as the fleet walls
+              "multihost_ring_hop_wall_ms": 2.0,
+              "multihost_updates_per_s": 2.0}
+
+#: Every row key judged against a median+MAD incumbent window — the
+#: ``--list-missing`` contract: a key listed here with no history in the
+#: incumbents file is a gate that silently cannot fire.  Keep in the order
+#: the rows print.
+WINDOWED_ROWS = (
+    "north_star_ups", "w2_warm_ms_per_step", "covertype_bf16x3_ups",
+    "w2_streaming_100k_ms_per_step", "config1_ups",
+    "phi_kernel_pairs_per_sec",
+    "serve_throughput", "serve_latency_p99",
+    "serve_sharded", "serve_sharded_p99",
+    "serve_multitenant", "serve_multitenant_p99",
+    "elastic_reshard_wall_s", "elastic_recovery_wall_s",
+    "large_n_approx",
+    "storm_goodput_2x", "storm_recover_s",
+    "fleet_detect_s", "fleet_readmit_s", "fleet_federation_scrape_ms",
+    "multihost_ring_hop_wall_ms", "multihost_updates_per_s",
+)
 
 #: Hard ceiling on the span tracer's measured serve-bench cost (round 10):
 #: the interleaved tracer-off/on A/B (``serve_bench.
@@ -264,6 +300,14 @@ def incumbent_history(incumbents: dict, key: str):
         return list(hist)
     legacy = incumbents.get(key)
     return [legacy] if isinstance(legacy, (int, float)) else []
+
+
+def missing_rows(incumbents: dict, expected=WINDOWED_ROWS):
+    """Windowed row keys with NO incumbent history (neither a ``_history``
+    window nor a legacy scalar): their gates return NO_INCUMBENT every run,
+    i.e. they silently cannot fire.  ``--list-missing`` prints these so a
+    recording session knows what it still owes the file."""
+    return [k for k in expected if not incumbent_history(incumbents, k)]
 
 
 def judge_row(value, history, tol, higher_better, mad_scale=MAD_SCALE):
@@ -417,7 +461,21 @@ def main():
     ap.add_argument("--force", action="store_true",
                     help="allow --record even when rows FAIL (deliberately "
                          "lowering the bar, e.g. after a hardware change)")
+    ap.add_argument("--list-missing", action="store_true",
+                    help="print the windowed rows with no incumbent "
+                         "history and exit (works off-TPU: it only reads "
+                         "the incumbents file)")
     args = ap.parse_args()
+
+    if args.list_missing:
+        # before the TPU gate on purpose: auditing the incumbents file
+        # needs no accelerator
+        with open(INCUMBENTS_PATH) as fh:
+            incumbents = json.load(fh)
+        missing = missing_rows(incumbents)
+        print(json.dumps({"windowed_rows": len(WINDOWED_ROWS),
+                          "missing": missing}))
+        sys.exit(0)
 
     import jax
 
@@ -1031,6 +1089,74 @@ def main():
     else:
         row["status"] = "PASS"
     print(json.dumps(row), flush=True)
+
+    # cross-host training gates (round 19): the multihost_train drill —
+    # W-process mesh, host-sharded per-process checkpoints, SIGKILL one
+    # worker, resume at W−1 on the same step grid.  Unconditional FAILs
+    # (multihost_train.row_ok): non-bitwise multi-process-topology resume,
+    # RNG root changed across layouts, steps lost off the checkpoint-grid
+    # expectation, divergent kill-one resume, or any post-restart
+    # steady-state recompile.  The ring-hop wall and gather-arm updates/s
+    # gate against their own median+MAD windows.  A platform that cannot
+    # run the federation refuses up front (status='unsupported' naming
+    # the jax version) — reported UNSUPPORTED like NO_MESH, not FAILed.
+    import multihost_train
+
+    mh_row = multihost_train.run_drill(mode="auto")
+    mh_ok, mh_why = multihost_train.row_ok(mh_row)
+    row = {"bench": "multihost_train", "mode": mh_row.get("mode"),
+           "status_detail": mh_row.get("status")}
+    if mh_row.get("status") == "unsupported":
+        row["status"] = "UNSUPPORTED"
+        row["reason"] = mh_row.get("unsupported_reason")
+        print(json.dumps(row), flush=True)
+    else:
+        row.update({
+            "processes": mh_row.get("processes"),
+            "shards": (f"{mh_row.get('shards')}->"
+                       f"{mh_row.get('shards_after_loss')}"),
+            "dcn_crossings_per_hop": mh_row.get("dcn_crossings_per_hop"),
+            "resume_bitwise": mh_row.get("resume_bitwise"),
+            "rng_layout_free": mh_row.get("rng_layout_free"),
+            "steps_lost": mh_row.get("steps_lost"),
+            "killone_max_dev": mh_row.get("killone_max_dev"),
+            "post_restart_recompiles": mh_row.get(
+                "post_restart_recompiles"),
+            "federation_restarts": mh_row.get("federation_restarts"),
+        })
+        if not mh_ok:
+            row["status"] = "FAIL"
+            row["error"] = "; ".join(mh_why)
+            failures += 1
+        else:
+            row["status"] = "PASS"
+        print(json.dumps(row), flush=True)
+        if mh_ok:
+            for key, field, higher in (
+                    ("multihost_ring_hop_wall_ms", "ring_hop_wall_ms",
+                     False),
+                    ("multihost_updates_per_s", "updates_per_s_gather",
+                     True)):
+                value = mh_row.get(field)
+                row = {"bench": key, "value": value,
+                       "unit": "ms" if key.endswith("_ms")
+                       else "updates/sec"}
+                if value is None:
+                    row["status"] = "FAIL"
+                    row["error"] = f"drill row carried no {field}"
+                    failures += 1
+                else:
+                    tol = min(args.tol * TOL_FACTOR.get(key, 1.0), 0.9)
+                    status, info = judge_row(
+                        value, incumbent_history(incumbents, key), tol,
+                        higher,
+                    )
+                    row.update(info)
+                    row["status"] = status
+                    if status == "FAIL":
+                        failures += 1
+                    results[key] = value
+                print(json.dumps(row), flush=True)
 
     print(json.dumps({
         "summary": "FAIL" if failures else "PASS",

@@ -430,6 +430,97 @@ def make_http_submit(url, max_workers=32):
     return make
 
 
+def make_router_submit(router, max_workers=16):
+    """Fleet transport for replay: each event goes through the round-15
+    ``FleetRouter`` front door (health-gated failover, retries, bounded
+    load) on a pool thread.  ``route`` never raises — a fleet-level 429
+    (every admitted replica shedding) re-raises as ``Overloaded`` so
+    ``replay`` books it shed, any other non-200 raises so it books an
+    error; an admitted request must resolve."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dist_svgd_tpu.serving.batcher import Overloaded
+
+    pool = ThreadPoolExecutor(max_workers=max_workers)
+
+    def post(ev, x):
+        doc = {"inputs": x.tolist()}
+        if ev.tenant is not None:
+            doc["tenant"] = ev.tenant
+        res = router.route(ev.tenant or "default",
+                           json.dumps(doc).encode())
+        if res.status == 429:
+            raise Overloaded("shed by fleet (429)")
+        if res.status != 200:
+            raise RuntimeError(
+                f"fleet answered {res.status} ({res.outcome})")
+        body = res.json()
+        return body.get("outputs") if isinstance(body, dict) else None
+
+    def make(pools):
+        def submit(ev):
+            p = pools[ev.rows]
+            return pool.submit(post, ev, p[ev.pick % len(p)])
+
+        return submit
+
+    make.shutdown = pool.shutdown
+    return make
+
+
+def build_fake_fleet(replicas=3, *, max_replica_rows=64, tenants=(),
+                     probe_interval_s=0.2, registry=None):
+    """A ``FleetRouter`` over in-process ``LoopbackReplica`` stand-ins
+    with a bounded per-replica row budget — the tier-1 seam for replaying
+    a trace through the fleet front door with no sockets or subprocesses
+    (the same fake-transport split ``tools/fleet_drill.py`` uses).  Each
+    replica sheds (429) past ``max_replica_rows`` concurrently in-flight
+    rows, so a flash crowd produces real fleet-level sheds while every
+    admitted request still resolves.  Returns ``(router, close)``."""
+    import threading
+
+    from dist_svgd_tpu.serving import fleet as fleet_mod
+    from dist_svgd_tpu.telemetry import MetricsRegistry
+
+    names = [f"r{i}" for i in range(int(replicas))]
+    transport = fleet_mod.FakeTransport({})
+    lock = threading.Lock()
+    inflight = {n: 0 for n in names}
+
+    def make_predict(name):
+        def predict(inputs, tenant, headers):
+            rows = len(inputs)
+            with lock:
+                if inflight[name] + rows > max_replica_rows:
+                    raise fleet_mod.Shed("replica row budget full",
+                                         retry_after_s=0.05)
+                inflight[name] += rows
+            try:
+                time.sleep(0.0005)  # a realistic (tiny) dispatch floor
+                return {"mean": [0.0] * rows}
+            finally:
+                with lock:
+                    inflight[name] -= rows
+
+        return predict
+
+    for n in names:
+        transport.set_replica(n, fleet_mod.LoopbackReplica(
+            n, predict_fn=make_predict(n), tenants=list(tenants),
+            registry=MetricsRegistry()))
+    reg = registry if registry is not None else MetricsRegistry()
+    replica_set = fleet_mod.ReplicaSet(
+        names, transport, probe_interval_s=probe_interval_s,
+        probe_timeout_s=0.2, fail_threshold=2, passive_fail_threshold=3,
+        open_cooldown_s=0.5, registry=reg)
+    router = fleet_mod.FleetRouter(
+        names, transport=transport, replica_set=replica_set,
+        max_retries=1, per_try_timeout_s=0.5, default_deadline_s=5.0,
+        registry=reg)
+    router.start()
+    return router, router.shutdown
+
+
 # --------------------------------------------------------------------- #
 # the serve_storm row
 
@@ -857,6 +948,10 @@ def main():
     ap.add_argument("--url", default=None,
                     help="replay mode: live serving.server base URL "
                          "(default replays in-process)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="replay mode: route through an N-replica "
+                         "in-process fake fleet (FleetRouter front door) "
+                         "instead of one batcher")
     args = ap.parse_args()
 
     rows = tuple(int(r) for r in args.rows.split(","))
@@ -906,6 +1001,17 @@ def main():
         transport = make_http_submit(args.url)
         records = replay(events, transport(pools))
         transport.shutdown(wait=False)
+    elif args.fleet:
+        pools = serve_bench.request_pool_by_size(
+            args.n_features, rows, per_size=32, seed=args.seed + 1)
+        router, close_fleet = build_fake_fleet(
+            args.fleet, tenants=tuple(f"t{i}" for i in range(args.tenants)))
+        transport = make_router_submit(router)
+        try:
+            records = replay(events, transport(pools))
+        finally:
+            transport.shutdown(wait=False)
+            close_fleet()
     else:
         engine = serve_bench.build_engine(
             args.model, args.n_particles, args.n_features, None, args.seed,
