@@ -48,6 +48,7 @@ from dist_svgd_tpu.resilience.federation import (
 )
 from dist_svgd_tpu.resilience.faults import (
     DeviceLossAt,
+    DriftAt,
     FaultPlan,
     FleetFault,
     HardKillAt,
@@ -102,6 +103,7 @@ __all__ = [
     "FakeWorker",
     "SubprocessWorker",
     "FleetFault",
+    "DriftAt",
     "ReplicaKillAt",
     "ReplicaHangAt",
     "PartitionAt",

@@ -306,6 +306,77 @@ class SlowReplicaAt(FleetFault):
         self.seconds = float(seconds)
 
 
+# --------------------------------------------------------------------- #
+# stream faults (round 20): deterministic distribution shift injected
+# into a streaming data source, consumed by streaming/source.py rather
+# than the supervisor — the unit of failure is the DATA, and the
+# schedule is keyed by the source's batch ordinal (like FleetFault's
+# request ordinal) so every drift-detection/retrain path runs tier-1 on
+# CPU with no real drift to wait for.
+
+
+class DriftAt:
+    """One scheduled distribution-shift window: batches with source
+    ordinal in ``[step, until)`` (``until=None`` → forever) are transformed
+    by a pure, deterministic ``apply`` — so a replayed stream reproduces
+    the drift bitwise (the kill→resume invariant extends through the
+    fault).  Kinds:
+
+    - ``'mean_shift'``: add ``magnitude`` to every feature column — the
+      covariate-shift shape KSD sees as a posterior/data mismatch.
+    - ``'label_flip'``: negate the ±1 labels of a deterministic
+      ``magnitude`` fraction of each batch's rows (strided, not sampled —
+      no RNG, so replay needs no extra state).
+    """
+
+    KINDS = ("mean_shift", "label_flip")
+
+    def __init__(self, step: int, kind: str = "mean_shift",
+                 magnitude: float = 1.0, until: Optional[int] = None):
+        if step < 0:
+            raise ValueError(f"step must be >= 0, got {step}")
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown drift kind {kind!r} "
+                             f"(one of {self.KINDS})")
+        if until is not None and until <= step:
+            raise ValueError(f"until ({until}) must be > step ({step})")
+        if kind == "label_flip" and not 0.0 <= magnitude <= 1.0:
+            raise ValueError(
+                f"label_flip magnitude is a flip fraction in [0, 1], "
+                f"got {magnitude}"
+            )
+        self.step = int(step)
+        self.kind = kind
+        self.magnitude = float(magnitude)
+        self.until = None if until is None else int(until)
+
+    def active(self, ordinal: int) -> bool:
+        return self.step <= ordinal and (self.until is None
+                                         or ordinal < self.until)
+
+    def apply(self, x, y):
+        """Transform one ``(x, y)`` batch (numpy arrays; pure — never
+        mutates its inputs)."""
+        import numpy as np
+
+        if self.kind == "mean_shift":
+            return x + np.asarray(self.magnitude, dtype=x.dtype), y
+        # label_flip: deterministic strided rows — round(frac * n) rows,
+        # evenly spread, replay-stable with zero extra state
+        n = y.shape[0]
+        k = int(round(self.magnitude * n))
+        if k <= 0:
+            return x, y
+        idx = np.linspace(0, n - 1, num=k).round().astype(int)
+        out = np.array(y)
+        out[idx] = -out[idx]
+        return x, out
+
+    def __repr__(self):
+        return (f"DriftAt(step={self.step}, kind={self.kind!r}, "
+                f"magnitude={self.magnitude}, until={self.until})")
+
+
 class FaultPlan:
     """An ordered schedule of faults, consumed by the supervisor at every
     segment boundary.  ``fire_due`` fires every not-yet-fired fault whose

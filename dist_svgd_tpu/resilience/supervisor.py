@@ -598,6 +598,16 @@ class RunSupervisor:
         state["sup_step_size"] = np.asarray(self.step_size, dtype=np.float64)
         return state
 
+    def _apply_resume_state(self, state: dict) -> None:
+        """Restore a checkpoint's supervisor-side state: the harness payload
+        plus the (possibly backed-off) step size.  Subclasses that stamp
+        extra metadata into :meth:`_state_with_meta` extend this — the two
+        methods are one serialisation seam."""
+        self._harness.load_state_dict(state)
+        eps = state.get("sup_step_size")
+        if eps is not None:
+            self.step_size = float(np.asarray(eps))
+
     def _checkpoint(self, tag: str = "periodic") -> Optional[str]:
         if self._manager is None:
             return None
@@ -837,10 +847,7 @@ class RunSupervisor:
         if resume and self._manager is not None:
             state = self._manager.restore_latest()
             if state is not None:
-                self._harness.load_state_dict(state)
-                eps = state.get("sup_step_size")
-                if eps is not None:
-                    self.step_size = float(np.asarray(eps))
+                self._apply_resume_state(state)
                 resumed_from = self._harness.t
                 self._log(event="resume", t=resumed_from,
                           step_size=self.step_size)

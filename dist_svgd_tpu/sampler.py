@@ -302,14 +302,45 @@ class Sampler:
         record_phi_residual(report, registry=registry)
         return report
 
-    def _minibatch_scores(self, parts, key):
+    def _minibatch_scores(self, parts, key, data=None):
         """Stochastic scores: N/B-scaled batch-likelihood gradient (+ unscaled
-        prior gradient when ``log_prior`` is separate)."""
-        batch, scale = draw_minibatch(key, self._data, self._n_rows, self._batch_size)
+        prior gradient when ``log_prior`` is separate).  ``data`` is a traced
+        argument of the jitted scan, NOT a closure constant — baking the
+        dataset in at trace time would silently train on stale rows after
+        :meth:`set_data` (the streaming path's whole point).  Eager callers
+        may omit it to score against the live corpus."""
+        if data is None:
+            data = self._data
+        batch, scale = draw_minibatch(key, data, self._n_rows, self._batch_size)
         scores = scale * jax.vmap(jax.grad(self._logp), in_axes=(0, None))(parts, batch)
         if self._log_prior is not None:
             scores = scores + jax.vmap(jax.grad(self._log_prior))(parts)
         return scores
+
+    def set_data(self, data) -> None:
+        """Swap the minibatch dataset in place (streaming ingest).
+
+        Requires minibatch mode and a replacement with the **identical**
+        pytree structure, leaf shapes, and dtypes — the compiled scan takes
+        data as a traced argument, so a shape-stable swap reuses the cached
+        executable with zero recompiles (streaming sources keep shapes
+        fixed via a capacity-bound ring for exactly this reason).  The
+        eager diagnostics score (``_score_fn``) reads ``self._data`` at
+        call time, so post-swap KSD/ESS judge the posterior against the
+        NEW data."""
+        if self._batch_size is None:
+            raise ValueError("set_data requires minibatch mode (batch_size)")
+        new = jax.tree_util.tree_map(jnp.asarray, data)
+        old_spec = jax.tree_util.tree_map(
+            lambda a: (a.shape, a.dtype), self._data)
+        new_spec = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), new)
+        if old_spec != new_spec:
+            raise ValueError(
+                f"set_data requires an identical data spec (shape/dtype "
+                f"pytree) — a changed spec would retrace the scan; got "
+                f"{new_spec} vs current {old_spec}"
+            )
+        self._data = new
 
     def _resolve_median_kernel(self, particles) -> None:
         """``kernel='median'``: bind an RBF at the median-heuristic bandwidth
@@ -374,32 +405,44 @@ class Sampler:
 
         phi_fn = self._phi
 
-        def one_step(parts, step_size, step_key, step_idx):
+        def one_step(parts, step_size, step_key, step_idx, data):
             # redraw-per-step RFF folds its bank from the same absolute
             # index the minibatch key uses (ops/approx.py:bind_phi_step) —
             # a no-op wrapper for every other φ backend
             phi_t = bind_phi_step(phi_fn, step_idx)
             if minibatch:
-                scores = self._minibatch_scores(parts, step_key)
+                scores = self._minibatch_scores(parts, step_key, data)
                 return parts + step_size * phi_t(parts, parts, scores)
             if update_rule == "jacobi":
                 scores = batched_score(parts)
                 return parts + step_size * phi_t(parts, parts, scores)
             return svgd_step_sequential(parts, self._score_fn, step_size, kernel)
 
-        def scan_run(particles, step_size, batch_key, i0):
+        def scan_body(particles, step_size, batch_key, i0, data):
             # i0 offsets the per-step key fold so a budget-chunked run
             # (dispatch_budget) draws the SAME minibatch stream as one
             # monolithic scan — chunk boundaries are invisible to the RNG
             def body(parts, i):
                 new = one_step(parts, step_size,
-                               jax.random.fold_in(batch_key, i0 + i), i0 + i)
+                               jax.random.fold_in(batch_key, i0 + i),
+                               i0 + i, data)
                 if record:
                     return new, parts  # pre-update snapshot (reference convention)
                 return new, None
 
             final, hist = lax.scan(body, particles, jnp.arange(num_iter))
             return final, hist
+
+        if minibatch:
+            # minibatch mode traces the dataset as a real argument so
+            # set_data swaps rows without invalidating this cache entry —
+            # a closure-captured dataset would be baked into the
+            # executable as a constant (stale-data hazard)
+            def scan_run(particles, step_size, batch_key, i0, data):
+                return scan_body(particles, step_size, batch_key, i0, data)
+        else:
+            def scan_run(particles, step_size, batch_key, i0):
+                return scan_body(particles, step_size, batch_key, i0, None)
 
         # carry donation (ROADMAP item 1): the particle buffer aliases the
         # output at every dispatch — run() owns/copies the input, so no
@@ -520,13 +563,17 @@ class Sampler:
             steps_per_dispatch = min(
                 steps_per_dispatch, _history.record_chunk_steps(n, self._d)
             )
+        # the minibatch scan takes the dataset as a traced trailing arg
+        # (set_data swaps rows without a retrace); full-data modes keep the
+        # 4-arg signature
+        extra = ((self._data,) if self._batch_size is not None else ())
         if steps_per_dispatch >= num_iter:
             run = self._run_fn(num_iter, record)
             with _trace.span("train.step_chunk",
                              {"steps": num_iter, "execution": "monolithic"}
                              if _trace.enabled() else None):
                 final, hist = run(particles, eps, bkey,
-                                  jnp.asarray(step_offset, jnp.int32))
+                                  jnp.asarray(step_offset, jnp.int32), *extra)
             self.last_run_stats = {
                 "execution": "monolithic", "num_steps": num_iter,
                 "num_dispatches": 1,
@@ -554,7 +601,8 @@ class Sampler:
             with _trace.span("train.step_chunk", {"steps": csize}
                              if _trace.enabled() else None):
                 final, hist = run(final, eps, bkey,
-                                  jnp.asarray(step_offset + done, jnp.int32))
+                                  jnp.asarray(step_offset + done, jnp.int32),
+                                  *extra)
             if record:
                 if pending is not None:
                     hists.append(np.asarray(pending))

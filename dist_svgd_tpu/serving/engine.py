@@ -44,6 +44,7 @@ from __future__ import annotations
 import math
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -254,6 +255,10 @@ class PredictiveEngine:
             "padding-bucket kernel-cache misses (one XLA trace each)")
         self._m_reloads = reg.counter(
             "svgd_engine_reloads_total", "hot ensemble swaps")
+        self._m_reload_wall = reg.histogram(
+            "svgd_engine_reload_wall_s",
+            "wall per hot ensemble swap (policy judge + kernel rebuild + "
+            "warm + pointer exchange) — the freshness budget's reload leg")
         self._m_reload_rejects = reg.counter(
             "svgd_engine_reload_rejected_total",
             "hot reloads refused by the ensemble-health policy")
@@ -567,7 +572,20 @@ class PredictiveEngine:
         ensemble); the feature layout may not — a reload can never
         repurpose a server to a different model shape.  Returns a summary
         dict; ``tag`` labels the generation in :meth:`stats`.
+
+        Each call runs inside a ``reload`` span (the hot-reload lane's
+        child leg) and an admitted swap's wall lands in the
+        ``svgd_engine_reload_wall_s`` histogram — the freshness budget's
+        reload leg is attributed, not inferred.
         """
+        t0 = time.perf_counter()
+        with _trace.span("reload", {"tag": tag}):
+            info = self._reload_inner(particles, warm=warm, tag=tag)
+        self._m_reload_wall.observe(time.perf_counter() - t0)
+        return info
+
+    def _reload_inner(self, particles, *, warm: bool,
+                      tag: Optional[str]) -> Dict[str, Any]:
         particles = jnp.asarray(particles)
         if particles.ndim != 2 or particles.shape[1] != self._particles.shape[1]:
             raise ValueError(
@@ -754,6 +772,15 @@ class CheckpointHotReloader:
                                  reasons=e.reasons)
             return None
         self.loaded_step = step
+        wm = state.get("stream_watermark")
+        if wm is not None:
+            # streaming checkpoints stamp their data watermark: once this
+            # generation serves, predictions reflect events up to `wm` —
+            # the serving half of the freshness SLO's gauge pair
+            self.engine.registry.gauge(
+                "svgd_serving_watermark",
+                "event-time data watermark of the served ensemble",
+            ).set(float(np.asarray(wm)), **self.engine._tlabels)
         if self._logger is not None:
             self._logger.log(event="hot_reload", step=step, **info)
         return step

@@ -99,8 +99,10 @@ __all__ = [
     "RatioObjective",
     "GaugeCeiling",
     "StalenessObjective",
+    "FreshnessObjective",
     "default_serving_slos",
     "default_training_slos",
+    "default_streaming_slos",
 ]
 
 _LAZY = {
@@ -113,8 +115,10 @@ _LAZY = {
     "RatioObjective": "slo",
     "GaugeCeiling": "slo",
     "StalenessObjective": "slo",
+    "FreshnessObjective": "slo",
     "default_serving_slos": "slo",
     "default_training_slos": "slo",
+    "default_streaming_slos": "slo",
 }
 
 

@@ -43,6 +43,7 @@ __all__ = [
     "RatioObjective",
     "GaugeCeiling",
     "StalenessObjective",
+    "FreshnessObjective",
     "SloEngine",
     "HistogramWindow",
     "CounterWindow",
@@ -50,6 +51,7 @@ __all__ = [
     "bucket_quantile",
     "default_serving_slos",
     "default_training_slos",
+    "default_streaming_slos",
 ]
 
 OK = "ok"
@@ -427,6 +429,56 @@ class StalenessObjective(_Objective):
         return row
 
 
+class FreshnessObjective(_Objective):
+    """Served predictions must not lag ingested data by more than
+    ``max_lag_s`` of **event time** — the streaming pipeline's end-to-end
+    SLO (round 20).
+
+    Reads a watermark gauge *pair*: ``ingest_gauge`` (event time of the
+    newest ingested batch — ``svgd_stream_watermark``) and
+    ``served_gauge`` (event-time watermark of the generation actually
+    serving — ``svgd_serving_watermark``, stamped by the hot reloader).
+    The lag is ``max(ingest − served, 0)``: a served watermark at or
+    ahead of ingest (a replayed stream, an idle source) is perfectly
+    fresh, exactly like :class:`StalenessObjective`'s backwards-clock
+    clamp.  Either gauge never set → ``no_data`` (a pipeline that has not
+    published yet is not breaching)."""
+
+    def __init__(self, name: str, max_lag_s: float, *,
+                 ingest_gauge: str = "svgd_stream_watermark",
+                 served_gauge: str = "svgd_serving_watermark",
+                 labels: Optional[dict] = None):
+        super().__init__(name)
+        if max_lag_s <= 0:
+            raise ValueError(f"max_lag_s must be positive, got {max_lag_s}")
+        self.max_lag_s = float(max_lag_s)
+        self.ingest_gauge = ingest_gauge
+        self.served_gauge = served_gauge
+        self.labels = dict(labels or {})
+
+    def evaluate(self, registry: MetricsRegistry, now_s: float) -> Dict:
+        ingest = registry._metrics.get(self.ingest_gauge)
+        served = registry._metrics.get(self.served_gauge)
+        row = {"objective": "freshness", "ingest_gauge": self.ingest_gauge,
+               "served_gauge": self.served_gauge,
+               "max_lag_s": self.max_lag_s}
+        # the served watermark may carry tenant labels while the ingest
+        # side is unlabelled (single trainer, many tenants) — each gauge
+        # is judged under its own label set
+        if (ingest is None or not ingest.has()
+                or served is None or not served.has(**self.labels)):
+            row.update(status=NO_DATA, burn_rate=0.0)
+            return row
+        lag = max(ingest.value() - served.value(**self.labels), 0.0)
+        burn = lag / self.max_lag_s
+        row.update(
+            status=BREACH if lag > self.max_lag_s else OK,
+            burn_rate=round(burn, 4),
+            lag_s=round(lag, 3),
+        )
+        return row
+
+
 class SloEngine:
     """Evaluates a fixed objective list against one registry.
 
@@ -555,3 +607,21 @@ def default_training_slos(registry: MetricsRegistry, *,
         objectives.append(StalenessObjective(
             "diag_freshness", "svgd_diag_last_update_ts", diag_max_age_s))
     return SloEngine(registry, objectives, clock=clock)
+
+
+def default_streaming_slos(registry: MetricsRegistry, *,
+                           max_lag_s: float = 60.0,
+                           drop_budget: float = 0.0,
+                           labels: Optional[dict] = None,
+                           mirror_metrics: bool = True,
+                           clock: Callable[[], float] = time.time) -> SloEngine:
+    """The streaming pipeline's objective set: served predictions within
+    ``max_lag_s`` of ingested event time (:class:`FreshnessObjective` over
+    the watermark gauge pair), and stream drops within ``drop_budget`` per
+    pulled batch (the default budget is ZERO — a dropped batch is lost
+    data, the freshness gate's unconditional-FAIL condition)."""
+    return SloEngine(registry, [
+        FreshnessObjective("freshness", max_lag_s, labels=labels),
+        RatioObjective("stream_drop_rate", "svgd_stream_dropped_total",
+                       "svgd_stream_batches_total", drop_budget),
+    ], clock=clock, mirror_metrics=mirror_metrics)

@@ -22,11 +22,50 @@ mode, ``tpu``-marked tests auto-skip; in TPU mode, everything else is
 deselected (the CPU-mesh suite must not run against the tunnel).
 """
 
+import json
 import os
 
 import pytest
 
 TPU_TIER = os.environ.get("DSVGD_TPU_TESTS") == "1"
+
+#: Per-test call-phase wall clock, collected for every test that ran this
+#: session.  tests/test_wall_budget.py (reordered to run LAST below) FAILs
+#: the tier if any non-slow test exceeds the budget — one runaway test is
+#: how a 15-minute tier-1 budget dies quietly.
+DURATIONS = {}
+WALL_BUDGET_S = 15.0
+#: Known-heavy tests with an explicit, named allowance.  Adding a line here
+#: is a reviewed decision; the default budget never creeps to absorb one
+#: outlier.  The 3-arm mini storm replays the same trace through three
+#: controller configurations end to end — inherently ~3x a normal test.
+WALL_BUDGET_ALLOW_S = {
+    "tests/test_workload_replay.py::test_mini_storm_adaptive_arm_schema_and_gates": 25.0,
+}
+DURATIONS_ARTIFACT = os.path.join(os.path.dirname(__file__),
+                                  ".test_durations.json")
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        entry = DURATIONS.setdefault(
+            report.nodeid, {"duration": 0.0,
+                            "slow": "slow" in report.keywords})
+        entry["duration"] += report.duration
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # the --durations report, as a machine-readable artifact: slowest
+    # first, so a budget regression names its culprit without a rerun
+    rows = sorted(
+        ({"test": nid, **meta} for nid, meta in DURATIONS.items()),
+        key=lambda r: -r["duration"])
+    try:
+        with open(DURATIONS_ARTIFACT, "w") as f:
+            json.dump({"wall_budget_s": WALL_BUDGET_S, "tests": rows}, f,
+                      indent=1)
+    except OSError:
+        pass  # a read-only checkout must not fail the run
 
 if not TPU_TIER:
     import _jax_env
@@ -53,3 +92,9 @@ def pytest_collection_modifyitems(config, items):
         for item in items:
             if "tpu" in item.keywords:
                 item.add_marker(skip)
+    # the wall-budget assertion must observe every other test's duration,
+    # so it runs last regardless of collection order
+    tail = [i for i in items if "test_wall_budget" in i.nodeid]
+    if tail:
+        items[:] = [i for i in items
+                    if "test_wall_budget" not in i.nodeid] + tail

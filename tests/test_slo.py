@@ -1,0 +1,138 @@
+"""telemetry/slo.py edge semantics: timestamp staleness (never-set
+watermark, backwards clock, exactly-at-threshold) and the streaming
+freshness objective's watermark-pair reading.  All clocks injected —
+no sleeps, no real time."""
+
+import numpy as np  # noqa: F401  (kept for parity with the suite idiom)
+
+import pytest
+
+from dist_svgd_tpu.telemetry import MetricsRegistry
+from dist_svgd_tpu.telemetry.slo import (
+    FreshnessObjective,
+    SloEngine,
+    StalenessObjective,
+    default_streaming_slos,
+)
+
+
+# --------------------------------------------------------------------- #
+# staleness: a unix-timestamp gauge must be at most max_age_s old
+
+
+def test_staleness_never_set_gauge_is_no_data_not_breach():
+    reg = MetricsRegistry()
+    obj = StalenessObjective("ckpt_fresh", "svgd_ckpt_ts", max_age_s=60.0)
+    row = obj.evaluate(reg, now_s=1000.0)
+    assert row["status"] == "no_data" and row["burn_rate"] == 0.0
+    # gauge exists but was never .set(): still no_data
+    reg.gauge("svgd_ckpt_ts")
+    assert obj.evaluate(reg, now_s=1000.0)["status"] == "no_data"
+    # the engine's overall verdict stays ok on no_data objectives
+    eng = SloEngine(reg, [obj], clock=lambda: 1000.0)
+    assert eng.evaluate()["status"] == "ok"
+
+
+def test_staleness_backwards_watermark_clamps_to_zero_age():
+    reg = MetricsRegistry()
+    reg.gauge("svgd_ckpt_ts").set(2000.0)  # stamped ahead of "now"
+    obj = StalenessObjective("ckpt_fresh", "svgd_ckpt_ts", max_age_s=60.0)
+    row = obj.evaluate(reg, now_s=1000.0)
+    assert row["status"] == "ok"
+    assert row["age_s"] == 0.0 and row["burn_rate"] == 0.0
+
+
+def test_staleness_exactly_at_threshold_is_ok_past_is_breach():
+    reg = MetricsRegistry()
+    reg.gauge("svgd_ckpt_ts").set(1000.0)
+    obj = StalenessObjective("ckpt_fresh", "svgd_ckpt_ts", max_age_s=60.0)
+    at = obj.evaluate(reg, now_s=1060.0)  # age == max_age_s exactly
+    assert at["status"] == "ok" and at["burn_rate"] == 1.0
+    past = obj.evaluate(reg, now_s=1060.5)
+    assert past["status"] == "breach" and past["burn_rate"] > 1.0
+    # the injected engine clock drives the same verdict end to end
+    now = {"t": 1060.0}
+    eng = SloEngine(reg, [obj], clock=lambda: now["t"])
+    assert eng.evaluate()["status"] == "ok"
+    now["t"] = 1061.0
+    assert eng.evaluate()["status"] == "breach"
+    assert reg.counter("svgd_slo_breaches_total").value(
+        slo="ckpt_fresh") == 1.0
+
+
+def test_staleness_rejects_nonpositive_threshold():
+    with pytest.raises(ValueError, match="max_age_s"):
+        StalenessObjective("x", "g", max_age_s=0.0)
+
+
+# --------------------------------------------------------------------- #
+# freshness: served watermark within max_lag_s of the ingest watermark
+
+
+def test_freshness_no_data_until_both_watermarks_set():
+    reg = MetricsRegistry()
+    obj = FreshnessObjective("freshness", 60.0)
+    assert obj.evaluate(reg, now_s=0.0)["status"] == "no_data"
+    reg.gauge("svgd_stream_watermark").set(100.0)
+    assert obj.evaluate(reg, now_s=0.0)["status"] == "no_data"
+    reg.gauge("svgd_serving_watermark").set(80.0)
+    row = obj.evaluate(reg, now_s=0.0)
+    assert row["status"] == "ok" and row["lag_s"] == 20.0
+
+
+def test_freshness_served_ahead_of_ingest_clamps_fresh():
+    # a replayed/idle stream can leave serving ahead of ingest — that is
+    # perfectly fresh, not negative lag
+    reg = MetricsRegistry()
+    reg.gauge("svgd_stream_watermark").set(100.0)
+    reg.gauge("svgd_serving_watermark").set(500.0)
+    row = FreshnessObjective("freshness", 60.0).evaluate(reg, now_s=0.0)
+    assert row["status"] == "ok"
+    assert row["lag_s"] == 0.0 and row["burn_rate"] == 0.0
+
+
+def test_freshness_exactly_at_threshold_is_ok_past_is_breach():
+    reg = MetricsRegistry()
+    reg.gauge("svgd_stream_watermark").set(160.0)
+    reg.gauge("svgd_serving_watermark").set(100.0)
+    obj = FreshnessObjective("freshness", 60.0)
+    at = obj.evaluate(reg, now_s=0.0)  # lag == max_lag_s exactly
+    assert at["status"] == "ok" and at["burn_rate"] == 1.0
+    reg.gauge("svgd_stream_watermark").set(160.5)
+    past = obj.evaluate(reg, now_s=0.0)
+    assert past["status"] == "breach" and past["lag_s"] == 60.5
+
+
+def test_freshness_labeled_served_gauge_judged_under_own_labels():
+    reg = MetricsRegistry()
+    reg.gauge("svgd_stream_watermark").set(100.0)
+    reg.gauge("svgd_serving_watermark").set(90.0, tenant="a")
+    # unlabelled objective does not see tenant-labelled series → no_data
+    plain = FreshnessObjective("freshness", 60.0)
+    assert plain.evaluate(reg, now_s=0.0)["status"] == "no_data"
+    scoped = FreshnessObjective("freshness", 60.0,
+                                labels={"tenant": "a"})
+    row = scoped.evaluate(reg, now_s=0.0)
+    assert row["status"] == "ok" and row["lag_s"] == 10.0
+
+
+def test_freshness_rejects_nonpositive_threshold():
+    with pytest.raises(ValueError, match="max_lag_s"):
+        FreshnessObjective("freshness", 0.0)
+
+
+def test_default_streaming_slos_zero_drop_budget_breaches_on_loss():
+    reg = MetricsRegistry()
+    reg.gauge("svgd_stream_watermark").set(10.0)
+    reg.gauge("svgd_serving_watermark").set(10.0)
+    reg.counter("svgd_stream_batches_total").inc(10)
+    eng = default_streaming_slos(reg, max_lag_s=60.0, clock=lambda: 0.0)
+    doc = eng.evaluate()
+    assert doc["status"] == "ok"
+    assert set(doc["objectives"]) == {"freshness", "stream_drop_rate"}
+    # one dropped batch against the ZERO budget breaches immediately
+    reg.counter("svgd_stream_dropped_total").inc()
+    reg.counter("svgd_stream_batches_total").inc()
+    doc = eng.evaluate()
+    assert doc["objectives"]["stream_drop_rate"]["status"] == "breach"
+    assert doc["status"] == "breach"

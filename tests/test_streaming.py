@@ -1,0 +1,388 @@
+"""Streaming subsystem (dist_svgd_tpu/streaming/): seeded event-time
+sources with deterministic drift windows, the bounded ingest buffer's
+loud drop accounting, the fixed-shape RowRing corpus, and the
+StreamingSupervisor's segment lifecycle — bitwise kill→resume mid-stream,
+drift-triggered re-fit escalation, rejected hot reloads rolling back, and
+zero steady-state recompiles.  Everything runs on CPU with manual clocks
+(the measured real-clock loop lives in tools/freshness_drill.py)."""
+
+import numpy as np
+import pytest
+
+import dist_svgd_tpu as dt
+from dist_svgd_tpu.models.logreg import make_logreg_split
+from dist_svgd_tpu.resilience import DriftAt, GuardConfig
+from dist_svgd_tpu.streaming import (
+    CovertypeReplayStream,
+    GrowingCorpusStream,
+    LabelFlipStream,
+    MeanShiftStream,
+    RowRing,
+    StreamBuffer,
+    StreamingSupervisor,
+)
+from dist_svgd_tpu.telemetry import MetricsRegistry
+from dist_svgd_tpu.telemetry.diagnostics import (
+    DiagnosticsConfig,
+    PosteriorDiagnostics,
+    ReloadPolicy,
+)
+
+
+class ManualClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt_s):
+        self.t += dt_s
+
+
+def no_sleep(_s):
+    pass
+
+
+# --------------------------------------------------------------------- #
+# sources: purity, event-time arithmetic, drift windows
+
+
+def test_source_batches_pure_and_timestamped():
+    s = GrowingCorpusStream(batch_rows=8, dim=3, seed=7, period_s=2.0,
+                            start_time=10.0)
+    a, b = s.batch_at(5), s.batch_at(5)
+    assert np.array_equal(a.x, b.x) and np.array_equal(a.y, b.y)
+    assert a.event_time == 10.0 + 5 * 2.0
+    assert set(np.unique(a.y)) <= {-1.0, 1.0}
+    # a second instance with the same seed replays the same bytes
+    s2 = GrowingCorpusStream(batch_rows=8, dim=3, seed=7, period_s=2.0,
+                             start_time=10.0)
+    assert np.array_equal(s2.batch_at(5).x, a.x)
+    # different ordinals are independent draws
+    assert not np.array_equal(s.batch_at(6).x, a.x)
+    # due() is inclusive at the event time
+    assert not s.due(5, 19.999)
+    assert s.due(5, 20.0)
+
+
+def test_drifting_generators_shift_and_flip():
+    base = GrowingCorpusStream(batch_rows=64, dim=4, seed=1)
+    shift = MeanShiftStream(batch_rows=64, dim=4, seed=1, rate=0.5)
+    assert np.array_equal(shift.batch_at(0).x, base.batch_at(0).x)
+    d = shift.batch_at(6).x - base.batch_at(6).x
+    assert np.allclose(d, 3.0, atol=1e-6)
+    flip = LabelFlipStream(batch_rows=64, dim=4, seed=1, rate=0.1,
+                           max_frac=0.3)
+    flipped = np.sum(flip.batch_at(2).y != base.batch_at(2).y)
+    assert flipped == round(0.2 * 64)
+    capped = np.sum(flip.batch_at(9).y != base.batch_at(9).y)
+    assert capped == round(0.3 * 64)
+
+
+def test_drift_fault_window_applies_only_inside():
+    fault = DriftAt(2, kind="mean_shift", magnitude=5.0, until=4)
+    clean = GrowingCorpusStream(batch_rows=8, dim=3, seed=3)
+    faulty = GrowingCorpusStream(batch_rows=8, dim=3, seed=3,
+                                 faults=(fault,))
+    for o in (0, 1, 4, 5):
+        assert np.array_equal(faulty.batch_at(o).x, clean.batch_at(o).x)
+    for o in (2, 3):
+        assert np.allclose(faulty.batch_at(o).x - clean.batch_at(o).x, 5.0)
+    # faults replay bitwise too
+    again = GrowingCorpusStream(batch_rows=8, dim=3, seed=3,
+                                faults=(DriftAt(2, kind="mean_shift",
+                                                magnitude=5.0, until=4),))
+    assert np.array_equal(again.batch_at(3).x, faulty.batch_at(3).x)
+
+
+def test_drift_fault_label_flip_and_validation():
+    clean = GrowingCorpusStream(batch_rows=10, dim=2, seed=0)
+    flip = GrowingCorpusStream(
+        batch_rows=10, dim=2, seed=0,
+        faults=(DriftAt(0, kind="label_flip", magnitude=0.5),))
+    b, fb = clean.batch_at(0), flip.batch_at(0)
+    assert np.array_equal(b.x, fb.x)
+    assert np.sum(b.y != fb.y) == 5
+    with pytest.raises(ValueError, match="unknown drift kind"):
+        DriftAt(0, kind="spin")
+    with pytest.raises(ValueError, match="flip fraction"):
+        DriftAt(0, kind="label_flip", magnitude=1.5)
+    with pytest.raises(ValueError, match="until"):
+        DriftAt(5, until=5)
+    with pytest.raises(TypeError, match="DriftAt"):
+        GrowingCorpusStream(batch_rows=4, dim=2, faults=(object(),))
+
+
+def test_bounded_replay_source_exhausts_loudly():
+    s = CovertypeReplayStream(n_rows=100, batch_rows=32, seed=0)
+    assert s.num_batches == 3
+    assert s.due(2, 1e9) and not s.due(3, 1e9)
+    with pytest.raises(IndexError, match="past the bounded source"):
+        s.batch_at(3)
+    # replay slices are row-order contiguous
+    b0, b1 = s.batch_at(0), s.batch_at(1)
+    assert b0.x.shape == (32, s.dim)
+    assert not np.array_equal(b0.x, b1.x)
+
+
+# --------------------------------------------------------------------- #
+# buffer: loud drop-oldest, watermark accounting
+
+
+def test_buffer_drops_oldest_loudly_never_silently():
+    reg = MetricsRegistry()
+    clock = ManualClock(0.0)
+    s = GrowingCorpusStream(batch_rows=4, dim=2, seed=0, period_s=1.0,
+                            start_time=1.0)
+    buf = StreamBuffer(s, capacity=2, registry=reg, clock=clock)
+    clock.advance(5.0)  # ordinals 0..4 all due at once
+    assert buf.poll() == 5
+    assert buf.dropped == 3
+    assert reg.counter("svgd_stream_dropped_total").value() == 3.0
+    assert reg.counter("svgd_stream_batches_total").value() == 5.0
+    kept = buf.take()
+    assert [b.ordinal for b in kept] == [3, 4]  # newest survive, in order
+    assert buf.watermark == s.event_time(4)
+    assert reg.gauge("svgd_stream_watermark").value() == s.event_time(4)
+    assert len(buf) == 0
+    # nothing new due → no-op poll
+    assert buf.poll() == 0 and buf.dropped == 3
+
+
+def test_buffer_seek_fast_forwards_cursor():
+    clock = ManualClock(100.0)
+    s = GrowingCorpusStream(batch_rows=4, dim=2, seed=0, period_s=1.0)
+    buf = StreamBuffer(s, capacity=64, registry=MetricsRegistry(),
+                       clock=clock)
+    buf.seek(50)
+    buf.poll()
+    assert [b.ordinal for b in buf.take()][0] == 50
+    buf.seek(10)  # seek never rewinds
+    assert buf.next_ordinal > 50
+
+
+# --------------------------------------------------------------------- #
+# RowRing: constant shapes forever
+
+
+def test_row_ring_tiles_then_slides():
+    ring = RowRing(8, 2)
+    with pytest.raises(ValueError, match="before any rows"):
+        ring.data()
+    x0 = np.arange(6, dtype=np.float32).reshape(3, 2)
+    ring.extend(x0, np.array([1.0, -1.0, 1.0]))
+    x, y = ring.data()
+    assert x.shape == (8, 2) and y.shape == (8,)  # tiled to capacity
+    assert np.array_equal(x[:3], x0) and np.array_equal(x[3:6], x0)
+    # fill past capacity → exact sliding window of the newest 8 rows
+    x1 = np.arange(100, 120, dtype=np.float32).reshape(10, 2)
+    ring.extend(x1, np.ones(10))
+    x, y = ring.data()
+    assert x.shape == (8, 2)
+    assert set(map(tuple, x)) == set(map(tuple, x1[-8:]))
+    assert ring.written == 13
+
+
+def test_row_ring_oversized_extend_keeps_newest():
+    ring = RowRing(4, 1)
+    ring.extend(np.arange(10, dtype=np.float32).reshape(10, 1),
+                np.ones(10))
+    x, _ = ring.data()
+    assert sorted(x.ravel().tolist()) == [6.0, 7.0, 8.0, 9.0]
+    assert ring.written == 10
+
+
+def test_row_ring_state_roundtrip_bitwise():
+    ring = RowRing(5, 3)
+    rng = np.random.default_rng(0)
+    ring.extend(rng.normal(size=(7, 3)).astype(np.float32),
+                np.ones(7))
+    state = ring.state_dict()
+    other = RowRing(5, 3)
+    other.load_state_dict(state)
+    for a, b in zip(ring.data(), other.data()):
+        assert np.array_equal(a, b)
+    wrong = RowRing(6, 3)
+    with pytest.raises(ValueError, match="ring checkpoint shape"):
+        wrong.load_state_dict(state)
+    ring.extend(np.zeros((1, 3), np.float32), np.ones(1))
+    assert not np.array_equal(ring.data()[0], other.data()[0])
+
+
+def test_row_ring_rejects_bad_shapes():
+    ring = RowRing(4, 3)
+    with pytest.raises(ValueError, match="expected x"):
+        ring.extend(np.zeros((2, 2), np.float32), np.ones(2))
+    with pytest.raises(ValueError, match="expected x"):
+        ring.extend(np.zeros((2, 3), np.float32), np.ones(3))
+
+
+# --------------------------------------------------------------------- #
+# pipeline: segment lifecycle on a tiny stack
+
+DIM = 3
+ROWS = 16
+CORPUS = 32
+
+
+def _stack(root, clock, registry, *, seed=0, faults=(), reloader=None,
+           diag=None, steps=2, refit_steps=6, n=16):
+    source = GrowingCorpusStream(batch_rows=ROWS, dim=DIM, seed=seed,
+                                 period_s=1.0, start_time=1.0,
+                                 faults=faults)
+    buffer = StreamBuffer(source, capacity=8, registry=registry,
+                          clock=clock)
+    ring = RowRing(CORPUS, DIM)
+    likelihood, prior = make_logreg_split()
+    sampler = dt.Sampler(
+        DIM + 1, likelihood, kernel=dt.RBF(1.0),
+        data=(np.zeros((CORPUS, DIM), np.float32),
+              np.ones((CORPUS,), np.float64)),
+        batch_size=8, log_prior=prior)
+    sup = StreamingSupervisor(
+        sampler, 0.05, buffer=buffer, ring=ring, steps_per_segment=steps,
+        refit_steps=refit_steps, drift_diagnostics=diag, reloader=reloader,
+        checkpoint_dir=str(root), checkpoint_every=steps,
+        segment_steps=steps, n=n, seed=seed, registry=registry,
+        clock=clock, sleep=no_sleep)
+    return source, buffer, sup
+
+
+def test_streaming_supervisor_rejects_fulldata_sampler(tmp_path):
+    likelihood, prior = make_logreg_split()
+    full = dt.Sampler(DIM + 1, likelihood, kernel=dt.RBF(1.0),
+                      data=(np.zeros((8, DIM), np.float32),
+                            np.ones((8,), np.float64)),
+                      log_prior=prior)
+    with pytest.raises(ValueError, match="minibatch"):
+        StreamingSupervisor(
+            full, 0.05, buffer=None, ring=None, steps_per_segment=2,
+            checkpoint_dir=str(tmp_path), segment_steps=2, n=8)
+
+
+def test_segment_ingests_trains_checkpoints(tmp_path):
+    reg = MetricsRegistry()
+    clock = ManualClock(0.0)
+    _, buffer, sup = _stack(tmp_path, clock, reg)
+    clock.advance(2.0)  # ordinals 0 and 1 due
+    seg = sup.run_segment_once()
+    assert seg["batches"] == 2 and seg["rows"] == 2 * ROWS
+    assert seg["t"] == 2 and seg["steps"] == 2
+    assert seg["watermark"] == 2.0
+    assert seg["dropped_total"] == 0
+    assert reg.counter("svgd_stream_segments_total").value() == 1.0
+    assert reg.counter("svgd_stream_rows_total").value() == 2 * ROWS
+    # a segment with no due batches still trains on the held corpus
+    seg2 = sup.run_segment_once()
+    assert seg2["batches"] == 0 and seg2["t"] == 4
+
+
+def test_bitwise_kill_resume_mid_stream(tmp_path):
+    def run(root, n_segments, *, resume_first=False, t0=0.0):
+        reg = MetricsRegistry()
+        clock = ManualClock(t0)
+        _, buffer, sup = _stack(root, clock, reg)
+        for i in range(n_segments):
+            clock.advance(1.0)
+            sup.run_segment_once(resume=(resume_first and i == 0))
+        return np.asarray(sup.particles), sup.t, buffer.next_ordinal, clock.t
+
+    root_a = tmp_path / "a"
+    root_b = tmp_path / "b"
+    p_a, t_a, ord_a, _ = run(root_a, 4)
+    # run B: 2 segments, hard kill (process state dropped), cold resume
+    _, _, _, t_kill = run(root_b, 2)
+    p_b, t_b, ord_b, _ = run(root_b, 2, resume_first=True, t0=t_kill)
+    assert t_b == t_a and ord_b == ord_a
+    assert np.array_equal(p_b, p_a)  # bitwise, not just close
+
+
+def test_drift_breach_escalates_to_refit(tmp_path):
+    reg = MetricsRegistry()
+    clock = ManualClock(0.0)
+    diag = PosteriorDiagnostics(
+        DiagnosticsConfig(every_steps=1, row_chunk=32, max_points=32),
+        registry=reg)
+    _, _, sup = _stack(tmp_path, clock, reg, diag=diag)
+    clock.advance(1.0)
+    first = sup.run_segment_once()  # detector unarmed at t=0
+    assert not first["refit"]
+    # arm an always-trip guard: any finite (or NaN) KSD breaches
+    sup.drift_guard = GuardConfig(max_ksd=-1.0)
+    assert sup.drift_guard.max_ksd == -1.0
+    clock.advance(1.0)
+    seg = sup.run_segment_once()
+    assert seg["refit"] and seg["drift"].startswith("posterior drift")
+    assert seg["steps"] == 6  # refit_steps, not steps_per_segment
+    assert reg.counter("svgd_stream_refits_total").value() == 1.0
+    # disarm → back to incremental segments
+    sup.drift_guard = None
+    clock.advance(1.0)
+    assert not sup.run_segment_once()["refit"]
+
+
+def test_segment_publishes_through_hot_reloader(tmp_path):
+    from dist_svgd_tpu.serving import CheckpointHotReloader, PredictiveEngine
+    from dist_svgd_tpu.utils.rng import as_key, init_particles
+
+    reg = MetricsRegistry()
+    clock = ManualClock(0.0)
+    engine = PredictiveEngine(
+        "logreg", np.asarray(init_particles(as_key(0), 16, DIM + 1)),
+        min_bucket=4, max_bucket=8, registry=reg)
+    reloader = CheckpointHotReloader(engine, str(tmp_path), key="particles")
+    _, _, sup = _stack(tmp_path, clock, reg, reloader=reloader)
+    clock.advance(1.0)
+    seg = sup.run_segment_once()
+    assert seg["reload_step"] == seg["t"]
+    assert not seg["reload_rejected"]
+    assert seg["freshness_s"] is not None and seg["freshness_s"] >= 0.0
+    assert engine.stats()["ensemble_tag"] == f"step_{seg['t']}"
+    # the served generation stamps the serving watermark — the freshness
+    # SLO's second gauge
+    assert reg.gauge("svgd_serving_watermark").value() == seg["watermark"]
+    assert reg.histogram("svgd_freshness_seconds").summary()["count"] == 1
+
+
+def test_rejected_reload_rolls_back_never_forward(tmp_path):
+    from dist_svgd_tpu.serving import CheckpointHotReloader, PredictiveEngine
+    from dist_svgd_tpu.utils.rng import as_key, init_particles
+
+    reg = MetricsRegistry()
+    clock = ManualClock(0.0)
+    # an impossible health floor: every candidate generation is rejected
+    engine = PredictiveEngine(
+        "logreg", np.asarray(init_particles(as_key(0), 16, DIM + 1)),
+        min_bucket=4, max_bucket=8, registry=reg,
+        reload_policy=ReloadPolicy(min_ess_frac=1.5, max_points=16))
+    tag0 = engine.stats()["ensemble_tag"]
+    reloader = CheckpointHotReloader(engine, str(tmp_path), key="particles")
+    _, _, sup = _stack(tmp_path, clock, reg, reloader=reloader)
+    clock.advance(1.0)
+    seg = sup.run_segment_once()
+    assert seg["reload_rejected"] and seg["reload_step"] is None
+    assert seg["freshness_s"] is None  # nothing new served → no sample
+    st = engine.stats()
+    assert st["ensemble_tag"] == tag0  # still on the prior generation
+    assert st["reload_rejects"] == 1 and st["reloads"] == 0
+    assert not reg.gauge("svgd_serving_watermark").has()
+
+
+def test_zero_recompiles_across_steady_segments(tmp_path):
+    from tools.jaxlint.sentry import retrace_sentry
+
+    reg = MetricsRegistry()
+    clock = ManualClock(0.0)
+    _, _, sup = _stack(tmp_path, clock, reg)
+    clock.advance(1.0)
+    sup.run_segment_once()  # first segment pays the compile
+    with retrace_sentry("streaming-steady") as sentry:
+        for _ in range(3):
+            clock.advance(1.0)
+            sup.run_segment_once()
+    if not sentry.supported:
+        pytest.skip("retrace sentry unsupported on this jax")
+    # the RowRing keeps the traced data shape constant: ingesting three
+    # more segments must not retrace the scan
+    assert sentry.compiles == 0, sentry.report()

@@ -1,0 +1,25 @@
+"""Tier-1 wall-time guard: no non-slow test may exceed the per-test
+budget.  conftest.py collects every call-phase duration and reorders this
+module to run LAST, so by the time the assertion runs it has seen the
+whole session.  The same data lands in ``tests/.test_durations.json``
+(slowest first) for post-mortems.
+
+The tier-1 suite runs under one ~15-minute budget; a single test quietly
+growing past ~15 s is how that budget dies — this turns the creep into a
+named FAIL instead of an eventual suite timeout."""
+
+from conftest import DURATIONS, WALL_BUDGET_ALLOW_S, WALL_BUDGET_S
+
+
+def test_no_nonslow_test_exceeds_wall_budget():
+    over = {
+        nid: round(meta["duration"], 2)
+        for nid, meta in DURATIONS.items()
+        if not meta["slow"]
+        and meta["duration"] > WALL_BUDGET_ALLOW_S.get(nid, WALL_BUDGET_S)
+    }
+    assert not over, (
+        f"non-slow tests over the {WALL_BUDGET_S:.0f}s wall budget "
+        f"(mark them slow, make them faster, or grant a named allowance "
+        f"in conftest.WALL_BUDGET_ALLOW_S): {over}"
+    )

@@ -130,6 +130,18 @@ A/B timing protocol those notes derived:
   cannot run the federation (``status='unsupported'`` naming the jax
   version) is reported UNSUPPORTED, not FAILed — the NO_MESH pattern.
 
+- **streaming-freshness gates (round 20)** — ``freshness``
+  (``tools/freshness_drill.py:run_drill``: a manual-clock bitwise
+  kill→resume replay of the streaming pipeline, then a real-clock
+  ingest → train → checkpoint → hot-reload loop with a calibrated
+  label-flip ``DriftAt``).  Unconditional FAILs (``row_ok``): ANY
+  dropped stream batch (data loss is loud by contract), a non-bitwise
+  mid-stream resume, a drift breach served without a timely re-fit, any
+  steady-state recompile beyond the documented per-reload kernel
+  rebuilds, or a breached streaming SLO.  ``freshness_p99_s`` (p99
+  event-time → first-serve latency) gates against its own median+MAD
+  window.
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -201,7 +213,10 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
               # the multihost walls include cross-process DCN hops and
               # host checkpoint I/O — as host-noisy as the fleet walls
               "multihost_ring_hop_wall_ms": 2.0,
-              "multihost_updates_per_s": 2.0}
+              "multihost_updates_per_s": 2.0,
+              # freshness is train wall + checkpoint I/O + reload compile
+              # under a real clock — host-noisy like the other walls
+              "freshness_p99_s": 2.0}
 
 #: Every row key judged against a median+MAD incumbent window — the
 #: ``--list-missing`` contract: a key listed here with no history in the
@@ -219,7 +234,20 @@ WINDOWED_ROWS = (
     "storm_goodput_2x", "storm_recover_s",
     "fleet_detect_s", "fleet_readmit_s", "fleet_federation_scrape_ms",
     "multihost_ring_hop_wall_ms", "multihost_updates_per_s",
+    "freshness_p99_s",
 )
+
+#: Windowed rows whose source drill ALSO carries unconditional ``row_ok``
+#: correctness gates — those fire with or without incumbent history, so
+#: ``--list-missing`` annotates them: an empty window means the row's
+#: *regression* gate cannot fire, not that the drill cannot gate at all.
+UNCONDITIONAL_ROW_KEYS = frozenset({
+    "large_n_approx",
+    "storm_goodput_2x", "storm_recover_s",
+    "fleet_detect_s", "fleet_readmit_s", "fleet_federation_scrape_ms",
+    "multihost_ring_hop_wall_ms", "multihost_updates_per_s",
+    "freshness_p99_s",
+})
 
 #: Hard ceiling on the span tracer's measured serve-bench cost (round 10):
 #: the interleaved tracer-off/on A/B (``serve_bench.
@@ -473,8 +501,16 @@ def main():
         with open(INCUMBENTS_PATH) as fh:
             incumbents = json.load(fh)
         missing = missing_rows(incumbents)
-        print(json.dumps({"windowed_rows": len(WINDOWED_ROWS),
-                          "missing": missing}))
+        print(json.dumps({
+            "windowed_rows": len(WINDOWED_ROWS),
+            "missing": missing,
+            # every missing row's windowed gate is dormant; the annotated
+            # ones still hard-FAIL on their drill's row_ok correctness
+            # checks even with an empty history
+            "gates": {k: ("windowed+unconditional"
+                          if k in UNCONDITIONAL_ROW_KEYS else "windowed")
+                      for k in missing},
+        }))
         sys.exit(0)
 
     import jax
@@ -1157,6 +1193,51 @@ def main():
                         failures += 1
                     results[key] = value
                 print(json.dumps(row), flush=True)
+
+    # streaming-freshness gates (round 20): the freshness drill — manual-
+    # clock bitwise kill→resume replay, then a real-clock ingest → train →
+    # checkpoint → hot-reload loop with a calibrated label-flip DriftAt.
+    # Unconditional FAILs (freshness_drill.row_ok): any dropped stream
+    # batch, a non-bitwise mid-stream resume, drift served without a
+    # timely re-fit, any steady-state recompile beyond the documented
+    # per-reload kernel rebuilds, or a breached streaming SLO.  The p99
+    # event-time → first-serve latency gates against its own window.
+    import freshness_drill
+
+    fr_row = freshness_drill.run_drill()
+    fr_ok, fr_why = freshness_drill.row_ok(fr_row)
+    row = {"bench": "freshness",
+           "freshness_p50_s": fr_row.get("freshness_p50_s"),
+           "freshness_p99_s": fr_row.get("freshness_p99_s"),
+           "resumed_bitwise_identical": fr_row.get(
+               "resumed_bitwise_identical"),
+           "drift_detect_segments": fr_row.get("drift_detect_segments"),
+           "refits": fr_row.get("refits"),
+           "reloads": fr_row.get("reloads"),
+           "reload_rejections": fr_row.get("reload_rejections"),
+           "dropped_total": fr_row.get("dropped_total"),
+           "steady_state_recompiles": fr_row.get("steady_state_recompiles"),
+           "slo_status": fr_row.get("slo_status")}
+    if not fr_ok:
+        row["status"] = "FAIL"
+        row["error"] = "; ".join(fr_why)
+        failures += 1
+    else:
+        row["status"] = "PASS"
+    print(json.dumps(row), flush=True)
+    if fr_ok:
+        fr_key = "freshness_p99_s"
+        fr_val = fr_row.get(fr_key)
+        row = {"bench": fr_key, "value": fr_val, "unit": "s"}
+        tol = min(args.tol * TOL_FACTOR.get(fr_key, 1.0), 0.9)
+        status, info = judge_row(
+            fr_val, incumbent_history(incumbents, fr_key), tol, False)
+        row.update(info)
+        row["status"] = status
+        if status == "FAIL":
+            failures += 1
+        results[fr_key] = fr_val
+        print(json.dumps(row), flush=True)
 
     print(json.dumps({
         "summary": "FAIL" if failures else "PASS",
