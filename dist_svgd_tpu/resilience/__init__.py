@@ -47,6 +47,7 @@ from dist_svgd_tpu.resilience.federation import (
     SubprocessWorker,
 )
 from dist_svgd_tpu.resilience.faults import (
+    BadGenerationAt,
     DeviceLossAt,
     DriftAt,
     FaultPlan,
@@ -103,6 +104,7 @@ __all__ = [
     "FakeWorker",
     "SubprocessWorker",
     "FleetFault",
+    "BadGenerationAt",
     "DriftAt",
     "ReplicaKillAt",
     "ReplicaHangAt",

@@ -377,6 +377,69 @@ class DriftAt:
                 f"magnitude={self.magnitude}, until={self.until})")
 
 
+class BadGenerationAt:
+    """One scheduled bad candidate generation: rollout offers with
+    publish ordinal in ``[step, until)`` (``until=None`` → forever) carry
+    particles transformed by a pure, deterministic ``apply`` into
+    prediction garbage — so the progressive-delivery rollback path runs
+    tier-1 on CPU with no real bad training run to wait for (and a
+    replayed publish schedule reproduces the bad candidate bitwise).
+    Consumed at the offer seam — the rollout driver (a drill, a test, or
+    a supervisor shim) transforms the candidate ensemble before
+    ``RolloutController.offer``; the controller itself never knows the
+    candidate is synthetic, which is the point: detection must come from
+    the live divergence/burn windows.  Kinds:
+
+    - ``'saturate'``: scale every parameter by ``magnitude`` (default
+      1e6) — predictions saturate/overflow, the divergence histogram's
+      overflow bucket fills, the shadow stage breaches immediately.
+    - ``'scramble'``: deterministically reverse the parameter axis and
+      negate — finite, plausible-looking particles whose *predictions*
+      disagree with the incumbent (the subtle shape: passes any
+      all-finite check, only the divergence window catches it).
+    """
+
+    KINDS = ("saturate", "scramble")
+
+    def __init__(self, step: int, kind: str = "saturate",
+                 magnitude: float = 1e6, until: Optional[int] = None):
+        if step < 0:
+            raise ValueError(f"step must be >= 0, got {step}")
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown bad-generation kind {kind!r} "
+                             f"(one of {self.KINDS})")
+        if until is not None and until <= step:
+            raise ValueError(f"until ({until}) must be > step ({step})")
+        if kind == "saturate" and magnitude <= 1.0:
+            raise ValueError(
+                f"saturate magnitude must be > 1, got {magnitude}")
+        self.step = int(step)
+        self.kind = kind
+        self.magnitude = float(magnitude)
+        self.until = None if until is None else int(until)
+
+    def active(self, ordinal: int) -> bool:
+        return self.step <= ordinal and (self.until is None
+                                         or ordinal < self.until)
+
+    def apply(self, particles):
+        """Transform one ``(n, d)`` candidate ensemble (numpy array;
+        pure — never mutates its input)."""
+        import numpy as np
+
+        particles = np.asarray(particles)
+        if self.kind == "saturate":
+            return particles * np.asarray(self.magnitude,
+                                          dtype=particles.dtype)
+        # scramble: reverse the parameter axis and negate — deterministic,
+        # finite, and prediction-breaking for any non-symmetric model
+        return -particles[:, ::-1].copy()
+
+    def __repr__(self):
+        return (f"BadGenerationAt(step={self.step}, kind={self.kind!r}, "
+                f"magnitude={self.magnitude}, until={self.until})")
+
+
 class FaultPlan:
     """An ordered schedule of faults, consumed by the supervisor at every
     segment boundary.  ``fire_due`` fires every not-yet-fired fault whose

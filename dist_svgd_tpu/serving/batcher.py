@@ -128,12 +128,14 @@ class _Request:
     the epoch, so a timestamp is only meaningful against the same tracer."""
 
     __slots__ = ("future", "n_chunks", "parts", "enqueued", "trace_enq",
-                 "trace_src", "tenant", "trace")
+                 "trace_src", "tenant", "trace", "generation", "mirror")
 
     def __init__(self, n_chunks: int, enqueued: float,
                  trace_enq: Optional[float] = None, trace_src=None,
                  tenant: Optional[str] = None,
-                 trace: Optional[str] = None):
+                 trace: Optional[str] = None,
+                 generation: Optional[str] = None,
+                 mirror: bool = False):
         self.future: Future = Future()
         self.n_chunks = n_chunks
         self.parts: List[Optional[Dict[str, np.ndarray]]] = [None] * n_chunks
@@ -142,6 +144,12 @@ class _Request:
         self.trace_src = trace_src
         self.tenant = tenant
         self.trace = trace
+        # progressive delivery (round 21): which generation serves this
+        # request (None = incumbent, "candidate" = the rollout's hash
+        # split routed it to the staged candidate), and whether the
+        # incumbent answer should be shadow-mirrored to the candidate
+        self.generation = generation
+        self.mirror = mirror
 
 
 class _Chunk:
@@ -247,6 +255,12 @@ class MicroBatcher:
         # therefore everyone's queue delay) stays bounded between
         # overflow events, and flips it back when calm restores quotas.
         self._quota_mode = "overflow"
+        # progressive delivery (round 21): an armed RolloutController
+        # assigns each arriving request a generation (deterministic hash
+        # split) and flags incumbent requests for shadow mirroring; the
+        # submit ordinal is the hash key (guarded by _cond's lock)
+        self._rollout = None
+        self._submit_seq = 0
         self._tenant_queued: Dict[str, int] = {}
         # rows collected into a batch but not yet resolved: the drain
         # condition on tenant removal is queued AND inflight == 0 (a
@@ -436,10 +450,26 @@ class MicroBatcher:
                             f"{self.max_queue_rows}); retry with backoff",
                             retry_after_s=self._retry_after_s_locked(),
                         )
+                # progressive delivery: assign the request a generation via
+                # the rollout's deterministic hash split (nested threshold
+                # — an assignment never flaps backwards as stages widen),
+                # and flag incumbent requests for shadow mirroring.  The
+                # submit ordinal is the hash key: pure, replayable, and
+                # uniform across tenants' interleaving
+                generation = None
+                mirror = False
+                ro = self._rollout
+                if ro is not None and ro.active and tenant == ro.tenant:
+                    seq = self._submit_seq
+                    self._submit_seq += 1
+                    if ro.assign(seq) == "candidate":
+                        generation = "candidate"
+                    else:
+                        mirror = ro.should_mirror(seq)
                 n_chunks = -(-rows // self.max_batch)
                 req = _Request(n_chunks, self._clock(),
                                tracer.now() if tracer is not None else None,
-                               tracer, tenant, trace)
+                               tracer, tenant, trace, generation, mirror)
                 for i in range(n_chunks):
                     chunk = x[i * self.max_batch : (i + 1) * self.max_batch]
                     self._queue.append(_Chunk(chunk, req, i))
@@ -638,6 +668,24 @@ class MicroBatcher:
             self._quota_mode = mode
         return old
 
+    @property
+    def rollout(self):
+        """The armed :class:`~dist_svgd_tpu.rollout.RolloutController`
+        (None outside a rollout)."""
+        return self._rollout
+
+    def set_rollout(self, controller) -> None:
+        """Arm (or with ``None`` disarm) the progressive-delivery hook
+        LIVE (round 21).  While armed, every arriving request of the
+        controller's tenant is hash-assigned a generation (candidate
+        requests dispatch against the staged candidate and carry
+        ``generation="candidate"`` serve labels) and incumbent requests
+        may be shadow-mirrored.  Requests already queued keep the
+        assignment they got at submit time — disarming mid-flight is
+        safe (candidate batches fall back to the incumbent dispatch)."""
+        with self._cond:
+            self._rollout = controller
+
     def _collect(self, lane: int = 0) -> Optional[List[_Chunk]]:
         """Block until a batch is ready (max_batch reached, max_wait expired,
         or draining); None once closed and drained — or once this lane's id
@@ -671,13 +719,18 @@ class MicroBatcher:
                     continue  # drained under us (close(drain=False))
                 batch: List[_Chunk] = []
                 rows = 0
-                # one batch = one tenant: different tenants hit different
-                # engines/shapes, so a foreign chunk ends the batch (the
-                # next _collect — or another lane — picks it up)
+                # one batch = one (tenant, generation): different tenants
+                # hit different engines/shapes, and a candidate-split chunk
+                # dispatches against a different resident ensemble than an
+                # incumbent one — fusing across either would be wrong, not
+                # just slow (a foreign chunk ends the batch; the next
+                # _collect — or another lane — picks it up)
                 head_tenant = self._queue[0].req.tenant
+                head_gen = self._queue[0].req.generation
                 while (self._queue
                        and rows + self._queue[0].x.shape[0] <= self.max_batch
-                       and self._queue[0].req.tenant == head_tenant):
+                       and self._queue[0].req.tenant == head_tenant
+                       and self._queue[0].req.generation == head_gen):
                     chunk = self._queue.popleft()
                     batch.append(chunk)
                     rows += chunk.x.shape[0]
@@ -697,18 +750,24 @@ class MicroBatcher:
     def _run_batch(self, batch: List[_Chunk], lane: int = 0) -> None:
         rows = sum(c.x.shape[0] for c in batch)
         lane_label = f"l{lane}"
-        # _collect guarantees a single-tenant batch; tenant-less batches
-        # keep the unlabelled metric series (single-tenant deployments
-        # are byte-identical)
+        # _collect guarantees a single-(tenant, generation) batch;
+        # tenant-less batches keep the unlabelled metric series
+        # (single-tenant deployments are byte-identical).  Candidate-split
+        # batches add generation="candidate" to every dispatch-side serve
+        # series — the rollout's SLO engine judges that label set alone,
+        # so candidate and incumbent never dilute each other's windows
         tenant = batch[0].req.tenant
+        generation = batch[0].req.generation
+        ro = self._rollout
         tl = {} if tenant is None else {"tenant": tenant}
+        gl = tl if generation is None else {**tl, "generation": generation}
         tracer = _trace.get_tracer()
         t0 = self._clock()
         t_pop = tracer.now() if tracer is not None else 0.0
         queue_wait_ms = (t0 - min(c.req.enqueued for c in batch)) * 1e3
         x = np.concatenate([c.x for c in batch], axis=0)
         self._m_lane_inflight.set(rows, batcher=self.metrics_instance,
-                                  lane=lane_label, **tl)
+                                  lane=lane_label, **gl)
         # thread the trace id through the dispatch via the trace context
         # (the engine's spans tag themselves from it — same mechanics as
         # the tenant label, but per-request): only when the whole batch
@@ -719,17 +778,24 @@ class MicroBatcher:
                     if ctx_trace is not None else None)
         t_disp0 = tracer.now() if tracer is not None else 0.0
         try:
-            out = (self._dispatch(x) if tenant is None
-                   else self._dispatch(x, tenant))
+            if generation == "candidate" and ro is not None:
+                # candidate-split batch: dispatch against the staged
+                # candidate generation (the controller falls back to the
+                # incumbent if a rollback raced this batch — the client
+                # gets an answer either way)
+                out = ro.dispatch_candidate(x, tenant)
+            else:
+                out = (self._dispatch(x) if tenant is None
+                       else self._dispatch(x, tenant))
         except Exception as e:
             with self._cond:
                 self._n_errors += 1
                 if tenant is not None:
                     self._tenant_inflight[tenant] = max(
                         0, self._tenant_inflight.get(tenant, 0) - rows)
-            self._m_errors.inc(**tl)
+            self._m_errors.inc(**gl)
             self._m_lane_inflight.set(0, batcher=self.metrics_instance,
-                                      lane=lane_label, **tl)
+                                      lane=lane_label, **gl)
             for c in batch:
                 try:
                     c.req.future.set_exception(e)
@@ -745,7 +811,7 @@ class MicroBatcher:
                 _trace.set_trace_context(prev_ctx)
         t_disp1 = tracer.now() if tracer is not None else 0.0
         self._m_lane_inflight.set(0, batcher=self.metrics_instance,
-                                  lane=lane_label, **tl)
+                                  lane=lane_label, **gl)
         device_ms = (self._clock() - t0) * 1e3
         now = self._clock()
         with self._cond:
@@ -755,12 +821,19 @@ class MicroBatcher:
             # atomic so exactly ONE lane observes the final fill (else
             # both count the request and race future.set_result)
             done_requests = []
+            mirrors = []
             offset = 0
             for c in batch:
                 n = c.x.shape[0]
                 c.req.parts[c.index] = {
                     k: v[offset : offset + n] for k, v in out.items()
                 }
+                if c.req.mirror and ro is not None:
+                    # shadow mirror: hand this chunk's input + incumbent
+                    # answer to the rollout's background worker AFTER the
+                    # lock drops — the controller copies and never blocks,
+                    # so the client's critical path is untouched
+                    mirrors.append((c.x, c.req.parts[c.index]))
                 offset += n
                 if all(p is not None for p in c.req.parts):
                     done_requests.append(c.req)
@@ -783,22 +856,24 @@ class MicroBatcher:
                 self._latency_ms.append(lat_ms)
                 latencies.append((req, n_rows, lat_ms))
             self._lane_requests[lane] += len(latencies)
-        self._m_batches.inc(**tl)
-        self._m_batch_rows.observe(rows, **tl)
-        self._m_queue_wait.observe(queue_wait_ms / 1e3, **tl)
-        self._m_device.observe(device_ms / 1e3, **tl)
+        for mx, mout in mirrors:
+            ro.mirror(mx, mout)
+        self._m_batches.inc(**gl)
+        self._m_batch_rows.observe(rows, **gl)
+        self._m_queue_wait.observe(queue_wait_ms / 1e3, **gl)
+        self._m_device.observe(device_ms / 1e3, **gl)
         self._m_lane_batches.inc(batcher=self.metrics_instance,
-                                 lane=lane_label, **tl)
+                                 lane=lane_label, **gl)
         self._m_lane_rows.inc(rows, batcher=self.metrics_instance,
-                              lane=lane_label, **tl)
+                              lane=lane_label, **gl)
         if latencies:
             self._m_lane_requests.inc(len(latencies),
                                       batcher=self.metrics_instance,
-                                      lane=lane_label, **tl)
+                                      lane=lane_label, **gl)
         for req, n_rows, lat_ms in latencies:
-            self._m_requests.inc(**tl)
-            self._m_rows.inc(n_rows, **tl)
-            self._m_latency.observe(lat_ms / 1e3, **tl)
+            self._m_requests.inc(**gl)
+            self._m_rows.inc(n_rows, **gl)
+            self._m_latency.observe(lat_ms / 1e3, **gl)
         if tracer is not None:
             # one lane tree per completed request: the cross-thread
             # enqueue→reply lifetime with the queue-wait / coalesce /
@@ -817,6 +892,8 @@ class MicroBatcher:
                          "lane": lane_label}
                 if tenant is not None:
                     attrs["tenant"] = tenant
+                if generation is not None:
+                    attrs["generation"] = generation
                 if req.trace is not None:
                     # the cross-process join key: trace_report --stitch
                     # matches this tree to the router's fleet.route on it

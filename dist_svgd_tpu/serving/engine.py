@@ -241,6 +241,28 @@ class PredictiveEngine:
         self._misses = 0
         self._reloads = 0
         self._evictions = 0
+        # generation identity (round 21): every resident ensemble carries a
+        # monotonically-minted id.  The cold-start ensemble is generation 1;
+        # each admitted reload / staged candidate mints the next id.  After
+        # an admitted swap the PREVIOUS generation stays resident (particles
+        # + its compiled kernel dict), so rollback() is one lock-guarded
+        # pointer exchange — never a checkpoint re-load.
+        self._generation_id = 1
+        self._next_generation = 2
+        self._prev_particles: Optional[jax.Array] = None
+        self._prev_kernels: Optional[Dict[int, Any]] = None
+        self._prev_tag: Optional[str] = None
+        self._prev_generation: Optional[int] = None
+        self._prev_health: Optional[Dict[str, Any]] = None
+        self._rollbacks = 0
+        # candidate generation (round 21, progressive delivery): staged by
+        # stage_candidate(), served only via predict(generation='candidate')
+        # — the rollout controller's per-generation dispatch seam.  Promotion
+        # is the same pointer-exchange discipline as reload's admitted swap.
+        self._cand_particles: Optional[jax.Array] = None
+        self._cand_kernels: Optional[Dict[int, Any]] = None
+        self._cand_tag: Optional[str] = None
+        self._cand_generation: Optional[int] = None
         #: Tenant identity on every metric series (empty dict = unlabelled,
         #: the single-tenant series — backward compatible).
         self.tenant = tenant
@@ -265,6 +287,9 @@ class PredictiveEngine:
         self._m_evictions = reg.counter(
             "svgd_registry_evictions_total",
             "compiled kernel buckets evicted by the shared LRU")
+        self._m_rollbacks = reg.counter(
+            "svgd_engine_rollbacks_total",
+            "O(1) swaps back to the resident previous generation")
         self._reload_policy = reload_policy
         self._reload_rejects = 0
         # served ensemble's health baseline (computed lazily at the first
@@ -436,10 +461,36 @@ class PredictiveEngine:
         return self._plan.compile(
             dispatch, donate_argnums=(0,) if self._donate else ())
 
-    def _kernel_for(self, bucket: int):
+    def _kernel_for(self, bucket: int, generation: str = "serving"):
         """Returns ``(fn, dtype)`` snapshotted under one lock acquisition:
         a concurrent :meth:`reload` can never hand a caller the new
-        ensemble's dtype with the old ensemble's kernel (or vice versa)."""
+        ensemble's dtype with the old ensemble's kernel (or vice versa).
+
+        ``generation='candidate'`` resolves against the staged candidate
+        instead (the rollout controller's split/shadow dispatch).  Candidate
+        buckets are never reported to the shared :class:`KernelBucketLRU`:
+        a transient candidate's churn must not evict the incumbent's
+        steady-state buckets (the candidate's kernels die with
+        ``drop_candidate`` or become the accounted set at promotion)."""
+        if generation == "candidate":
+            with self._lock:
+                if self._cand_particles is None:
+                    raise RuntimeError(
+                        "no candidate generation staged; stage_candidate() "
+                        "first (or the rollout already resolved)"
+                    )
+                fn = self._cand_kernels.get(bucket)
+                if fn is None:
+                    self._misses += 1
+                    miss = True
+                    fn = self._cand_kernels[bucket] = self._build_kernel(
+                        self._cand_particles)
+                else:
+                    self._hits += 1
+                    miss = False
+                dtype = self._input_dtype(self._cand_particles.dtype)
+            (self._m_misses if miss else self._m_hits).inc(**self._tlabels)
+            return fn, dtype
         with self._lock:
             fn = self._kernels.get(bucket)
             if fn is None:
@@ -477,14 +528,25 @@ class PredictiveEngine:
     # ------------------------------------------------------------------ #
     # serving
 
-    def predict(self, x) -> Dict[str, np.ndarray]:
+    def predict(self, x, generation: str = "serving") -> Dict[str, np.ndarray]:
         """Evaluate one request batch ``x`` of shape ``(b, feature_dim)``.
 
         Pads to the power-of-two bucket, runs the bucket's cached jitted
         kernel, slices the padding back off.  Returns plain numpy arrays of
         leading dimension ``b`` (the device→host fetch doubles as the fence
         the batcher's device-time split relies on).
+
+        ``generation='candidate'`` (round 21) dispatches against the staged
+        candidate generation instead of the serving incumbent — the rollout
+        controller's shadow-mirror and canary-split path.  Raises
+        ``RuntimeError`` when no candidate is staged (a split batch racing a
+        rollback falls back to the incumbent upstream).
         """
+        if generation not in ("serving", "candidate"):
+            raise ValueError(
+                f"generation must be 'serving' or 'candidate', "
+                f"got {generation!r}"
+            )
         x = np.asarray(x)
         if x.ndim != 2 or x.shape[1] != self._feature_dim:
             raise ValueError(
@@ -508,7 +570,7 @@ class PredictiveEngine:
             if ctx is not None:
                 tags["trace"] = ctx
         with _trace.span("engine.predict", tags):
-            fn, dtype = self._kernel_for(bucket)
+            fn, dtype = self._kernel_for(bucket, generation)
             if bucket != b:
                 # pad on HOST: a device-side jnp.concatenate compiles one XLA
                 # program per distinct (b, bucket) pair — steady-state traffic
@@ -607,7 +669,11 @@ class PredictiveEngine:
             if reasons:
                 with self._lock:
                     self._reload_rejects += 1
-                self._m_reload_rejects.inc(**self._tlabels)
+                    serving_gen = self._generation_id
+                # generation = the incumbent that KEPT serving (the refused
+                # candidate never minted an id)
+                self._m_reload_rejects.inc(generation=str(serving_gen),
+                                           **self._tlabels)
                 _trace.instant("engine.reload_rejected", {"tag": tag})
                 rec = _trace.flight_recorder()
                 if rec is not None:
@@ -650,18 +716,174 @@ class PredictiveEngine:
                 # (bounded: the bucket lattice is finite, log2(max/min)+1)
                 missing = [b for b in self._kernels if b not in new_kernels]
                 if not missing:
+                    # keep the outgoing generation RESIDENT (particles +
+                    # compiled kernels): rollback() is then one pointer
+                    # exchange, never a checkpoint re-load (round 21)
+                    self._prev_particles = self._particles
+                    self._prev_kernels = self._kernels
+                    self._prev_tag = self._ensemble_tag
+                    self._prev_generation = self._generation_id
+                    self._prev_health = self._health_report
                     self._particles = particles
                     self._kernels = new_kernels
                     self._reloads += 1
                     self._ensemble_tag = tag
+                    self._generation_id = self._next_generation
+                    self._next_generation += 1
+                    gen = self._generation_id
                     if new_report is not None:
                         self._health_report = new_report
                     break
                 buckets = missing
-        self._m_reloads.inc(**self._tlabels)
+        # the generation label tells WHICH generation each swap installed —
+        # the mid-rollout fleet is inspectable from the counter series alone
+        self._m_reloads.inc(generation=str(gen), **self._tlabels)
         _trace.instant("engine.reload", {"tag": tag})
         return {"n_particles": int(particles.shape[0]),
-                "warmed_buckets": sorted(new_kernels), "tag": tag}
+                "warmed_buckets": sorted(new_kernels), "tag": tag,
+                "generation_id": gen}
+
+    # ------------------------------------------------------------------ #
+    # generations (round 21: progressive delivery)
+
+    def rollback(self) -> Dict[str, Any]:
+        """Swap back to the still-resident previous generation — O(1).
+
+        One lock-guarded pointer exchange of the full
+        ``(particles, kernels, tag, generation, health)`` pairs; **no
+        checkpoint I/O ever happens on this path** (regression-pinned in
+        tests/test_rollout.py).  The pairs *exchange* rather than pop, so a
+        mistaken rollback is itself recoverable by a second call.  Buckets
+        compiled only after the original swap recompile lazily on the
+        request path (a counted miss) — the previous generation kept the
+        kernel set it retired with.
+
+        Raises ``RuntimeError`` when no previous generation is resident
+        (cold-started engine with no admitted reload yet).
+        """
+        with self._lock:
+            if self._prev_particles is None:
+                raise RuntimeError(
+                    "no previous generation resident; nothing to roll back to"
+                )
+            self._particles, self._prev_particles = (
+                self._prev_particles, self._particles)
+            self._kernels, self._prev_kernels = (
+                self._prev_kernels, self._kernels)
+            self._ensemble_tag, self._prev_tag = (
+                self._prev_tag, self._ensemble_tag)
+            self._generation_id, self._prev_generation = (
+                self._prev_generation, self._generation_id)
+            self._health_report, self._prev_health = (
+                self._prev_health, self._health_report)
+            self._rollbacks += 1
+            gen = self._generation_id
+            tag = self._ensemble_tag
+            n = int(self._particles.shape[0])
+        self._m_rollbacks.inc(generation=str(gen), **self._tlabels)
+        _trace.instant("engine.rollback", {"tag": tag, "generation": gen})
+        return {"generation_id": gen, "tag": tag, "n_particles": n}
+
+    def stage_candidate(self, particles, *, warm: bool = True,
+                        tag: Optional[str] = None) -> Dict[str, Any]:
+        """Stage a candidate generation WITHOUT swapping it into serving.
+
+        The candidate gets its own kernel set, built and (``warm=True``)
+        pre-traced off the request path over every currently-compiled
+        bucket — exactly :meth:`reload`'s staging discipline, minus the
+        pointer exchange and minus the reload policy (the rollout
+        controller judges the candidate on LIVE shadow/canary windows
+        instead of a one-shot pre-serve health check).  Dispatch against
+        it with ``predict(x, generation='candidate')``; install it with
+        :meth:`promote_candidate`; discard with :meth:`drop_candidate`.
+        A second stage_candidate supersedes the first (its kernels are
+        dropped).  Returns ``{generation_id, warmed_buckets, tag}``.
+        """
+        particles = jnp.asarray(particles)
+        if particles.ndim != 2 or particles.shape[1] != self._particles.shape[1]:
+            raise ValueError(
+                f"candidate particles {particles.shape} incompatible with "
+                f"the served layout (n, {self._particles.shape[1]})"
+            )
+        particles = self._place_ensemble(particles)
+        warm_dtype = self._input_dtype(particles.dtype)
+        new_kernels: Dict[int, Any] = {}
+        with self._lock:
+            buckets = sorted(self._kernels)
+        while True:
+            for b in buckets:
+                if b not in new_kernels:
+                    fn = self._build_kernel(particles)
+                    if warm:
+                        fn(self._plan.replicate(
+                            jnp.zeros((b, self._feature_dim), warm_dtype)))
+                    new_kernels[b] = fn
+            with self._lock:
+                missing = [b for b in self._kernels if b not in new_kernels]
+                if not missing:
+                    self._cand_particles = particles
+                    self._cand_kernels = new_kernels
+                    self._cand_tag = tag
+                    self._cand_generation = self._next_generation
+                    self._next_generation += 1
+                    gen = self._cand_generation
+                    break
+                buckets = missing
+        _trace.instant("engine.stage_candidate",
+                       {"tag": tag, "generation": gen})
+        return {"generation_id": gen, "warmed_buckets": sorted(new_kernels),
+                "tag": tag}
+
+    def promote_candidate(self) -> Dict[str, Any]:
+        """Install the staged candidate as the serving generation — O(1).
+
+        The same pointer-exchange discipline as :meth:`reload`'s admitted
+        swap: the outgoing incumbent stays resident for :meth:`rollback`,
+        the candidate slot empties, and the swap counts as a reload (so
+        the drills' ``expected_compiles = reloads × buckets`` accounting
+        holds — the candidate's kernels were compiled once, at staging).
+        The served health baseline resets: the next policied reload
+        re-baselines against the promoted generation's own diagnostics.
+        """
+        with self._lock:
+            if self._cand_particles is None:
+                raise RuntimeError("no candidate generation staged")
+            self._prev_particles = self._particles
+            self._prev_kernels = self._kernels
+            self._prev_tag = self._ensemble_tag
+            self._prev_generation = self._generation_id
+            self._prev_health = self._health_report
+            self._particles = self._cand_particles
+            self._kernels = self._cand_kernels
+            self._ensemble_tag = self._cand_tag
+            self._generation_id = self._cand_generation
+            self._health_report = None
+            self._cand_particles = None
+            self._cand_kernels = None
+            self._cand_tag = None
+            self._cand_generation = None
+            self._reloads += 1
+            gen = self._generation_id
+            tag = self._ensemble_tag
+            n = int(self._particles.shape[0])
+        self._m_reloads.inc(generation=str(gen), **self._tlabels)
+        _trace.instant("engine.promote", {"tag": tag, "generation": gen})
+        return {"generation_id": gen, "tag": tag, "n_particles": n}
+
+    def drop_candidate(self) -> bool:
+        """Discard the staged candidate (rollout rollback before any
+        promotion — the incumbent never stopped serving).  Returns whether
+        a candidate was staged.  O(1), no checkpoint I/O."""
+        with self._lock:
+            existed = self._cand_particles is not None
+            gen = self._cand_generation
+            self._cand_particles = None
+            self._cand_kernels = None
+            self._cand_tag = None
+            self._cand_generation = None
+        if existed:
+            _trace.instant("engine.drop_candidate", {"generation": gen})
+        return existed
 
     def stats(self) -> Dict[str, Any]:
         """Compile-cache and ensemble identity counters for ``/metrics``."""
@@ -686,6 +908,13 @@ class PredictiveEngine:
                 "reload_rejects": self._reload_rejects,
                 "ensemble_tag": self._ensemble_tag,
                 "ensemble_health": self._health_report,
+                # generation identity (round 21): which generation serves,
+                # which is resident for O(1) rollback, which is staged
+                "generation_id": self._generation_id,
+                "previous_generation_id": self._prev_generation,
+                "candidate_generation_id": self._cand_generation,
+                "candidate_tag": self._cand_tag,
+                "rollbacks": self._rollbacks,
             }
 
 
@@ -719,18 +948,26 @@ class CheckpointHotReloader:
             root's current latest when the engine wasn't built from a
             manager root.  Pass ``None`` to force the first poll to load
             whatever is restorable, or an explicit step number.
+        rollout: optional progressive-delivery controller
+            (:class:`~dist_svgd_tpu.rollout.RolloutController`, duck-typed
+            on ``offer``).  When set, a newer step is **offered as a
+            candidate** instead of swapped directly — the rollout drives
+            it through shadow/canary stages and promotes or rolls back on
+            live SLO windows; the serving watermark is stamped at
+            *promotion*, not at offer.
         logger: optional ``JsonlLogger`` — one record per swap.
     """
 
     def __init__(self, engine: PredictiveEngine, root: str, *,
                  key: str = "particles", interval_s: float = 5.0,
-                 baseline_step="auto", logger=None):
+                 baseline_step="auto", rollout=None, logger=None):
         from dist_svgd_tpu.utils.checkpoint import CheckpointManager
 
         self.engine = engine
         self._mgr = CheckpointManager(os.fspath(root))
         self._key = key
         self._interval_s = float(interval_s)
+        self.rollout = rollout
         self._logger = logger
         if baseline_step == "auto":
             baseline_step = getattr(engine, "checkpoint_step", None)
@@ -759,6 +996,22 @@ class CheckpointHotReloader:
                 f"checkpoint step_{step} has no {self._key!r} entry "
                 f"(keys: {sorted(state)})"
             )
+        wm = state.get("stream_watermark")
+        if self.rollout is not None:
+            # progressive delivery (round 21): the new generation enters a
+            # staged rollout instead of an atomic cutover.  The step is
+            # marked seen either way — a superseded/deferred candidate is a
+            # rollout decision, not a reason to re-offer the same step
+            # forever.  The serving watermark is stamped by the rollout at
+            # PROMOTION (candidate traffic is not "served" freshness-wise).
+            offered = self.rollout.offer(
+                np.asarray(arr), tag=f"step_{step}",
+                watermark=(float(np.asarray(wm)) if wm is not None else None))
+            self.loaded_step = step
+            if self._logger is not None:
+                self._logger.log(event="rollout_offer", step=step,
+                                 accepted=bool(offered))
+            return step if offered else None
         try:
             info = self.engine.reload(np.asarray(arr), tag=f"step_{step}")
         except EnsembleRejected as e:
@@ -772,15 +1025,22 @@ class CheckpointHotReloader:
                                  reasons=e.reasons)
             return None
         self.loaded_step = step
-        wm = state.get("stream_watermark")
         if wm is not None:
             # streaming checkpoints stamp their data watermark: once this
             # generation serves, predictions reflect events up to `wm` —
-            # the serving half of the freshness SLO's gauge pair
-            self.engine.registry.gauge(
+            # the serving half of the freshness SLO's gauge pair.  Stamped
+            # twice: the tenant-keyed series the FreshnessObjective reads
+            # (exact label match — unchanged), plus a generation-labelled
+            # series so a mid-rollout fleet shows WHICH generation's data
+            # is serving (round 21)
+            gauge = self.engine.registry.gauge(
                 "svgd_serving_watermark",
                 "event-time data watermark of the served ensemble",
-            ).set(float(np.asarray(wm)), **self.engine._tlabels)
+            )
+            gauge.set(float(np.asarray(wm)), **self.engine._tlabels)
+            gauge.set(float(np.asarray(wm)),
+                      generation=str(info["generation_id"]),
+                      **self.engine._tlabels)
         if self._logger is not None:
             self._logger.log(event="hot_reload", step=step, **info)
         return step

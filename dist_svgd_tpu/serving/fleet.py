@@ -566,7 +566,7 @@ def classify_slo(doc: Any, now_s: Optional[float] = None,
 class _ReplicaState:
     __slots__ = ("state", "probe_failures", "request_failures", "opened_at",
                  "last_healthy", "inflight", "ejections", "reason",
-                 "backpressure_until")
+                 "backpressure_until", "generation")
 
     def __init__(self):
         self.state = CLOSED
@@ -578,6 +578,9 @@ class _ReplicaState:
         self.ejections = 0
         self.reason = ""
         self.backpressure_until = 0.0
+        # serving generation the last healthy probe reported (round 21):
+        # a mid-rollout fleet shows which replicas flipped generations
+        self.generation: Optional[int] = None
 
 
 class ReplicaSet:
@@ -752,9 +755,15 @@ class ReplicaSet:
 
     # ---- active probing ---------------------------------------------- #
 
-    def _probe_replica(self, rid: str) -> Tuple[bool, bool, str]:
-        """(health ok, draining, slo verdict) — network I/O, NO lock."""
+    def _probe_replica(self, rid: str) -> Tuple[bool, bool, str,
+                                                Optional[int]]:
+        """(health ok, draining, slo verdict, serving generation) —
+        network I/O, NO lock.  The generation comes off the root
+        ``/healthz`` doc (single-tenant replicas report it directly;
+        multi-tenant docs carry it per tenant instead and report None
+        here)."""
         draining = False
+        generation: Optional[int] = None
         try:
             paths = [self.health_path] + [
                 f"{self.health_path}/{t}" for t in self.probe_tenants]
@@ -763,11 +772,14 @@ class ReplicaSet:
                     rid, "GET", path, timeout_s=self.probe_timeout_s)
                 doc = reply.json()
                 if isinstance(doc, dict) and doc.get("status") == "draining":
-                    return False, True, "unknown"
+                    return False, True, "unknown", None
                 if reply.status != 200:
-                    return False, False, "unknown"
+                    return False, False, "unknown", None
+                if (isinstance(doc, dict) and path == self.health_path
+                        and doc.get("generation_id") is not None):
+                    generation = int(doc["generation_id"])
         except TransportError:
-            return False, False, "unknown"
+            return False, False, "unknown", None
         try:
             reply = self.transport.request(
                 rid, "GET", self.slo_path, timeout_s=self.probe_timeout_s)
@@ -775,7 +787,7 @@ class ReplicaSet:
                                    max_age_s=self.slo_max_age_s)
         except TransportError:
             verdict = "unknown"
-        return True, draining, verdict
+        return True, draining, verdict, generation
 
     def probe_once(self) -> Dict[str, str]:
         """One active sweep: probe every non-cooling replica, apply the
@@ -788,7 +800,7 @@ class ReplicaSet:
                     to_probe.append(rid)
         results = {rid: self._probe_replica(rid) for rid in to_probe}
         with self._lock:
-            for rid, (ok, draining, verdict) in results.items():
+            for rid, (ok, draining, verdict, generation) in results.items():
                 st = self._replicas[rid]
                 if draining:
                     # a deliberate signal, not a flaky probe: one strike
@@ -809,6 +821,8 @@ class ReplicaSet:
                 # blocks a live health endpoint from keeping its circuit)
                 st.probe_failures = 0
                 st.last_healthy = self._clock()
+                if generation is not None:
+                    st.generation = generation
                 if st.state == HALF_OPEN:
                     self._transition_locked(rid, CLOSED, "trial_probe_ok")
             return {rid: s.state for rid, s in self._replicas.items()}
@@ -863,6 +877,7 @@ class ReplicaSet:
                       "inflight": st.inflight, "ejections": st.ejections,
                       "probe_failures": st.probe_failures,
                       "request_failures": st.request_failures,
+                      "generation": st.generation,
                       "last_healthy_age_s": (
                           None if st.last_healthy is None
                           else round(self._clock() - st.last_healthy, 3))}

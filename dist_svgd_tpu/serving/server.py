@@ -419,6 +419,12 @@ class PredictionServer:
             "feature_dim": st["feature_dim"],
             "devices": st["plan"]["num_shards"],
             "lanes": self.batcher.lanes,
+            # generation identity (round 21): which posterior generation
+            # answers this replica's traffic — the fleet router's /fleet
+            # doc and tools/fleet_status.py surface it per replica so a
+            # mid-rollout fleet is inspectable at a glance
+            "generation_id": st["generation_id"],
+            "previous_generation_id": st["previous_generation_id"],
             "uptime_s": round(time.time() - self._started, 1),
         }
 

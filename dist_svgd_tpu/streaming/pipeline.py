@@ -295,19 +295,41 @@ class StreamingSupervisor(RunSupervisor):
         # -- publish (hot reload; rejected reloads roll BACK) ----------- #
         reload_step = None
         rejected = False
+        rollout_decision = None
         rel_t0 = tnow()
         if self._reloader is not None:
             rejects0 = self._reloader.engine.stats()["reload_rejects"]
             reload_step = self._reloader.poll_once()
             rejected = (self._reloader.engine.stats()["reload_rejects"]
                         > rejects0)
+            rollout = getattr(self._reloader, "rollout", None)
+            if rollout is not None:
+                # progressive delivery (round 21): the supervisor publishes
+                # candidates INTO the rollout (poll_once offered above) and
+                # drives one control step per segment — deterministic, on
+                # the segment cadence, with the supervisor's own liveness
+                # (a separate controller thread would race the injectable
+                # clocks tier-1 relies on).  Promotion/rollback decisions
+                # land here, in the segment record.
+                rollout_decision = rollout.step()
         rel_t1 = tnow()
 
         freshness_s = None
-        if (reload_step is not None and self._stream_watermark is not None):
+        if (reload_step is not None and self._stream_watermark is not None
+                and rollout_decision is None):
             # event time of the newest datum this generation was trained
             # on → the moment it started serving (one shared timeline)
             freshness_s = max(self._clock() - self._stream_watermark, 0.0)
+            self._m_freshness.observe(freshness_s)
+        elif (rollout_decision is not None
+              and rollout_decision.get("action") == "promote"
+              and rollout_decision.get("watermark") is not None):
+            # rollout-published generations count as served at PROMOTION
+            # (candidate traffic is not "served" freshness-wise): the
+            # freshness observation uses the promoted generation's own
+            # offered watermark, which may trail the live ingest cursor
+            freshness_s = max(
+                self._clock() - rollout_decision["watermark"], 0.0)
             self._m_freshness.observe(freshness_s)
 
         self._stream_segments += 1
@@ -336,6 +358,7 @@ class StreamingSupervisor(RunSupervisor):
             "dropped_total": self._stream_dropped,
             "reload_step": reload_step,
             "reload_rejected": rejected,
+            "rollout": rollout_decision,
             "freshness_s": freshness_s,
             "resumed_from": report["resumed_from"],
             "train_status": report["status"],
@@ -368,6 +391,12 @@ class StreamingSupervisor(RunSupervisor):
                            if s["reload_step"] is not None),
             "reload_rejections": sum(1 for s in segments
                                      if s["reload_rejected"]),
+            "promotions": sum(1 for s in segments
+                              if (s["rollout"] or {}).get("action")
+                              == "promote"),
+            "rollout_rollbacks": sum(1 for s in segments
+                                     if (s["rollout"] or {}).get("action")
+                                     == "rollback"),
             "watermark": self._stream_watermark,
             "freshness_s": freshness,
             "segment_reports": segments,

@@ -52,6 +52,7 @@ __all__ = [
     "default_serving_slos",
     "default_training_slos",
     "default_streaming_slos",
+    "default_rollout_slos",
 ]
 
 OK = "ok"
@@ -624,4 +625,40 @@ def default_streaming_slos(registry: MetricsRegistry, *,
         FreshnessObjective("freshness", max_lag_s, labels=labels),
         RatioObjective("stream_drop_rate", "svgd_stream_dropped_total",
                        "svgd_stream_batches_total", drop_budget),
+    ], clock=clock, mirror_metrics=mirror_metrics)
+
+
+def default_rollout_slos(registry: MetricsRegistry, *,
+                         p99_ms: float = 100.0,
+                         error_budget: float = 0.01,
+                         max_divergence: float = 0.05,
+                         divergence_budget: float = 0.01,
+                         labels: Optional[dict] = None,
+                         mirror_metrics: bool = True,
+                         clock: Callable[[], float] = time.time) -> SloEngine:
+    """The progressive-delivery judge: the candidate generation's OWN
+    serve windows plus the shadow-divergence window.
+
+    The candidate objectives read the ``generation="candidate"`` label
+    set of the standard serve series — the batcher stamps candidate-split
+    batches with that label, so the incumbent's traffic never dilutes the
+    candidate's verdict (and vice versa).  Divergence reuses
+    :class:`LatencyObjective` verbatim: ``svgd_rollout_divergence`` is a
+    histogram over prediction-space distances instead of seconds, and
+    "``target`` fraction of observations at or under ``threshold``" is
+    exactly the divergence-budget judgement (a NaN-predicting candidate
+    lands in the overflow bucket, over every finite threshold).  All
+    three objectives are ``no_data``-safe: an empty window holds the
+    rollout in its current stage rather than promoting or rolling back.
+    """
+    base = dict(labels or {})
+    cand = {**base, "generation": "candidate"}
+    return SloEngine(registry, [
+        LatencyObjective("candidate_p99", "svgd_serve_request_latency_seconds",
+                         p99_ms / 1e3, target=0.99, labels=cand),
+        RatioObjective("candidate_errors", "svgd_serve_dispatch_errors_total",
+                       "svgd_serve_batches_total", error_budget, labels=cand),
+        LatencyObjective("shadow_divergence", "svgd_rollout_divergence",
+                         max_divergence, target=1.0 - divergence_budget,
+                         labels=base),
     ], clock=clock, mirror_metrics=mirror_metrics)

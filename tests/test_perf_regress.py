@@ -113,3 +113,98 @@ def test_windowed_rows_include_the_multihost_gates():
     assert "multihost_ring_hop_wall_ms" in WINDOWED_ROWS
     assert "multihost_updates_per_s" in WINDOWED_ROWS
     assert len(WINDOWED_ROWS) == len(set(WINDOWED_ROWS))
+
+
+def test_windowed_rows_include_the_rollout_gates():
+    from perf_regress import UNCONDITIONAL_ROW_KEYS
+
+    assert "rollout_promote_s" in WINDOWED_ROWS
+    assert "shadow_overhead_frac" in WINDOWED_ROWS
+    assert "rollout_promote_s" in UNCONDITIONAL_ROW_KEYS
+    assert "shadow_overhead_frac" in UNCONDITIONAL_ROW_KEYS
+
+
+def test_rollout_row_ok_gates():
+    """Every unconditional canary_rollout gate fires on its own failure
+    mode; a fully-green row passes."""
+    import rollout_drill
+
+    green = {
+        "good": {"promoted": True, "stages": [0.02, 0.1, 0.5, 1.0]},
+        "bad": {"rolled_back": True, "peak_fraction": 0.0,
+                "max_exposure": 0.10, "checkpoint_reloads": 0,
+                "incumbent_bitwise": True,
+                "serving_generation_unchanged": True},
+        "client": {"offered": 10, "completed": 10, "shed": 0,
+                   "errors": 0, "lost": 0},
+        "steady_state_recompiles": 0,
+        "shadow_overhead_frac": 0.001, "shadow_overhead_max": 0.05,
+    }
+    ok, why = rollout_drill.row_ok(green)
+    assert ok and why == []
+    breakages = [
+        (("good", "promoted"), False, "never reached full exposure"),
+        (("client", "lost"), 2, "lost"),
+        (("client", "errors"), 1, "errored"),
+        (("steady_state_recompiles",), 3, "steady-state compile"),
+        (("bad", "rolled_back"), False, "never rolled back"),
+        (("bad", "peak_fraction"), 0.5, "exposure"),
+        (("bad", "checkpoint_reloads"), 1, "checkpoint"),
+        (("bad", "incumbent_bitwise"), False, "bitwise"),
+        (("bad", "serving_generation_unchanged"), False, "generation"),
+        (("shadow_overhead_frac",), 0.06, "critical path"),
+    ]
+    for path, value, needle in breakages:
+        row = {k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in green.items()}
+        if len(path) == 1:
+            row[path[0]] = value
+        else:
+            row[path[0]][path[1]] = value
+        ok, why = rollout_drill.row_ok(row)
+        assert not ok
+        assert any(needle in w for w in why), (path, why)
+
+
+def test_window_metrics_classifies_mirrors_separately():
+    """Shadow-mirrored dispatches are their own category: never client
+    ok/shed/error/lost, never offered, never in goodput or latency."""
+    from workload_replay import window_metrics
+
+    recs = [
+        {"t": 0.1, "rows": 4, "tenant": "a", "status": "ok",
+         "lat_ms": 5.0},
+        {"t": 0.2, "rows": 4, "tenant": "a", "status": "mirror",
+         "lat_ms": None},
+        {"t": 0.3, "rows": 4, "tenant": "a", "status": "shed",
+         "lat_ms": None},
+        {"t": 0.4, "rows": 4, "tenant": "a", "status": "mirror",
+         "lat_ms": None},
+        {"t": 0.5, "rows": 4, "tenant": "a", "status": "lost",
+         "lat_ms": None},
+    ]
+    w = window_metrics(recs, 0.0, 1.0, good_ms=50.0)
+    assert w["mirrors"] == 2
+    assert w["offered"] == 3  # client traffic only
+    assert w["completed"] == 1 and w["shed"] == 1 and w["lost"] == 1
+    assert w["good"] == 1
+    # the client accounting identity holds with mirrors excluded
+    assert (w["completed"] + w["shed"] + w["errors"] + w["lost"]
+            == w["offered"])
+
+
+def test_mirror_counts_reads_rollout_counters():
+    from workload_replay import mirror_counts
+
+    from dist_svgd_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    assert mirror_counts(reg, "a") == {
+        "mirrors": 0, "mirror_dropped": 0, "mirror_errors": 0}
+    reg.counter("svgd_rollout_mirrors_total", "m").inc(3, tenant="a")
+    reg.counter("svgd_rollout_mirror_dropped_total", "d").inc(1,
+                                                              tenant="a")
+    assert mirror_counts(reg, "a") == {
+        "mirrors": 3, "mirror_dropped": 1, "mirror_errors": 0}
+    assert mirror_counts(reg) == {
+        "mirrors": 0, "mirror_dropped": 0, "mirror_errors": 0}

@@ -526,6 +526,62 @@ def test_remove_without_drain_cancels_queued(rng):
     reg.close()
 
 
+def test_remove_tenant_drain_wins_scanner_reload_race(tmp_path, rng):
+    """``remove_tenant(drain=True)`` racing the scanner thread's hot
+    reload: the drain always wins, and a LATE reload (the scanner losing
+    the race on its own thread) neither resurrects the tenant nor leaks
+    a compiled bucket into the shared ``KernelBucketLRU`` — the reload
+    path rebuilds kernels inside the engine only; the shared cache is
+    touched exclusively by the serving path."""
+    root = str(tmp_path / "race")
+    mgr = CheckpointManager(root, every=1, backend="npz")
+    mgr.save(1, {"particles": rng.normal(size=(16, 5)).astype(np.float32)})
+    reg = _registry()
+    tenant = reg.add_tenant("victim", "logreg", checkpoint=root,
+                            watch=True, min_bucket=4, max_bucket=4)
+    eng = tenant.engine
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    reg.predict("victim", x)  # serve once: the bucket enters the LRU
+    assert reg.kernel_cache.stats()["size"] == 1
+    # scanner thread hammers hot reloads while the main thread removes
+    stop = threading.Event()
+    reload_errors = []
+
+    def scanner():
+        step = 2
+        while not stop.is_set():
+            try:
+                mgr.save(step, {"particles":
+                                rng.normal(size=(16, 5))
+                                .astype(np.float32)})
+                tenant.reloader.poll_once()
+                step += 1
+            except Exception as e:  # pragma: no cover - the race's loser
+                reload_errors.append(e)
+                return
+
+    t = threading.Thread(target=scanner)
+    t.start()
+    reg.remove_tenant("victim", drain=True, timeout=30)
+    stop.set()
+    t.join(timeout=30)
+    assert reload_errors == []
+    # drain won and stays won
+    assert "victim" not in reg
+    with pytest.raises(KeyError, match="unknown tenant"):
+        reg.submit("victim", x)
+    assert reg.kernel_cache.stats()["size"] == 0
+    # one fully-late reload on the detached engine: absorbed, no
+    # resurrection, no compiled bucket re-entering the shared LRU
+    mgr.save(99, {"particles": rng.normal(size=(16, 5))
+                  .astype(np.float32)})
+    tenant.reloader.poll_once()
+    assert eng.stats()["generation_id"] >= 2  # the reload itself worked
+    assert "victim" not in reg
+    assert reg.kernel_cache.stats()["size"] == 0
+    reg.close()
+
+
 def test_set_quota_live(rng):
     reg = _registry(batcher_autostart=False, max_batch=8,
                     max_queue_rows=16)

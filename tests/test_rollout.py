@@ -1,0 +1,452 @@
+"""Progressive delivery (dist_svgd_tpu/rollout/): deterministic hash
+splits, prediction divergence, the staged shadow → canary → promote /
+rollback state machine on an injectable clock, O(1) checkpoint-free
+rollback to the resident incumbent, the batcher's split/mirror seam,
+registry arm/disarm lifecycle, the hot-reloader's offer-as-candidate
+path, and ``BadGenerationAt``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dist_svgd_tpu.resilience import BadGenerationAt
+from dist_svgd_tpu.rollout import (
+    RolloutController,
+    RolloutPlan,
+    prediction_divergence,
+)
+from dist_svgd_tpu.rollout.controller import _hash_unit
+from dist_svgd_tpu.serving import ModelRegistry, PredictiveEngine
+from dist_svgd_tpu.serving.engine import CheckpointHotReloader
+from dist_svgd_tpu.telemetry import MetricsRegistry
+from dist_svgd_tpu.utils.checkpoint import CheckpointManager
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(21)
+
+
+class ManualClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _engine(rng, n=16, k=4, **kw):
+    parts = rng.normal(size=(n, 1 + k)).astype(np.float32)
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("max_bucket", 4)
+    kw.setdefault("registry", MetricsRegistry())
+    eng = PredictiveEngine("logreg", parts, **kw)
+    eng.warmup()
+    return eng, parts
+
+
+def _controller(eng, clock, **plan_kw):
+    plan_kw.setdefault("shadow_fraction", 0.5)
+    plan_kw.setdefault("shadow_min_mirrors", 2)
+    plan_kw.setdefault("shadow_hold_s", 1.0)
+    plan_kw.setdefault("canary_stages", (0.5, 1.0))
+    plan_kw.setdefault("stage_hold_s", 1.0)
+    plan_kw.setdefault("stage_min_requests", 1)
+    return RolloutController(eng, plan=RolloutPlan(**plan_kw), clock=clock)
+
+
+def _observe_divergence(reg, value, times=1):
+    h = reg.histogram("svgd_rollout_divergence")
+    for _ in range(times):
+        h.observe(value)
+
+
+def _observe_candidate_latency(reg, seconds, times=1):
+    h = reg.histogram("svgd_serve_request_latency_seconds")
+    for _ in range(times):
+        h.observe(seconds, generation="candidate")
+
+
+# --------------------------------------------------------------------- #
+# plan validation, hash split, divergence
+
+
+def test_plan_validates():
+    with pytest.raises(ValueError, match="shadow_fraction"):
+        RolloutPlan(shadow_fraction=0.0)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        RolloutPlan(canary_stages=(0.5, 0.5, 1.0))
+    with pytest.raises(ValueError, match="last canary stage"):
+        RolloutPlan(canary_stages=(0.1, 0.5))
+    with pytest.raises(ValueError, match="breach_streak"):
+        RolloutPlan(breach_streak=0)
+    with pytest.raises(ValueError, match="on_active"):
+        RolloutPlan(on_active="explode")
+    d = RolloutPlan().describe()
+    assert d["canary_stages"] == [0.01, 0.10, 0.50, 1.0]
+
+
+def test_hash_split_deterministic_and_monotone():
+    """The per-request hash is stable across calls and processes, split
+    vs mirror use independent streams, and a request assigned to the
+    candidate at fraction f stays there at every fraction > f (stage
+    advances never flap an assignment back to the incumbent)."""
+    units = [_hash_unit(7, "split", k) for k in range(2000)]
+    assert units == [_hash_unit(7, "split", k) for k in range(2000)]
+    assert all(0.0 <= u < 1.0 for u in units)
+    # roughly uniform: the 1% stage actually admits ~1% of traffic
+    assert 0.05 < sum(u < 0.1 for u in units) / 2000 < 0.15
+    # different salts decorrelate split and mirror decisions
+    mirrors = [_hash_unit(7, "mirror", k) for k in range(2000)]
+    assert mirrors != units
+    for f_lo, f_hi in ((0.01, 0.10), (0.10, 0.50), (0.50, 1.0)):
+        lo = {k for k, u in enumerate(units) if u < f_lo}
+        hi = {k for k, u in enumerate(units) if u < f_hi}
+        assert lo <= hi
+
+
+def test_prediction_divergence():
+    a = {"mean": np.array([0.5, 0.5]), "var": np.array([0.1, 0.1])}
+    b = {"mean": np.array([0.5, 0.7]), "var": np.array([0.1, 0.1])}
+    assert prediction_divergence(a, a) == 0.0
+    assert prediction_divergence(a, b) == pytest.approx(0.05)
+    # no shared keys -> NaN (counted against the divergence budget by
+    # the histogram's overflow bucket, never silently green)
+    assert np.isnan(prediction_divergence({"x": np.ones(2)},
+                                          {"y": np.ones(2)}))
+    bad = {"mean": np.array([np.nan, 0.5]), "var": np.array([0.1, 0.1])}
+    assert np.isnan(prediction_divergence(bad, a))
+
+
+# --------------------------------------------------------------------- #
+# the controller state machine (manual clock, metrics-driven windows)
+
+
+def test_controller_promotes_through_stages(rng):
+    eng, parts = _engine(rng)
+    reg = eng.registry
+    clock = ManualClock()
+    ro = _controller(eng, clock)
+    cand = parts + np.float32(1e-3)
+    assert ro.offer(cand, tag="good", watermark=123.0)
+    assert ro.state == "shadow" and ro.active
+    # held but starved: no mirrors yet -> the shadow stage must hold
+    clock.advance(1.5)
+    assert ro.step()["action"] == "hold"
+    _observe_divergence(reg, 1e-4, times=3)
+    clock.advance(0.1)
+    d = ro.step()
+    assert d["action"] == "advance" and d["fraction"] == 0.5
+    _observe_candidate_latency(reg, 0.002, times=2)
+    clock.advance(1.1)
+    d = ro.step()
+    assert d["action"] == "advance" and d["fraction"] == 1.0
+    _observe_candidate_latency(reg, 0.002, times=2)
+    clock.advance(1.1)
+    d = ro.step()
+    assert d["action"] == "promote" and d["watermark"] == 123.0
+    assert d["promote_s"] == pytest.approx(3.8, abs=0.2)
+    st = eng.stats()
+    assert st["generation_id"] == 2
+    assert st["previous_generation_id"] == 1
+    assert st["candidate_generation_id"] is None
+    # promotion stamped the freshness watermark on BOTH series: the
+    # tenant-keyed one the FreshnessObjective reads, plus the
+    # generation-labelled identity series
+    g = reg.gauge("svgd_serving_watermark")
+    assert g.value() == 123.0
+    assert g.value(generation="2") == 123.0
+    # the promoted ensemble now serves
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    ref = PredictiveEngine("logreg", cand, min_bucket=4, max_bucket=4,
+                           registry=MetricsRegistry())
+    np.testing.assert_array_equal(eng.predict(x)["mean"],
+                                  ref.predict(x)["mean"])
+    assert not ro.active
+    ro.close()
+
+
+def test_controller_rolls_back_on_divergence_without_checkpoint_io(rng):
+    """A breaching candidate is dropped in O(1): the resident incumbent
+    keeps serving bitwise-identically and the checkpoint-consuming seam
+    (``engine.reload``) is never called — the zero-I/O rollback pin."""
+    eng, parts = _engine(rng)
+    reg = eng.registry
+    clock = ManualClock()
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    before = {k: np.array(v, copy=True) for k, v in eng.predict(x).items()}
+    reloads = []
+    orig = eng.reload
+    eng.reload = lambda *a, **k: (reloads.append(1), orig(*a, **k))[1]
+    ro = _controller(eng, clock, max_divergence=0.05, breach_streak=1)
+    assert ro.offer(parts * np.float32(1e6), tag="bad")
+    _observe_divergence(reg, 0.9, times=3)
+    clock.advance(0.1)
+    d = ro.step()
+    assert d["action"] == "rollback"
+    assert d["objectives"] == ["shadow_divergence"]
+    assert d["at_stage"] == "shadow"
+    assert not ro.active
+    st = eng.stats()
+    assert st["generation_id"] == 1
+    assert st["candidate_generation_id"] is None
+    after = eng.predict(x)
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k])
+    assert not reloads
+    del eng.reload
+    assert ro.status()["rollbacks"] == 1
+    ro.close()
+
+
+def test_controller_breach_streak_rides_out_one_bad_window(rng):
+    eng, parts = _engine(rng)
+    reg = eng.registry
+    clock = ManualClock()
+    ro = _controller(eng, clock, max_divergence=0.05, breach_streak=2)
+    ro.offer(parts + np.float32(1e-3))
+    _observe_divergence(reg, 0.9)
+    clock.advance(0.1)
+    assert ro.step()["action"] == "breach"  # streak 1 of 2: no rollback
+    assert ro.active
+    _observe_divergence(reg, 1e-4, times=2)  # window recovers
+    clock.advance(1.0)
+    assert ro.step()["action"] == "advance"  # streak reset by green
+    ro.close()
+
+
+def test_offer_supersede_and_defer(rng):
+    eng, parts = _engine(rng)
+    clock = ManualClock()
+    ro = _controller(eng, clock, on_active="supersede")
+    assert ro.offer(parts + np.float32(1e-3), tag="first")
+    gen_first = eng.stats()["candidate_generation_id"]
+    assert ro.offer(parts + np.float32(2e-3), tag="second")
+    assert eng.stats()["candidate_generation_id"] != gen_first
+    assert ro.status()["supersedes"] == 1
+    ro.close()
+    eng2, parts2 = _engine(np.random.default_rng(3))
+    ro2 = _controller(eng2, ManualClock(), on_active="defer")
+    assert ro2.offer(parts2 + np.float32(1e-3), tag="first")
+    assert not ro2.offer(parts2 + np.float32(2e-3), tag="second")
+    assert ro2.status()["tag"] == "first"
+    ro2.close()
+
+
+def test_engine_rollback_is_a_pair_exchange(rng):
+    """Satellite 1: the previous generation stays resident; rollback is
+    a swap (a second rollback recovers the newer generation) and never
+    touches checkpoint I/O."""
+    eng, parts = _engine(rng)
+    new = parts + np.float32(0.5)
+    eng.reload(new, tag="gen2")
+    assert eng.stats()["generation_id"] == 2
+    assert eng.stats()["previous_generation_id"] == 1
+    x = rng.normal(size=(2, 4)).astype(np.float32)
+    out_gen2 = {k: np.array(v, copy=True)
+                for k, v in eng.predict(x).items()}
+    info = eng.rollback()
+    assert info["generation_id"] == 1
+    assert eng.stats()["previous_generation_id"] == 2
+    info = eng.rollback()  # EXCHANGE, not a one-shot: gen2 comes back
+    assert info["generation_id"] == 2
+    after = eng.predict(x)
+    for k in out_gen2:
+        np.testing.assert_array_equal(out_gen2[k], after[k])
+
+
+# --------------------------------------------------------------------- #
+# batcher split/mirror seam + registry lifecycle
+
+
+def _wait(pred, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+def test_batcher_split_mirror_and_generation_labels(rng):
+    """Live traffic through the registry's batcher: mirrors flow off the
+    client path and are never client requests; canary-split requests
+    land on the candidate's OWN label set; promotion serves the
+    candidate ensemble."""
+    metrics = MetricsRegistry()
+    reg = ModelRegistry(metrics=metrics, max_batch=4, max_wait_ms=0.5)
+    parts = rng.normal(size=(16, 5)).astype(np.float32)
+    reg.add_tenant("prod", "logreg", particles=parts,
+                   min_bucket=4, max_bucket=4)
+    reg.warm()
+    clock = ManualClock()
+    ro = reg.begin_rollout(
+        "prod", controller=RolloutController(
+            reg.tenant("prod").engine, metrics=metrics, clock=clock,
+            plan=RolloutPlan(shadow_fraction=0.9, shadow_min_mirrors=1,
+                             shadow_hold_s=0.0, canary_stages=(0.5, 1.0),
+                             stage_hold_s=0.0, stage_min_requests=1,
+                             max_divergence=1.0, p99_ms=1e5)))
+    cand = parts + np.float32(1e-3)
+    assert ro.offer(cand, tag="good")
+    x = rng.normal(size=(4, 4)).astype(np.float32)
+    n_client = 0
+    for _ in range(12):
+        reg.submit("prod", x).result(timeout=10)
+        n_client += 1
+    m_mirrors = metrics.counter("svgd_rollout_mirrors_total")
+    assert _wait(lambda: m_mirrors.value(tenant="prod") >= 1)
+    req_counter = metrics.counter("svgd_serve_requests_total")
+    # shadow: every client request resolved on the incumbent series —
+    # mirrored dispatches are NOT client requests
+    assert req_counter.value(tenant="prod") == n_client
+    assert req_counter.value(tenant="prod", generation="candidate") == 0
+    clock.advance(0.1)
+    assert ro.step()["action"] == "advance"  # canary 0.5
+    for _ in range(24):
+        reg.submit("prod", x).result(timeout=10)
+        n_client += 1
+    # the 0.5 split sent a deterministic subset to the candidate's own
+    # label set; incumbent + candidate account for every client request
+    cand_served = req_counter.value(tenant="prod", generation="candidate")
+    assert cand_served > 0
+    assert req_counter.value(tenant="prod") + cand_served == n_client
+    clock.advance(0.1)
+    assert ro.step()["action"] == "advance"  # canary 1.0
+    reg.submit("prod", x).result(timeout=10)
+    n_client += 1
+    assert _wait(lambda: req_counter.value(
+        tenant="prod", generation="candidate") > cand_served)
+    clock.advance(0.1)
+    assert ro.step()["action"] == "promote"
+    # post-promote traffic serves the candidate ensemble on the plain
+    # tenant series again
+    ref = PredictiveEngine("logreg", cand, min_bucket=4, max_bucket=4,
+                           registry=MetricsRegistry())
+    np.testing.assert_array_equal(
+        reg.submit("prod", x).result(timeout=10)["mean"],
+        ref.predict(x)["mean"])
+    reg.end_rollout("prod")
+    reg.close()
+
+
+def test_registry_rollout_lifecycle(rng):
+    metrics = MetricsRegistry()
+    reg = ModelRegistry(metrics=metrics, max_wait_ms=0.5)
+    for name in ("a", "b"):
+        reg.add_tenant(name, "logreg",
+                       particles=rng.normal(size=(8, 5)).astype(np.float32),
+                       min_bucket=4, max_bucket=4)
+    ro = reg.begin_rollout("a")
+    assert reg.begin_rollout("a") is ro  # idempotent for the same tenant
+    with pytest.raises(RuntimeError, match="already armed"):
+        reg.begin_rollout("b")
+    assert reg.rollout_status()["tenant"] == "a"
+    eng = reg.tenant("a").engine
+    ro.offer(np.asarray(eng.particles) + np.float32(1e-3))
+    assert eng.stats()["candidate_generation_id"] is not None
+    reg.end_rollout("a")  # disarm drops the in-flight candidate
+    assert eng.stats()["candidate_generation_id"] is None
+    assert reg.rollout_status() is None
+    assert reg.batcher.rollout is None
+    # removing the rollout tenant disarms too
+    ro2 = reg.begin_rollout("b")
+    assert reg.rollout_status()["tenant"] == "b"
+    reg.remove_tenant("b")
+    assert reg.rollout_status() is None
+    assert reg.batcher.rollout is None
+    assert not ro2.active
+    reg.close()
+
+
+def test_tenant_summary_and_stats_carry_generation_identity(rng):
+    reg = ModelRegistry(metrics=MetricsRegistry(), max_wait_ms=0.5)
+    reg.add_tenant("prod", "logreg",
+                   particles=rng.normal(size=(8, 5)).astype(np.float32),
+                   min_bucket=4, max_bucket=4)
+    row = reg.tenant("prod").summary()
+    assert row["generation_id"] == 1
+    assert row["previous_generation_id"] is None
+    assert row["candidate_generation_id"] is None
+    reg.tenant("prod").engine.reload(
+        rng.normal(size=(8, 5)).astype(np.float32), tag="gen2")
+    row = reg.tenant("prod").summary()
+    assert row["generation_id"] == 2
+    assert row["previous_generation_id"] == 1
+    reg.close()
+
+
+# --------------------------------------------------------------------- #
+# hot-reloader offer path (the streaming publish leg's seam)
+
+
+def test_reloader_offers_candidate_instead_of_swapping(tmp_path, rng):
+    eng, parts = _engine(rng)
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, every=1, backend="npz")
+    new = parts + np.float32(0.25)
+    mgr.save(2, {"particles": new,
+                 "stream_watermark": np.float64(777.0)})
+    clock = ManualClock()
+    ro = _controller(eng, clock)
+    reloader = CheckpointHotReloader(eng, root, rollout=ro,
+                                     baseline_step=1)
+    assert reloader.poll_once() == 2
+    st = eng.stats()
+    # offered, NOT swapped: serving generation unchanged, candidate
+    # resident, freshness watermark NOT stamped until promotion
+    assert st["generation_id"] == 1
+    assert st["candidate_generation_id"] is not None
+    assert reloader.loaded_step == 2
+    assert not eng.registry.gauge("svgd_serving_watermark").has()
+    assert reloader.poll_once() is None  # step marked seen
+    # walk it to promotion: the rollout stamps the offered watermark
+    _observe_divergence(eng.registry, 1e-4, times=3)
+    clock.advance(1.1)
+    assert ro.step()["action"] == "advance"
+    _observe_candidate_latency(eng.registry, 0.001)
+    clock.advance(1.1)
+    assert ro.step()["action"] == "advance"
+    _observe_candidate_latency(eng.registry, 0.001)
+    clock.advance(1.1)
+    d = ro.step()
+    assert d["action"] == "promote" and d["watermark"] == 777.0
+    assert eng.registry.gauge("svgd_serving_watermark").value() == 777.0
+    ro.close()
+
+
+# --------------------------------------------------------------------- #
+# BadGenerationAt
+
+
+def test_bad_generation_at_validates():
+    with pytest.raises(ValueError, match="kind"):
+        BadGenerationAt(0, kind="melt")
+    with pytest.raises(ValueError, match="until"):
+        BadGenerationAt(5, until=5)
+    with pytest.raises(ValueError, match="magnitude"):
+        BadGenerationAt(0, kind="saturate", magnitude=1.0)
+
+
+def test_bad_generation_at_window_and_purity(rng):
+    fault = BadGenerationAt(2, kind="saturate", magnitude=1e6, until=4)
+    assert [fault.active(i) for i in range(6)] == [
+        False, False, True, True, False, False]
+    parts = rng.normal(size=(8, 5)).astype(np.float32)
+    ref = parts.copy()
+    out1 = fault.apply(parts)
+    out2 = fault.apply(parts)
+    np.testing.assert_array_equal(parts, ref)  # pure: input untouched
+    np.testing.assert_array_equal(out1, out2)  # deterministic
+    assert np.all(np.isfinite(out1))  # passes admission health checks
+    np.testing.assert_allclose(out1, parts * 1e6, rtol=1e-6)
+    scr = BadGenerationAt(0, kind="scramble").apply(parts)
+    assert scr.shape == parts.shape
+    assert np.all(np.isfinite(scr))
+    np.testing.assert_array_equal(scr, -parts[:, ::-1])

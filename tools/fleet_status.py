@@ -86,6 +86,7 @@ def build_report(first: Dict[str, Any], second: Optional[Dict[str, Any]],
         "replicas": {rid: {"state": st.get("state"),
                            "reason": st.get("reason"),
                            "ejections": st.get("ejections"),
+                           "generation": st.get("generation"),
                            "last_healthy_age_s": st.get(
                                "last_healthy_age_s")}
                      for rid, st in (doc.get("replicas") or {}).items()},
@@ -105,6 +106,10 @@ def render(report: Dict[str, Any]) -> str:
     for rid in sorted(report["replicas"]):
         st = report["replicas"][rid]
         line = f"  {rid:12s} {st['state']:9s}"
+        if st.get("generation") is not None:
+            # per-replica serving generation: a mid-rollout fleet shows
+            # which replicas already flipped to the new posterior
+            line += f" gen={st['generation']}"
         if st.get("reason"):
             line += f" reason={st['reason']}"
         if st.get("ejections"):

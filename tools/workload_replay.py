@@ -247,6 +247,14 @@ def replay(events, submit, *, clock=time.perf_counter, sleep=time.sleep,
     ``error`` (any other failure) / ``lost`` (never resolved — always a
     bug, gated unconditionally in ``perf_regress``).
 
+    Rollout drivers may append extra ``status="mirror"`` records for
+    shadow-mirrored candidate dispatches (batcher-internal duplicates of
+    client requests during a :class:`RolloutController` shadow phase).
+    ``window_metrics`` classifies those separately: they are never
+    counted as client ``ok``/``shed``/``error``/``lost``, never enter
+    the goodput or latency numbers, and never appear in ``offered`` —
+    mirrored work is capacity spent, not traffic served.
+
     ``submit(event) -> Future`` raises ``Overloaded`` to shed.  Latency is
     charged from the *scheduled* arrival, so queue backlog shows up in the
     numbers instead of hiding in the generator (no coordinated omission).
@@ -322,8 +330,16 @@ def window_metrics(records, t0, t1, good_ms):
     """Aggregate one ``[t0, t1)`` window of replay records.  ``goodput``
     counts completions within ``good_ms`` of their scheduled arrival —
     work the user actually experienced as served (a completion past the
-    objective is capacity spent on a lost cause)."""
-    sel = [r for r in records if t0 <= r["t"] < t1]
+    objective is capacity spent on a lost cause).
+
+    ``status="mirror"`` records (shadow-mirrored rollout dispatches) are
+    counted in their own ``mirrors`` field and excluded from every
+    client-facing number — ``offered``, completions, sheds, errors,
+    losses, goodput, and the latency percentiles all describe real
+    client traffic only."""
+    win = [r for r in records if t0 <= r["t"] < t1]
+    mirrors = sum(1 for r in win if r["status"] == "mirror")
+    sel = [r for r in win if r["status"] != "mirror"]
     lats = sorted(r["lat_ms"] for r in sel if r["status"] == "ok")
     good = sum(1 for r in sel
                if r["status"] == "ok" and r["lat_ms"] <= good_ms)
@@ -335,6 +351,7 @@ def window_metrics(records, t0, t1, good_ms):
         "shed": sum(1 for r in sel if r["status"] == "shed"),
         "errors": sum(1 for r in sel if r["status"] == "error"),
         "lost": sum(1 for r in sel if r["status"] == "lost"),
+        "mirrors": mirrors,
         "good": good,
         "goodput_rps": round(good / span, 1),
         "p50_ms": round(_percentile(lats, 0.50), 3),
@@ -375,6 +392,25 @@ def time_to_recover(records, burst_end_s, target_ms, duration_s):
         if lats and not shed and _percentile(lats, 0.99) <= target_ms:
             return round(max(b - burst_end_s, 0.0), 3)
     return round(duration_s - burst_end_s, 3)
+
+
+def mirror_counts(metrics, tenant=None):
+    """Batcher-internal shadow-mirror accounting from a
+    ``MetricsRegistry``.  Mirrored candidate dispatches during a rollout
+    shadow phase ride off the client's critical path — no replay future
+    ever resolves for them — so the rollout counters are the only place
+    they are visible.  Returns ``{"mirrors", "mirror_dropped",
+    "mirror_errors"}``, reported *alongside* (never inside) the client
+    ok/shed/error/lost numbers."""
+    labels = {} if tenant is None else {"tenant": tenant}
+    out = {}
+    for field, name in (
+            ("mirrors", "svgd_rollout_mirrors_total"),
+            ("mirror_dropped", "svgd_rollout_mirror_dropped_total"),
+            ("mirror_errors", "svgd_rollout_mirror_errors_total")):
+        metric = metrics.get(name)
+        out[field] = int(metric.value(**labels)) if metric is not None else 0
+    return out
 
 
 def make_submit(batcher, pools, model_registry=None):
