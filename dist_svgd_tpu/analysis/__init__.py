@@ -1,0 +1,40 @@
+"""Static program analysis of compiled plans (the hardware-independent
+half of the perf contract).
+
+``registry`` records every ``Plan.compile``/``compile_sharded`` product
+with the avals of its first call; ``audit`` re-lowers each recorded
+program and distills a **program card** (collective inventory, donation
+verdict, n×n detector, dtype story) plus findings under the jaxlint-style
+**XP001–XP005** rule family; ``stablehlo`` holds the text-level parsers
+both lean on.  ``tools/program_audit.py`` gates the cards against a
+committed baseline; ``tests/test_program_audit.py`` enforces the
+zero-finding baseline in tier-1.
+"""
+
+from dist_svgd_tpu.analysis.audit import (
+    COLLECTIVE_PRIMS,
+    ProgramCard,
+    XP_RULES,
+    audit_entry,
+    audit_registry,
+    xp_findings,
+)
+from dist_svgd_tpu.analysis.registry import (
+    ProgramEntry,
+    ProgramRegistry,
+    default_registry,
+    use_registry,
+)
+
+__all__ = [
+    "COLLECTIVE_PRIMS",
+    "ProgramCard",
+    "ProgramEntry",
+    "ProgramRegistry",
+    "XP_RULES",
+    "audit_entry",
+    "audit_registry",
+    "default_registry",
+    "use_registry",
+    "xp_findings",
+]

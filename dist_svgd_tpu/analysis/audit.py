@@ -1,0 +1,355 @@
+"""Program cards + the XP rule family: static audit of compiled plans.
+
+A **program card** is the audit summary of one compiled entrypoint (one
+:class:`~dist_svgd_tpu.analysis.registry.ProgramEntry`): the collective
+inventory from walking its jaxpr, the donation/aliasing verdict and
+buffer inventory from its lowered StableHLO text, and the dtype story of
+inputs vs internals.  Cards are pure data (``as_dict`` round-trips to
+JSON) so ``tools/program_audit.py`` can diff them against a committed
+baseline on the 2-core CPU box — the hardware-independent proof ROADMAP
+items 1–2 kept stalling on.
+
+Findings ride the jaxlint ``Finding`` machinery (same dataclass, same
+allowlist) under the **XP** rule family — program-level rules, distinct
+from the AST-level JL family because there is no source line to hang a
+disable comment on; the path is the pseudo-URL ``plan://<label>`` and the
+allowlist (path-suffix matching) is the blessing mechanism:
+
+- **XP001 materialized-nxn** — a program whose call site *declared*
+  ``gram_free`` (Pallas φ, or an active rff/nystrom kernel approximation)
+  lowered a tensor with two axes equal to the particle count: the Gram
+  matrix the whole design exists to avoid is back in HBM.  Exact-φ
+  programs legitimately materialize (m, n) tiles and never declare.
+- **XP002 collective-in-unsharded-plan** — a plan with ``num_shards == 1``
+  lowered cross-device collectives (psum/all_gather/...): either the mesh
+  plumbing regressed or a shard_map leaked into the single-device path.
+- **XP003 donation-dropped** — ``donate_argnums`` was declared and at
+  least one donated leaf has a shape/dtype-matching output to alias, yet
+  the lowered module carries fewer aliasing/donor markers than those
+  matches: jax dropped the donation silently (the classic "warning
+  suppressed, win lost" regression).  Also fires when the call site's
+  ``expect_donation`` meta says the program is *supposed* to donate but
+  ``donate_argnums`` arrived empty — the "``donate_carries`` stripped"
+  red path.  Structurally unaliasable donations (reduction kernels whose
+  outputs match no donated input — the serving engine's deliberate case)
+  are exempt by construction.
+- **XP004 f64-promotion** — f64 tensors materialize inside a program none
+  of whose inputs (arguments *or* closed-over constants) are f64: a
+  weak-type leak doubled the bandwidth bill.  Tier-1 runs with x64
+  enabled, so this keys on the promotion, not on f64 existing.
+- **XP005 bf16-pollution** — a program whose call site pinned f32
+  (``pinned_f32`` meta) lowered bf16 internals with no bf16 input: the
+  low-precision path bled into the pinned one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dist_svgd_tpu.analysis import stablehlo as shlo
+from dist_svgd_tpu.analysis.registry import ProgramEntry, ProgramRegistry
+
+try:  # the repo checkout: share jaxlint's Finding + allowlist machinery
+    from tools.jaxlint.core import Finding
+except Exception:  # standalone package install without tools/ on the path
+    @dataclasses.dataclass(frozen=True)
+    class Finding:  # type: ignore[no-redef]
+        path: str
+        line: int
+        rule: str
+        message: str
+
+        def format(self) -> str:
+            return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+        def as_dict(self) -> dict:
+            return dataclasses.asdict(self)
+
+
+__all__ = [
+    "COLLECTIVE_PRIMS",
+    "Finding",
+    "ProgramCard",
+    "XP_RULES",
+    "audit_entry",
+    "audit_registry",
+    "xp_findings",
+]
+
+XP_RULES: Dict[str, str] = {
+    "XP001": "materialized n×n buffer in a gram-free-declared program",
+    "XP002": "cross-shard collective lowered in a single-shard plan",
+    "XP003": "donation declared but aliasing dropped / stripped",
+    "XP004": "silent f32→f64 promotion (f64 internals, no f64 input)",
+    "XP005": "bf16 pollution of a pinned-f32 program",
+}
+
+#: jaxpr primitives that move bytes across the mesh axis.
+COLLECTIVE_PRIMS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pbroadcast", "reduce_scatter", "psum_scatter",
+}
+
+_HLO_DTYPE = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "int64": "i64", "int32": "i32", "int16": "i16",
+    "int8": "i8", "bool": "i1", "uint64": "ui64", "uint32": "ui32",
+    "uint16": "ui16", "uint8": "ui8", "complex64": "c64",
+    "complex128": "c128",
+}
+
+
+def _hlo_dtype(dt: Any) -> str:
+    return _HLO_DTYPE.get(np.dtype(dt).name, np.dtype(dt).name)
+
+
+def _aval_sig(a: Any) -> str:
+    return f"{_hlo_dtype(a.dtype)}[{','.join(str(d) for d in a.shape)}]"
+
+
+@dataclasses.dataclass
+class ProgramCard:
+    """One compiled program's audit summary (see module docstring)."""
+
+    label: str
+    kind: str                      # 'compile' | 'compile_sharded'
+    num_shards: int
+    input_signature: str           # "f64[24,3],f64[],i32[]" — the card key
+    input_dtypes: List[str]        # args + closed-over consts, sorted
+    internal_dtypes: List[str]
+    collectives: Dict[str, int]            # prim name -> count
+    collective_payload_bytes: Dict[str, int]  # mesh axis -> bytes moved
+    donated_leaves: int
+    aliasable_leaves: int
+    donation_markers: int
+    donation_ok: bool
+    n_particles: Optional[int]
+    nxn_buffers: int
+    largest_intermediate_bytes: int
+    peak_live_bytes_est: int
+    meta: Dict[str, Any]
+
+    @property
+    def key(self) -> str:
+        """Stable identity across runs: label + first-call signature
+        (one serving label covers many buckets — each bucket is its own
+        card)."""
+        return f"{self.label}({self.input_signature})"
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+
+# ------------------------------------------------------------------ #
+# jaxpr walking
+
+def _sub_jaxprs(value: Any):
+    items = value if isinstance(value, (list, tuple)) else (value,)
+    for item in items:
+        if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+            yield item.jaxpr          # ClosedJaxpr
+        elif hasattr(item, "eqns"):
+            yield item                # raw Jaxpr
+
+
+def _walk_eqns(jaxpr, visit) -> None:
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                _walk_eqns(sub, visit)
+
+
+def _collective_axes(params: dict) -> Tuple[str, ...]:
+    axes = params.get("axes", params.get("axis_name", ()))
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+def collective_inventory(closed_jaxpr) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(op -> count, mesh axis -> payload bytes) over the whole program,
+    sub-jaxprs (pjit/shard_map/scan bodies) included.  Payload counts each
+    collective's *input* bytes once per occurrence in the program text —
+    a scanned collective is one occurrence (per-step traffic, which is
+    what the card gates; total-step traffic is run-length dependent)."""
+    counts: Dict[str, int] = {}
+    payload: Dict[str, int] = {}
+
+    def visit(eqn):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMS:
+            return
+        counts[name] = counts.get(name, 0) + 1
+        nbytes = 0
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                nbytes += int(np.prod(aval.shape, dtype=np.int64)
+                              * np.dtype(aval.dtype).itemsize)
+        for axis in _collective_axes(eqn.params) or ("<unnamed>",):
+            payload[axis] = payload.get(axis, 0) + nbytes
+
+    _walk_eqns(closed_jaxpr.jaxpr, visit)
+    return counts, payload
+
+
+# ------------------------------------------------------------------ #
+# card construction
+
+def _flat_avals(entry: ProgramEntry, argnums: Sequence[int]) -> List[Any]:
+    import jax
+
+    out: List[Any] = []
+    args = entry.call_args()
+    for i in argnums:
+        if i < len(args) and i not in entry.static_argnums:
+            out.extend(jax.tree_util.tree_leaves(args[i]))
+    return out
+
+
+def _greedy_alias_matches(donated: List[Any], outputs: List[Any]) -> int:
+    """How many donated leaves have a shape+dtype-matching output buffer to
+    alias (each output matches at most once) — the count of aliasing
+    markers a donation-preserving lowering must carry."""
+    pool: Dict[Tuple, int] = {}
+    for o in outputs:
+        k = (tuple(o.shape), np.dtype(o.dtype).name)
+        pool[k] = pool.get(k, 0) + 1
+    hits = 0
+    for d in donated:
+        k = (tuple(d.shape), np.dtype(d.dtype).name)
+        if pool.get(k, 0) > 0:
+            pool[k] -= 1
+            hits += 1
+    return hits
+
+
+def audit_entry(entry: ProgramEntry) -> Optional[ProgramCard]:
+    """Build the card for one registry entry; ``None`` when the program
+    died (weakref cleared) or was never called (no avals to re-lower
+    with).  Re-lowering is trace-time work on the entry's captured avals —
+    it never executes the program."""
+    import jax
+
+    fn = entry.ref()
+    if fn is None or not entry.captured:
+        return None
+    args = entry.call_args()
+
+    closed = jax.make_jaxpr(
+        fn, static_argnums=entry.static_argnums or ())(*args)
+    counts, payload = collective_inventory(closed)
+
+    text = ""
+    if hasattr(fn, "lower"):
+        text = fn.lower(*args).as_text()
+
+    traced = [i for i in range(len(args)) if i not in entry.static_argnums]
+    in_leaves = _flat_avals(entry, traced)
+    const_avals = [jax.ShapeDtypeStruct(np.shape(c), c.dtype)
+                   for c in closed.consts if hasattr(c, "dtype")]
+    input_dtypes = sorted({_hlo_dtype(a.dtype)
+                           for a in in_leaves + const_avals})
+    donated = _flat_avals(entry, entry.donate_argnums)
+    aliasable = _greedy_alias_matches(donated, list(closed.out_avals))
+    markers = shlo.donation_marker_count(text)
+
+    p_arg = entry.meta.get("particles_arg", 0)
+    n = None
+    if p_arg is not None:  # None = no particle-shaped argument (W2 stacks)
+        p_leaves = _flat_avals(entry, (int(p_arg),))
+        if p_leaves and len(p_leaves[0].shape) >= 1:
+            n = int(p_leaves[0].shape[0])
+
+    return ProgramCard(
+        label=entry.label,
+        kind=entry.kind,
+        num_shards=entry.num_shards,
+        input_signature=",".join(_aval_sig(a) for a in in_leaves),
+        input_dtypes=input_dtypes,
+        internal_dtypes=sorted(shlo.internal_dtypes(text)),
+        collectives=dict(sorted(counts.items())),
+        collective_payload_bytes=dict(sorted(payload.items())),
+        donated_leaves=len(donated),
+        aliasable_leaves=aliasable,
+        donation_markers=markers,
+        donation_ok=(markers >= aliasable),
+        n_particles=n,
+        nxn_buffers=(shlo.nxn_buffer_count(text, n) if n else 0),
+        largest_intermediate_bytes=shlo.largest_tensor_bytes(text),
+        peak_live_bytes_est=shlo.peak_live_bytes(text),
+        meta=dict(entry.meta),
+    )
+
+
+# ------------------------------------------------------------------ #
+# the XP rules (pure on the card — red paths are unit-testable without
+# recompiling anything)
+
+def xp_findings(card: ProgramCard) -> List[Finding]:
+    path = f"plan://{card.label}"
+    meta = card.meta
+    out: List[Finding] = []
+
+    if meta.get("gram_free") and card.nxn_buffers > 0:
+        out.append(Finding(path, 0, "XP001", (
+            f"program declares gram_free but lowers {card.nxn_buffers} "
+            f"{card.n_particles}x{card.n_particles} buffer(s) — the Gram "
+            "matrix is materialized"
+        )))
+
+    if card.num_shards == 1 and card.collectives:
+        inv = ", ".join(f"{k}x{v}" for k, v in card.collectives.items())
+        out.append(Finding(path, 0, "XP002", (
+            f"single-shard plan lowers cross-device collectives ({inv})"
+        )))
+
+    if card.donated_leaves and card.donation_markers < card.aliasable_leaves:
+        out.append(Finding(path, 0, "XP003", (
+            f"donation declared for {card.donated_leaves} leaf/leaves with "
+            f"{card.aliasable_leaves} aliasable output match(es), but the "
+            f"lowering carries only {card.donation_markers} aliasing/donor "
+            "marker(s) — donation silently dropped"
+        )))
+    elif meta.get("expect_donation") and not card.donated_leaves:
+        out.append(Finding(path, 0, "XP003", (
+            "call site expects carry donation (expect_donation meta) but "
+            "donate_argnums arrived empty — donation stripped"
+        )))
+
+    if (not meta.get("allow_f64") and "f64" in card.internal_dtypes
+            and "f64" not in card.input_dtypes):
+        out.append(Finding(path, 0, "XP004", (
+            "f64 tensors materialize inside a program with no f64 input — "
+            "weak-type promotion doubled the bandwidth"
+        )))
+
+    if (meta.get("pinned_f32") and "bf16" in card.internal_dtypes
+            and "bf16" not in card.input_dtypes):
+        out.append(Finding(path, 0, "XP005", (
+            "bf16 internals in a pinned-f32 program with no bf16 input"
+        )))
+    return out
+
+
+def audit_registry(registry: ProgramRegistry, *, label_prefix: str = "",
+                   ) -> Tuple[List[ProgramCard], List[Finding]]:
+    """Cards + findings for every live, called entry — deduplicated by
+    card key (rebuilt kernels for the same label+signature audit once)."""
+    cards: Dict[str, ProgramCard] = {}
+    for entry in registry.entries(captured_only=True,
+                                  label_prefix=label_prefix):
+        card = audit_entry(entry)
+        if card is not None and card.key not in cards:
+            cards[card.key] = card
+    ordered = [cards[k] for k in sorted(cards)]
+    findings: List[Finding] = []
+    for card in ordered:
+        findings.extend(xp_findings(card))
+    return ordered, findings

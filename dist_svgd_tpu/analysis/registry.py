@@ -1,0 +1,243 @@
+"""Program registry: every ``Plan.compile``/``compile_sharded`` product,
+observable after the fact.
+
+The auditor (``analysis/audit.py``, ``tools/program_audit.py``,
+``tests/test_program_audit.py``) needs two things the compile seam alone
+cannot give it: the *set* of programs a run actually compiled, and the
+argument avals each was first called with (re-lowering needs concrete
+shapes; the compile call itself only sees a Python callable).  So
+``Plan`` routes every compiled program through :meth:`ProgramRegistry.
+track`, which records an entry and returns a wrapper that snapshots the
+first call's ``ShapeDtypeStruct`` tree, then gets out of the way (one
+bool check per steady-state dispatch — the same discipline as plan.py's
+``_quiet_first_call``).
+
+Memory discipline, because this rides *every* compile across a ~600-test
+tier-1 run:
+
+- the entry holds a **weakref** to the jit object — the registry never
+  extends the life of a compiled executable or the ensemble it closes
+  over; a dead entry is skipped by :meth:`entries` and pruned on the next
+  :meth:`track`.
+- the entry count is **bounded** (FIFO eviction past ``capacity``) so a
+  pathological compile loop cannot grow the registry without bound.
+
+Tests and the audit tool that want a private view swap the process
+default with :func:`use_registry` (a context manager) — the seam in
+plan.py always asks :func:`default_registry` at compile time, never
+caches it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+__all__ = [
+    "ProgramEntry",
+    "ProgramRegistry",
+    "default_registry",
+    "use_registry",
+]
+
+
+def _as_tuple(v: Union[int, Sequence[int], Tuple]) -> Tuple[int, ...]:
+    if v is None:
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    return tuple(v)
+
+
+def _aval_of(x: Any) -> jax.ShapeDtypeStruct:
+    dtype = getattr(x, "dtype", None)
+    if dtype is None:
+        dtype = np.result_type(x)
+    return jax.ShapeDtypeStruct(np.shape(x), dtype)
+
+
+class ProgramEntry:
+    """One compiled program: identity, compile-time declarations, and the
+    first call's aval snapshot (``None`` until called / if uncapturable).
+
+    ``meta`` carries the call site's audit declarations (the ``audit=``
+    kwarg of ``Plan.compile``): ``gram_free`` (the program *claims* no n×n
+    Gram materialization — arms XP001), ``pinned_f32`` (arms XP005),
+    ``expect_donation`` (XP003's stripped-donation check),
+    ``particles_arg`` (which positional arg carries the ``(n, d)``
+    ensemble; default 0), ``allow_f64`` (disarms XP004).
+    """
+
+    __slots__ = ("seq", "label", "kind", "num_shards", "donate_argnums",
+                 "static_argnums", "meta", "ref", "avals", "calls")
+
+    def __init__(self, seq: int, label: str, kind: str, num_shards: int,
+                 donate_argnums: Tuple[int, ...],
+                 static_argnums: Tuple[int, ...],
+                 meta: Optional[dict], ref: "weakref.ref"):
+        self.seq = seq
+        self.label = label
+        self.kind = kind
+        self.num_shards = num_shards
+        self.donate_argnums = donate_argnums
+        self.static_argnums = static_argnums
+        self.meta = dict(meta or {})
+        self.ref = ref
+        self.avals: Optional[Tuple[Any, ...]] = None
+        self.calls = 0
+
+    # -------------------------------------------------------------- #
+
+    @property
+    def alive(self) -> bool:
+        return self.ref() is not None
+
+    @property
+    def captured(self) -> bool:
+        return self.avals is not None
+
+    def call_args(self) -> Tuple[Any, ...]:
+        """The first call, re-playable against ``lower``/``make_jaxpr``:
+        traced positions as ``ShapeDtypeStruct``, static positions as the
+        raw Python values the call passed."""
+        if self.avals is None:
+            raise ValueError(f"program {self.label!r} was never called")
+        return self.avals
+
+    def describe(self) -> dict:
+        return {
+            "label": self.label,
+            "kind": self.kind,
+            "num_shards": self.num_shards,
+            "donate_argnums": list(self.donate_argnums),
+            "static_argnums": list(self.static_argnums),
+            "meta": dict(self.meta),
+            "captured": self.captured,
+            "alive": self.alive,
+            "calls": self.calls,
+        }
+
+
+class ProgramRegistry:
+    """Bounded, thread-safe store of :class:`ProgramEntry` (see module
+    docstring for the lifetime rules)."""
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: List[ProgramEntry] = []
+        self._seq = itertools.count()
+
+    # -------------------------------------------------------------- #
+
+    def track(self, compiled: Callable, *, label: str, kind: str,
+              num_shards: int = 1,
+              donate_argnums: Union[int, Sequence[int], Tuple] = (),
+              static_argnums: Union[int, Sequence[int], Tuple] = (),
+              meta: Optional[dict] = None) -> Callable:
+        """Register ``compiled`` and return the aval-capturing wrapper the
+        caller should hand out in its place.
+
+        The wrapper delegates every call; the first positional-only call
+        additionally snapshots arg avals into the entry.  A call with
+        kwargs (no plan call site uses them) skips capture rather than
+        guessing at jit's kwarg flattening.
+        """
+        static = _as_tuple(static_argnums)
+        try:
+            ref = weakref.ref(compiled)
+        except TypeError:
+            # unweakrefable callable (builtins, some C wrappers): keep a
+            # strong ref — rare enough that the leak rule above tolerates it
+            ref = (lambda c=compiled: c)
+        with self._lock:
+            entry = ProgramEntry(
+                next(self._seq), label, kind, num_shards,
+                _as_tuple(donate_argnums), static, meta, ref,
+            )
+            self._entries = [e for e in self._entries if e.alive]
+            self._entries.append(entry)
+            if len(self._entries) > self._capacity:
+                del self._entries[: len(self._entries) - self._capacity]
+
+        state = {"captured": False}
+        guard = threading.Lock()
+
+        def dispatch(*args, **kwargs):
+            if not state["captured"]:
+                with guard:
+                    if not state["captured"]:
+                        if not kwargs:
+                            try:
+                                entry.avals = tuple(
+                                    args[i] if i in static
+                                    else jax.tree_util.tree_map(
+                                        _aval_of, args[i])
+                                    for i in range(len(args))
+                                )
+                            except Exception:
+                                entry.avals = None
+                        state["captured"] = True
+            entry.calls += 1
+            return compiled(*args, **kwargs)
+
+        dispatch.program_entry = entry  # type: ignore[attr-defined]
+        return dispatch
+
+    # -------------------------------------------------------------- #
+
+    def entries(self, *, captured_only: bool = False,
+                label_prefix: str = "") -> List[ProgramEntry]:
+        """Live entries, registration order (a snapshot — safe to iterate
+        while other threads compile)."""
+        with self._lock:
+            snap = list(self._entries)
+        return [e for e in snap
+                if e.alive
+                and (not captured_only or e.captured)
+                and e.label.startswith(label_prefix)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries = []
+
+    def __len__(self) -> int:
+        return len(self.entries())
+
+
+_default = ProgramRegistry()
+_default_lock = threading.Lock()
+
+
+def default_registry() -> ProgramRegistry:
+    """The process-wide registry ``Plan`` tracks through (re-read at every
+    compile — :func:`use_registry` swaps take effect immediately)."""
+    with _default_lock:
+        return _default
+
+
+@contextlib.contextmanager
+def use_registry(registry: Optional[ProgramRegistry] = None):
+    """Swap the process default for a scope (tests / the audit tool):
+    compiles inside the ``with`` land in the scoped registry, the prior
+    default is restored on exit.  Process-global: concurrent *other*
+    threads' compiles land in the scoped registry too — fine for the
+    single-threaded contexts this is built for, documented so nobody
+    treats it as thread-local."""
+    global _default
+    reg = registry if registry is not None else ProgramRegistry()
+    with _default_lock:
+        prev, _default = _default, reg
+    try:
+        yield reg
+    finally:
+        with _default_lock:
+            _default = prev

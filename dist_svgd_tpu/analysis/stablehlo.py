@@ -1,0 +1,178 @@
+"""Text-level StableHLO facts for the program auditor (no mlir bindings).
+
+Everything here parses the string ``jitted.lower(*avals).as_text()``
+returns — deliberately: the in-tree jax 0.4.x MLIR python bindings are
+private and version-fragile, while the *textual* StableHLO form of the
+three facts the auditor needs has been stable across every jax this repo
+has run on:
+
+- **tensor types** — ``tensor<8x128xf32>`` literals carry shape + dtype;
+  from them we derive the materialized-buffer inventory, the largest
+  intermediate, the n×n detector, and the internal-dtype set.
+- **donation markers** — jax records input→output aliasing either as
+  ``tf.aliasing_output = k`` (plain jit, shape-matched alias) or as
+  ``jax.buffer_donor = true`` (sharded / deferred donation).  A donated
+  argument that XLA could not alias carries *no* marker at all — that
+  silence is exactly the "donate_argnums set but aliasing silently
+  dropped" failure XP003 exists to catch, so the marker *count* is the
+  signal, not the marker text.
+- **peak live bytes** — a linear-scan liveness estimate over the ``@main``
+  body: each SSA value goes live at its defining line and dies after its
+  last textual use.  It ignores control-flow region overlap and XLA's
+  later fusion/rematerialization, so it is an *estimate* — good enough to
+  flag a program whose live set jumped from O(n·d) to O(n²), which is the
+  regression the card gates on (exact HBM numbers stay a TPU-profiler
+  job).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = [
+    "DTYPE_BYTES",
+    "donation_marker_count",
+    "internal_dtypes",
+    "iter_tensor_types",
+    "main_body_lines",
+    "nxn_buffer_count",
+    "peak_live_bytes",
+    "tensor_bytes",
+]
+
+#: ``tensor<8x128xf32>`` / ``tensor<f64>`` / ``tensor<4xi1>`` — shape dims
+#: then one element-type token.  Dynamic (``?``) dims never appear in this
+#: repo's programs (every plan is shape-bucketed); a type containing one
+#: simply does not match and is ignored.
+_TENSOR_RE = re.compile(r"tensor<((?:\d+x)*)([a-z][a-z0-9]*)>")
+
+_SSA_RE = re.compile(r"%[A-Za-z0-9_#.:]+")
+
+DTYPE_BYTES: Dict[str, int] = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "i32": 4, "i16": 2, "i8": 1, "i1": 1,
+    "ui64": 8, "ui32": 4, "ui16": 2, "ui8": 1,
+    "c64": 8, "c128": 16,
+}
+
+
+def iter_tensor_types(text: str) -> Iterable[Tuple[Tuple[int, ...], str]]:
+    """Every ``(shape, dtype)`` tensor-type literal in ``text``, in order
+    (duplicates included — counts matter for the buffer inventory)."""
+    for m in _TENSOR_RE.finditer(text):
+        dims = m.group(1)
+        shape = tuple(int(d) for d in dims.split("x") if d) if dims else ()
+        yield shape, m.group(2)
+
+
+def tensor_bytes(shape: Tuple[int, ...], dtype: str) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n * DTYPE_BYTES.get(dtype, 4)
+
+
+def donation_marker_count(text: str) -> int:
+    """Input→output aliasing annotations present in the lowered module —
+    both spellings (see module docstring).  0 for a program that donates
+    nothing *or* whose donation XLA silently dropped."""
+    return text.count("tf.aliasing_output") + text.count("jax.buffer_donor")
+
+
+def main_body_lines(text: str) -> List[str]:
+    """The op lines of the first ``func.func`` body (``@main`` in every
+    jax lowering), without the signature line — the signature's argument
+    types are *inputs*, not internals, and must not pollute the
+    internal-dtype / liveness scans."""
+    lines = text.splitlines()
+    out: List[str] = []
+    depth = 0
+    started = False
+    for line in lines:
+        if not started:
+            if "func.func" in line:
+                started = True
+                depth = line.count("{") - line.count("}")
+            continue
+        depth += line.count("{") - line.count("}")
+        if depth <= 0:
+            break
+        out.append(line)
+    return out
+
+
+def internal_dtypes(text: str) -> Set[str]:
+    """Element dtypes of tensors materialized *inside* the main body
+    (signature/input types excluded)."""
+    found: Set[str] = set()
+    for line in main_body_lines(text):
+        for _shape, dtype in iter_tensor_types(line):
+            found.add(dtype)
+    return found
+
+
+def nxn_buffer_count(text: str, n: int) -> int:
+    """Distinct body lines materializing a tensor with >= 2 axes equal to
+    ``n`` — the n×n (Gram-shaped) detector.  ``n < 2`` never matches
+    (axis-1 collisions are meaningless)."""
+    if n < 2:
+        return 0
+    count = 0
+    for line in main_body_lines(text):
+        for shape, _dtype in iter_tensor_types(line):
+            if sum(1 for d in shape if d == n) >= 2:
+                count += 1
+                break  # one hit per line: a line = one op's result
+    return count
+
+
+def largest_tensor_bytes(text: str) -> int:
+    best = 0
+    for line in main_body_lines(text):
+        for shape, dtype in iter_tensor_types(line):
+            best = max(best, tensor_bytes(shape, dtype))
+    return best
+
+
+def _result_types(line: str) -> List[Tuple[Tuple[int, ...], str]]:
+    """Tensor types of the values a body line *defines*.  For functional
+    types (``... : (tensor<a>) -> tensor<b>``) only the arrow's right side
+    counts; otherwise every tensor literal after the last ``:`` does."""
+    if "->" in line:
+        seg = line.rsplit("->", 1)[1]
+    elif ":" in line:
+        seg = line.rsplit(":", 1)[1]
+    else:
+        return []
+    return list(iter_tensor_types(seg))
+
+
+def peak_live_bytes(text: str) -> int:
+    """Linear-scan liveness estimate over the main body (see module
+    docstring for what this deliberately ignores)."""
+    lines = main_body_lines(text)
+    defs: List[Tuple[int, List[str], int]] = []  # (line_idx, names, bytes)
+    last_use: Dict[str, int] = {}
+    for i, line in enumerate(lines):
+        head, _, _tail = line.partition("=")
+        names = _SSA_RE.findall(head) if "=" in line else []
+        for tok in _SSA_RE.findall(line):
+            last_use[tok] = i
+        if names:
+            size = sum(tensor_bytes(s, d) for s, d in _result_types(line))
+            defs.append((i, names, size))
+    # death line per defined value group
+    peak = live = 0
+    deaths: Dict[int, int] = {}  # line -> bytes released after it
+    for i, names, size in defs:
+        die = max((last_use.get(nm, i) for nm in names), default=i)
+        deaths[die] = deaths.get(die, 0) + size
+    idx = 0
+    for i, _line in enumerate(lines):
+        while idx < len(defs) and defs[idx][0] == i:
+            live += defs[idx][2]
+            idx += 1
+        peak = max(peak, live)
+        live -= deaths.get(i, 0)
+    return peak

@@ -45,6 +45,7 @@ from dist_svgd_tpu.ops.approx import (
     RFF_REDRAW_MODES,
     approx_preferred,
     as_kernel_approx,
+    is_gram_free,
     nystrom_landmark_indices,
 )
 from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
@@ -573,6 +574,21 @@ class DistSampler:
             return {"phi_impl": "xla", "kernel_approx": self._approx}
         return {"phi_impl": self._phi_impl, "kernel_approx": None}
 
+    def _audit_meta(self, *, expect_donation=False, particles_arg=0,
+                    gram_free=None) -> dict:
+        """Program-card declarations for a compile site (``audit=`` kwarg
+        of ``Plan.compile_sharded`` — see ``analysis/audit.py``).  φ-free
+        sites (elementwise finishers) pass ``gram_free=True`` outright;
+        φ-bearing sites inherit the resolved backend's contract
+        (``ops.approx.is_gram_free``); W2/Sinkhorn sites, whose cost
+        blocks legitimately materialize, pass ``gram_free=False``."""
+        if gram_free is None:
+            gram_free = is_gram_free(
+                self._phi_impl,
+                self._approx is not None and self._approx_active)
+        return dict(gram_free=gram_free, expect_donation=expect_donation,
+                    particles_arg=particles_arg)
+
     def _build_step_programs(self) -> None:
         """(Re)build every bound/compiled step program from the current
         kernel + approximation configuration.  Called once from
@@ -608,6 +624,8 @@ class DistSampler:
             self._bound_step,
             in_specs=(0, self._data_spec, 0, None, None, None, None),
             out_specs=(0,),
+            label="dist.step",
+            audit=self._audit_meta(),
         )
         self._bound_lagged = None
         self._bound_lagged_record = None  # built lazily on first record run
@@ -808,6 +826,9 @@ class DistSampler:
                     )
                 ),
                 donate_argnums=(2,) if self._donate else (),
+                label="dist.sinkhorn",
+                audit=self._audit_meta(expect_donation=self._donate,
+                                       particles_arg=None, gram_free=False),
             )
         if self._w2_g is None:
             g0 = jnp.zeros(self._g_shape(), dtype=jnp.asarray(cur).dtype)
@@ -1544,7 +1565,8 @@ class DistSampler:
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, 0, data_spec, None, None),
                 out_specs=(0, 0),
-            ), donate_argnums=don)
+            ), donate_argnums=don, label="dist.chunk.local",
+                audit=self._audit_meta(expect_donation=self._donate))
         elif kind == "score":
             (num_hops,) = args
             fn = self._plan.compile_sharded(bind_shard_fn(
@@ -1552,7 +1574,8 @@ class DistSampler:
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, data_spec, None, None),
                 out_specs=(0, 0),
-            ), donate_argnums=don)
+            ), donate_argnums=don, label="dist.chunk.score",
+                audit=self._audit_meta(expect_donation=self._donate))
         elif kind == "exact_phi":
             num_hops, rotate_last = args
             fn = self._plan.compile_sharded(bind_shard_fn(
@@ -1560,14 +1583,23 @@ class DistSampler:
                 self._num_shards, self._mesh,
                 in_specs=(0, 0, 0, 0),
                 out_specs=(0, 0, 0),
-            ), donate_argnums=don)
+            ), donate_argnums=don, label="dist.chunk.exact_phi",
+                audit=self._audit_meta(expect_donation=self._donate,
+                                       gram_free=False))
         elif kind == "add_prior":
             # row-wise elementwise: applies to the merged global arrays
-            # directly, no binding needed (same for 'finish')
-            fn = self._plan.compile_sharded(b["add_prior"],
-                                            donate_argnums=don)
+            # directly, no binding needed (same for 'finish'); both are
+            # φ-free, so gram-freedom holds whatever the kernel backend
+            fn = self._plan.compile_sharded(
+                b["add_prior"], donate_argnums=don,
+                label="dist.chunk.add_prior",
+                audit=self._audit_meta(expect_donation=self._donate,
+                                       gram_free=True))
         elif kind == "finish":
-            fn = self._plan.compile_sharded(b["finish"], donate_argnums=don)
+            fn = self._plan.compile_sharded(
+                b["finish"], donate_argnums=don, label="dist.chunk.finish",
+                audit=self._audit_meta(expect_donation=self._donate,
+                                       gram_free=True))
         else:  # pragma: no cover - internal
             raise ValueError(f"unknown chunk kind {kind!r}")
         self._chunk_cache[key] = fn
@@ -1605,6 +1637,9 @@ class DistSampler:
         fn = self._plan.compile_sharded(
             jax.vmap(per),
             donate_argnums=(2,) if self._donate else (),
+            label=f"dist.w2_chunk.{kind}",
+            audit=self._audit_meta(expect_donation=self._donate,
+                                   particles_arg=None, gram_free=False),
         )
         self._chunk_cache[key] = fn
         return fn
@@ -1765,7 +1800,10 @@ class DistSampler:
                 )
             if self._include_wasserstein:
                 self._snapshot_previous_device(pre_update)
-        self.last_run_stats = self._stats(
+        # this-process execution report, deliberately NOT checkpointed: a
+        # resumed process has dispatched nothing yet, so resetting to the
+        # constructor's None is the honest value
+        self.last_run_stats = self._stats(  # jaxlint: disable=JL006
             "intra_step", num_steps, rec["count"], rec["max_wall"],
             hops_per_dispatch=hops_per_dispatch,
             max_passes_per_dispatch=max_passes,
@@ -1899,6 +1937,8 @@ class DistSampler:
                 in_specs=(0, self._data_spec, None, None, None, None),
                 out_specs=(0, 1) if record else (0,),
                 donate_argnums=(0,) if self._donate else (),
+                label="dist.scan",
+                audit=self._audit_meta(expect_donation=self._donate),
             )
             self._scan_cache[(num_steps, record, lagged)] = run
         out = run(
@@ -1990,6 +2030,9 @@ class DistSampler:
                           None, None),
                 out_specs=(0, 0, 0, 1 if record else None),
                 donate_argnums=(0, 1, 2) if self._donate else (),
+                label="dist.w2_scan",
+                audit=self._audit_meta(expect_donation=self._donate,
+                                       gram_free=False),
             )
             self._scan_cache[("w2", num_steps, record)] = run
 

@@ -20,6 +20,7 @@ from dist_svgd_tpu.ops.approx import (
     KernelApprox,
     as_kernel_approx,
     default_error_budget,
+    is_gram_free,
     phi_nystrom,
     phi_rff,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "KernelApprox",
     "as_kernel_approx",
     "default_error_budget",
+    "is_gram_free",
     "phi_nystrom",
     "phi_rff",
     "kernel_matrix",

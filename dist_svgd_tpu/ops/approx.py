@@ -204,6 +204,20 @@ def as_kernel_approx(spec: Union[None, str, KernelApprox]
     )
 
 
+def is_gram_free(phi_impl, approx_active: bool) -> bool:
+    """Whether the resolved φ backend avoids materializing the n×n Gram
+    matrix in device memory — the declaration the program auditor's XP001
+    rule arms on (``analysis/audit.py``).
+
+    True for the Pallas kernel (the Gram tile lives in VMEM only, never
+    HBM — BENCH_r05's whole premise) and for an *active* rff/nystrom
+    approximation (O(n·R) / O(n·L) features by construction).  The exact
+    XLA φ legitimately materializes (m, n) blocks and must NOT declare —
+    a false declaration turns the baseline red, which is the point: the
+    declaration is a contract, not a hint."""
+    return bool(approx_active) or str(phi_impl).startswith("pallas")
+
+
 def approx_preferred(k_eff: int, m: int, feature_count: int) -> bool:
     """The ``'auto'`` crossover: approximate once the exact pair count beats
     the feature work (:data:`APPROX_CROSSOVER_FACTOR`).  ``k_eff`` is the

@@ -37,6 +37,15 @@ scanned run, the W2 snapshot + Sinkhorn dual stacks in the W2 scan, and
 the intra-step executors' accumulator carries — gated by their
 ``donate_carries`` flag and pinned bitwise against the undonated path
 (``tools/profile_step_floor.py --donate-ab``).
+
+Round 22: every compiled program is additionally **tracked** through
+:mod:`dist_svgd_tpu.analysis.registry` — the seam the program auditor
+hangs off.  Call sites pass ``label=`` (a stable audit name) and
+``audit=`` (declarations like ``gram_free``/``pinned_f32`` that arm the
+XP rules); untagged sites still register under the function's name so the
+card inventory covers *every* entrypoint, not just the annotated ones.
+Tracking costs one bool check per steady-state dispatch and holds only a
+weakref to the compiled program.
 """
 
 from __future__ import annotations
@@ -93,6 +102,27 @@ def _quiet_first_call(fn: Callable) -> Callable:
             return out
 
     return wrapped
+
+
+def _track(compiled: Callable, fn: Callable, *, kind: str, num_shards: int,
+           donate_argnums, static_argnums,
+           label: Optional[str], audit: Optional[dict]) -> Callable:
+    """Register ``compiled`` with the process program registry (lazy
+    import: analysis is a pure observer — a broken/absent analysis package
+    must never take the compile path down with it)."""
+    try:
+        from dist_svgd_tpu.analysis.registry import default_registry
+    except Exception:
+        return compiled
+    return default_registry().track(
+        compiled,
+        label=label or getattr(fn, "__name__", None) or "plan_fn",
+        kind=kind,
+        num_shards=num_shards,
+        donate_argnums=donate_argnums,
+        static_argnums=static_argnums,
+        meta=audit,
+    )
 
 
 class Plan:
@@ -199,6 +229,8 @@ class Plan:
         donate_argnums: Union[int, Sequence[int], Tuple] = (),
         static_argnums: Union[int, Sequence[int], Tuple] = (),
         quiet_donation: bool = True,
+        label: Optional[str] = None,
+        audit: Optional[dict] = None,
     ) -> Callable:
         """Compile ``fn`` under this plan.
 
@@ -216,6 +248,11 @@ class Plan:
         output, and the nag would fire once per compiled bucket.  Pass
         False to keep the warning (e.g. when tuning donation on a
         training loop where "not usable" is the regression signal).
+
+        ``label``/``audit`` feed the program registry (module docstring):
+        ``label`` names the card, ``audit`` carries the XP-rule
+        declarations (``gram_free``, ``pinned_f32``, ``expect_donation``,
+        ``particles_arg``, ``allow_f64``).
         """
         if self.mesh is None:
             compiled = jax.jit(fn, donate_argnums=donate_argnums,
@@ -229,6 +266,11 @@ class Plan:
                 donate_argnums=donate_argnums,
                 static_argnums=static_argnums,
             )
+        compiled = _track(compiled, fn, kind="compile",
+                          num_shards=self.num_shards,
+                          donate_argnums=donate_argnums,
+                          static_argnums=static_argnums,
+                          label=label, audit=audit)
         if quiet_donation and donate_argnums not in ((), None):
             compiled = _quiet_first_call(compiled)
         return compiled
@@ -252,6 +294,8 @@ class Plan:
         *,
         donate_argnums: Union[int, Sequence[int], Tuple] = (),
         static_argnums: Union[int, Sequence[int], Tuple] = (),
+        label: Optional[str] = None,
+        audit: Optional[dict] = None,
     ) -> Callable:
         """Compile a *training* step/scan program under this plan — the
         sampler half of the unified compile entrypoint (ROADMAP item 5:
@@ -268,6 +312,9 @@ class Plan:
         or with ``in_specs=None`` for programs whose placement the bound
         function already owns — this is plain ``jax.jit``, byte-for-byte
         the pre-plan behavior.
+
+        ``label``/``audit`` feed the program registry exactly as in
+        :meth:`compile`.
         """
         if self.mesh is None or in_specs is None:
             compiled = jax.jit(fn, donate_argnums=donate_argnums,
@@ -286,6 +333,11 @@ class Plan:
                 donate_argnums=donate_argnums,
                 static_argnums=static_argnums,
             )
+        compiled = _track(compiled, fn, kind="compile_sharded",
+                          num_shards=self.num_shards,
+                          donate_argnums=donate_argnums,
+                          static_argnums=static_argnums,
+                          label=label, audit=audit)
         if donate_argnums not in ((), None):
             compiled = _quiet_first_call(compiled)
         return compiled

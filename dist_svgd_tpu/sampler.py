@@ -25,6 +25,7 @@ from dist_svgd_tpu.ops.approx import (
     approx_preferred,
     as_kernel_approx,
     bind_phi_step,
+    is_gram_free,
 )
 from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.svgd import svgd_step_sequential
@@ -448,7 +449,13 @@ class Sampler:
         # output at every dispatch — run() owns/copies the input, so no
         # caller buffer is ever invalidated
         run = self._plan.compile(
-            scan_run, donate_argnums=(0,) if self._donate else ())
+            scan_run, donate_argnums=(0,) if self._donate else (),
+            label="sampler.scan",
+            audit=dict(
+                gram_free=is_gram_free(self._phi_impl,
+                                       self.kernel_approx_active),
+                expect_donation=self._donate,
+            ))
         self._compiled[cache_key] = run
         return run
 

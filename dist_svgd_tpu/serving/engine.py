@@ -458,8 +458,15 @@ class PredictiveEngine:
         # across devices (replicated in/out shardings); without one this
         # is exactly the old single-device jit.  The padded input buffer
         # is donated so steady-state dispatch stops re-allocating it.
+        # Audit declarations: serve outputs are per-row reductions, so the
+        # donated request buffer is structurally unaliasable (the XP003
+        # exemption); an f32 ensemble pins the whole program f32 (XP005
+        # arms — the opt-in bf16 path legitimately computes low-precision
+        # and does not pin).
         return self._plan.compile(
-            dispatch, donate_argnums=(0,) if self._donate else ())
+            dispatch, donate_argnums=(0,) if self._donate else (),
+            label=f"serve.{self.model}",
+            audit=dict(pinned_f32=not low_precision))
 
     def _kernel_for(self, bucket: int, generation: str = "serving"):
         """Returns ``(fn, dtype)`` snapshotted under one lock acquisition:

@@ -1,0 +1,40 @@
+"""JL006 negative: config attrs, lazy caches, builder methods, string-keyed
+manifest fields, and non-checkpointed classes are all out of scope."""
+
+
+class Trainer:
+    def __init__(self):
+        self._particles = None
+        self._t = 0
+        self._seed = 0          # config: only ever set in __init__
+        self._step_fn = None    # compiled-program cache
+        self._bank_key = None   # persisted via the manifest string key
+
+    def step(self):
+        if self._step_fn is None:
+            # lazy-build idiom: rebuilt on demand, not trajectory state
+            self._step_fn = lambda p: [x + 1 for x in p]
+        self._particles = self._step_fn(self._particles or [])
+        self._t += 1
+        self._bank_key = self._t * 7
+
+    def rebuild_programs(self):
+        # mutates ONLY unpersisted attrs: a builder, no co-mutation signal
+        self._step_fn = lambda p: [x + 2 for x in p]
+
+    def state_dict(self):
+        state = {"particles": self._particles, "t": self._t}
+        state["bank_key"] = getattr(self, "_bank_key")
+        return state
+
+    def load_state_dict(self, state):
+        self._particles = state["particles"]
+        self._t = state["t"]
+
+
+class NotCheckpointed:
+    def __init__(self):
+        self._x = 0
+
+    def step(self):
+        self._x += 1

@@ -1,0 +1,22 @@
+"""JL006 positive: trajectory state mutated alongside persisted fields but
+absent from the checkpoint protocol."""
+
+
+class Trainer:
+    def __init__(self):
+        self._particles = None
+        self._t = 0
+        self._bandwidth = 1.0
+
+    def step(self):
+        self._particles = [p + 1 for p in self._particles or []]
+        self._t += 1
+        # EXPECT JL006: evolves with the persisted trajectory, never saved
+        self._bandwidth = self._bandwidth * 0.99
+
+    def state_dict(self):
+        return {"particles": self._particles, "t": self._t}
+
+    def load_state_dict(self, state):
+        self._particles = state["particles"]
+        self._t = state["t"]

@@ -35,7 +35,7 @@ FIXTURES = os.path.join(REPO_ROOT, "tests", "jaxlint_fixtures")
 GATED_TREES = [os.path.join(REPO_ROOT, p)
                for p in ("dist_svgd_tpu", "tools", "experiments")]
 
-ALL_RULES = ("JL001", "JL002", "JL003", "JL004", "JL005")
+ALL_RULES = ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006")
 
 
 def lint_fixture(name):
@@ -63,6 +63,8 @@ EXPECTATIONS = {
     "jl004_neg.py": (set(), {"JL004"}),
     "jl005_pos.py": ({"JL005"}, set()),
     "jl005_neg.py": (set(), set(ALL_RULES)),
+    "jl006_pos.py": ({"JL006"}, set()),
+    "jl006_neg.py": (set(), set(ALL_RULES)),
 }
 
 
@@ -143,6 +145,34 @@ def test_allowlist_policy_is_clean():
     assert allowlist_mod.validate() == []
 
 
+def test_allowlist_has_no_stale_entries():
+    """Round 22: an entry that waives nothing is dead weight waiting to
+    waive the WRONG future finding — the full-tree lint must match every
+    entry or the entry must go."""
+    stale = allowlist_mod.stale_entries(lint_paths(GATED_TREES))
+    assert stale == [], (
+        "stale allowlist entries (delete them):\n"
+        + "\n".join(repr(e) for e in stale)
+    )
+
+
+def test_stale_entries_detects_unmatched_and_keeps_matched():
+    from tools.jaxlint.core import Finding
+
+    findings = [Finding("pkg/tools/foo.py", 7, "JL003", "m")]
+    allow = [
+        ("tools/foo.py", "JL003", 7, "matched: stays"),
+        ("tools/foo.py", "JL003", 8, "wrong line: stale"),
+        ("tools/foo.py", "JL001", None, "wrong rule: stale"),
+        ("tools/gone.py", "JL003", None, "file gone: stale"),
+        ("tools/foo.py", "JL003", None, "line-free match: stays"),
+    ]
+    stale = allowlist_mod.stale_entries(findings, allow)
+    assert [e[3] for e in stale] == [
+        "wrong line: stale", "wrong rule: stale", "file gone: stale",
+    ]
+
+
 def test_repo_has_zero_nonallowlisted_findings():
     findings = [
         f for f in lint_paths(GATED_TREES)
@@ -178,6 +208,57 @@ def test_cli_list_rules(capsys):
     assert rc == 0
     for rule in ALL_RULES:
         assert rule in text
+
+
+def test_cli_format_github_annotations(capsys):
+    """--format=github emits one ::error workflow command per finding,
+    with file=/line=/title= properties CI renders inline."""
+    rc = cli.main(["--format=github", os.path.join(FIXTURES, "jl002_pos.py")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [l for l in out.splitlines() if l]
+    assert lines and all(l.startswith("::error ") for l in lines)
+    assert all("title=JL002" in l and "line=" in l for l in lines)
+    assert all("jl002_pos.py" in l for l in lines)
+
+
+def test_cli_format_github_clean_tree_is_silent(capsys):
+    rc = cli.main(["--format=github", os.path.join(FIXTURES, "jl003_neg.py")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.strip() == ""
+
+
+def test_cli_format_json_matches_json_alias(capsys):
+    target = os.path.join(FIXTURES, "jl002_pos.py")
+    rc1 = cli.main(["--format=json", target])
+    doc1 = json.loads(capsys.readouterr().out)
+    rc2 = cli.main(["--json", target])
+    doc2 = json.loads(capsys.readouterr().out)
+    assert (rc1, doc1) == (rc2, doc2)
+    assert doc1["stale_allowlist"] == []  # subset run: stale not judged
+
+
+def test_report_render_shared_by_auditor():
+    """The renderer accepts program-level findings (pseudo-paths, line 0)
+    — the shared reporting path the program auditor uses."""
+    import io
+
+    from tools.jaxlint.core import Finding
+    from tools.jaxlint.report import render
+
+    f = Finding("plan://sampler.scan", 0, "XP003", "donation dropped")
+    buf = io.StringIO()
+    render([f], "github", buf)
+    line = buf.getvalue().strip()
+    assert line.startswith("::error ")
+    assert "line=1" in line  # clamped: workflow commands need line >= 1
+    assert "title=XP003" in line
+    buf = io.StringIO()
+    render([f], "json", buf, cards=[{"label": "sampler.scan"}])
+    doc = json.loads(buf.getvalue())
+    assert doc["findings"][0]["rule"] == "XP003"
+    assert doc["cards"] == [{"label": "sampler.scan"}]
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,318 @@
+"""Program auditor gate (round 22): registry capture, XP red paths, and
+the ``tools/program_audit.py`` card gate.
+
+Three layers, mirroring the jaxlint test layout:
+
+- **registry semantics** — ``analysis.registry`` captures first-call
+  avals through the ``Plan.compile`` seam, scopes via ``use_registry``,
+  weakrefs the compiled plans (a dead plan yields no card), and bounds
+  its own memory.
+- **red paths** — an injected fixture plan that materializes a Gram
+  matrix under a ``gram_free`` declaration fires exactly one XP001; a
+  plan whose declared donation was stripped fires exactly one XP003;
+  and feeding either into :func:`tools.program_audit.gate` flips the
+  gated row to FAIL *naming the exact rule* (the ISSUE-19 acceptance
+  drill).
+- **the committed artifact** — ``tools/program_cards.json`` must exist,
+  cover every suite builder (``--list-missing`` empty — parity with
+  ``perf_regress --list-missing``), and judge a real builder's fresh
+  cards PASS with zero XP findings (the zero-finding baseline).
+
+Everything runs on the tier-1 CPU mesh; the only compiles are a handful
+of toy jits plus ONE real builder (``sampler_exact``), keeping every
+test far under the 15 s wall budget.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dist_svgd_tpu.analysis import (
+    ProgramCard,
+    audit_entry,
+    audit_registry,
+    default_registry,
+    use_registry,
+    xp_findings,
+)
+from dist_svgd_tpu.parallel.plan import Plan
+from tools import program_audit
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_plan_compile_tracks_and_captures_first_call_avals():
+    with use_registry() as reg:
+        plan = Plan()
+        f = plan.compile(lambda x: x * 2.0, label="t.double")
+        (entry,) = reg.entries()
+        assert entry.label == "t.double"
+        assert not entry.captured
+        f(jnp.zeros((5, 3), jnp.float32))
+        assert entry.captured
+        (aval,) = entry.avals
+        assert aval.shape == (5, 3) and aval.dtype == jnp.float32
+        # steady state: repeat calls don't re-capture or grow anything
+        f(jnp.ones((5, 3), jnp.float32))
+        assert len(reg.entries()) == 1
+
+
+def test_use_registry_scopes_and_restores_the_default():
+    outer = default_registry()
+    with use_registry() as reg:
+        assert default_registry() is reg
+        plan = Plan()
+        f = plan.compile(lambda x: x + 1, label="t.scoped")
+        assert [e.label for e in reg.entries()] == ["t.scoped"]
+        del f
+    assert default_registry() is outer
+    assert "t.scoped" not in [e.label for e in outer.entries()]
+
+
+def test_dead_plan_yields_no_card():
+    with use_registry() as reg:
+        plan = Plan()
+        f = plan.compile(lambda x: x - 1.0, label="t.dies")
+        f(jnp.zeros((4,), jnp.float32))
+        (entry,) = reg.entries()
+        assert entry.alive
+        del f
+        import gc
+
+        gc.collect()
+        # the registry holds only a weakref: the entry dies with the plan,
+        # audits to no card, and is pruned from subsequent listings
+        assert not entry.alive
+        assert audit_entry(entry) is None
+        cards, findings = audit_registry(reg)
+        assert reg.entries() == []
+    assert cards == [] and findings == []
+
+
+def test_registry_capacity_is_bounded():
+    with use_registry() as reg:
+        reg._capacity = 3
+        plan = Plan()
+        fns = [plan.compile((lambda i: lambda x: x + i)(i), label=f"t.{i}")
+               for i in range(5)]
+        assert len(reg.entries()) == 3
+        # FIFO eviction keeps the newest plans
+        assert [e.label for e in reg.entries()] == [f"t.{i}" for i in (2, 3, 4)]
+        del fns
+
+
+# ---------------------------------------------------------------------------
+# red paths (the ISSUE-19 acceptance drills)
+# ---------------------------------------------------------------------------
+
+
+def _gram_fixture_cards():
+    """A plan that *declares* gram-free but lowers an n×n Gram matrix."""
+
+    def gram_step(x):
+        g = jnp.exp(-jnp.sum((x[:, None, :] - x[None, :, :]) ** 2, -1))
+        return g @ x
+
+    with use_registry() as reg:
+        plan = Plan()
+        f = plan.compile(gram_step, label="t.gram",
+                         audit=dict(gram_free=True))
+        f(jnp.zeros((24, 2), jnp.float32))
+        return audit_registry(reg)
+
+
+def test_materialized_gram_fires_exactly_one_xp001():
+    cards, findings = _gram_fixture_cards()
+    (card,) = cards
+    assert card.nxn_buffers > 0 and card.n_particles == 24
+    assert [f.rule for f in findings] == ["XP001"]
+    (f,) = findings
+    assert f.path == "plan://t.gram"
+    assert "24" in f.message  # names the offending dimension
+
+
+def _stripped_donation_cards():
+    """Donation declared through the audit contract but stripped from the
+    compile call — the silent-drop failure mode XP003 exists to catch."""
+    with use_registry() as reg:
+        plan = Plan()
+        f = plan.compile(lambda x: x + 1.0, donate_argnums=(),
+                         label="t.nodon", audit=dict(expect_donation=True))
+        f(jnp.zeros((8, 2), jnp.float32))
+        return audit_registry(reg)
+
+
+def test_stripped_donation_fires_exactly_one_xp003():
+    cards, findings = _stripped_donation_cards()
+    (card,) = cards
+    assert card.donated_leaves == 0
+    assert [f.rule for f in findings] == ["XP003"]
+    assert findings[0].path == "plan://t.nodon"
+
+
+@pytest.mark.parametrize("fixture,rule", [
+    (_gram_fixture_cards, "XP001"),
+    (_stripped_donation_cards, "XP003"),
+])
+def test_gate_row_flips_fail_naming_the_rule(fixture, rule):
+    cards, findings = fixture()
+    baseline = {"cards": {program_audit.baseline_key(c): c.as_dict()
+                          for c in cards}}
+    rows, kept, ok = program_audit.gate(
+        cards, findings, baseline, builders=("?",))
+    assert not ok
+    (row,) = [r for r in rows if r["status"] == "FAIL"]
+    assert any(rule in reason for reason in row["reasons"])
+
+
+def test_healthy_plan_zero_findings():
+    with use_registry() as reg:
+        plan = Plan()
+        f = plan.compile(lambda x: x * 0.5, donate_argnums=(0,),
+                         label="t.ok", audit=dict(gram_free=True,
+                                                  expect_donation=True))
+        f(jnp.zeros((24, 2), jnp.float32))
+        cards, findings = audit_registry(reg)
+    (card,) = cards
+    assert findings == []
+    assert card.donation_ok and card.nxn_buffers == 0
+
+
+# ---------------------------------------------------------------------------
+# gate arithmetic (pure, no compiles)
+# ---------------------------------------------------------------------------
+
+
+def _card_dict(**over):
+    base = dict(collectives={"all_gather": 1}, donation_ok=True,
+                donation_markers=1, nxn_buffers=0, num_shards=2)
+    base.update(over)
+    return base
+
+
+def test_compare_card_flags_each_regression_axis():
+    base = _card_dict()
+    assert program_audit.compare_card(_card_dict(), base) == []
+    assert any("all_gather" in r for r in program_audit.compare_card(
+        _card_dict(collectives={"all_gather": 2}), base))
+    assert any("donation aliasing dropped" in r
+               for r in program_audit.compare_card(
+                   _card_dict(donation_ok=False), base))
+    assert any("markers" in r for r in program_audit.compare_card(
+        _card_dict(donation_markers=0), base))
+    assert any("nxn" in r for r in program_audit.compare_card(
+        _card_dict(nxn_buffers=3), base))
+    assert any("num_shards" in r for r in program_audit.compare_card(
+        _card_dict(num_shards=1), base))
+    # fewer collectives / MORE markers are improvements, not regressions
+    assert program_audit.compare_card(
+        _card_dict(collectives={}, donation_markers=2), base) == []
+
+
+def test_gate_subset_run_does_not_flag_unbuilt_builders_missing():
+    baseline = {"cards": {
+        "a/lbl(x)": dict(_card_dict(), builder="a"),
+        "b/lbl(x)": dict(_card_dict(), builder="b"),
+    }}
+    rows, kept, ok = program_audit.gate([], [], baseline, builders=("a",))
+    assert [r["status"] for r in rows] == ["MISSING"]
+    assert rows[0]["card"] == "a/lbl(x)"
+    assert not ok
+    # full scope flags both
+    rows, _, _ = program_audit.gate([], [], baseline, builders=("a", "b"))
+    assert sorted(r["card"] for r in rows) == ["a/lbl(x)", "b/lbl(x)"]
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact
+# ---------------------------------------------------------------------------
+
+
+def _baseline():
+    with open(program_audit.CARDS_PATH) as fh:
+        return json.load(fh)
+
+
+def test_baseline_artifact_covers_every_builder():
+    doc = _baseline()
+    assert program_audit.missing_builders(doc) == []
+    for key, card in doc["cards"].items():
+        assert key.startswith(card["builder"] + "/")
+        for field in program_audit.GATED_FIELDS:
+            assert field in card, (key, field)
+
+
+def test_list_missing_parity_with_perf_regress(tmp_path, capsys):
+    # empty artifact: every builder is a dormant gate, same contract as
+    # perf_regress's windowed rows with no incumbent history
+    empty = tmp_path / "cards.json"
+    empty.write_text(json.dumps({"cards": {}}))
+    rc = program_audit.main(["--list-missing", "--cards-path", str(empty)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["missing"] == list(program_audit.BUILDER_NAMES)
+    assert set(doc["gates"]) == set(program_audit.BUILDER_NAMES)
+    # committed artifact: nothing missing, and perf_regress --list-missing
+    # cross-reports the same answer in its own document
+    out = subprocess.run(
+        [sys.executable, os.path.join("tools", "perf_regress.py"),
+         "--list-missing"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    pr_doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert pr_doc["program_audit_missing"] == []
+    assert {"missing", "gates"} <= set(pr_doc) and {"missing", "gates"} <= set(doc)
+
+
+def test_sampler_exact_builder_passes_against_committed_baseline():
+    cards, findings = program_audit.run_suite(["sampler_exact"])
+    assert findings == []
+    rows, kept, ok = program_audit.gate(cards, findings, _baseline(),
+                                        builders=("sampler_exact",))
+    assert ok, rows
+    assert all(r["status"] == "PASS" for r in rows)
+    (card,) = cards
+    assert card.meta["builder"] == "sampler_exact"
+    assert card.key in {k.split("/", 1)[1] for k in _baseline()["cards"]}
+
+
+def test_full_suite_zero_findings_and_gate_green():
+    """The ISSUE-19 acceptance drill in one breath: every suite builder's
+    cards lower clean (zero XP findings on package plans) and judge PASS
+    against the committed baseline — the tier-1 enforcement of the
+    program-card artifact."""
+    cards, findings = program_audit.run_suite()
+    assert findings == []
+    rows, kept, ok = program_audit.gate(cards, findings, _baseline())
+    assert ok, [r for r in rows if r["status"] != "PASS"]
+    assert len(cards) == len(_baseline()["cards"])
+    # every builder contributed at least one card
+    owners = {c.meta["builder"] for c in cards}
+    assert owners == set(program_audit.BUILDER_NAMES)
+
+
+def test_tampered_baseline_fails_deterministically():
+    cards, findings = program_audit.run_suite(["sampler_exact"])
+    doc = copy.deepcopy(_baseline())
+    key = program_audit.baseline_key(cards[0])
+    # pretend the incumbent had one more donation marker: the "current
+    # build silently dropped aliasing" signature
+    doc["cards"][key]["donation_markers"] += 1
+    rows, _, ok = program_audit.gate(cards, findings, doc,
+                                     builders=("sampler_exact",))
+    assert not ok
+    (row,) = [r for r in rows if r["status"] == "FAIL"]
+    assert any("markers" in reason for reason in row["reasons"])

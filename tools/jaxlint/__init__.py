@@ -14,10 +14,25 @@ table and tests/test_jaxlint.py for the gate):
   ``with self._lock`` (``rules_lock``).
 - **JL005 tracer-leak** — Python side effects under jit/scan
   (``rules_tracer``).
+- **JL006 state-dict-drift** — attributes mutated alongside persisted
+  state in a checkpointed class (defines ``state_dict`` +
+  ``load_state_dict``) but absent from both protocol methods — silent
+  kill→resume field loss (``rules_statedict``).
 
 Escape hatch: ``# jaxlint: disable=JL00N`` on the offending line.
 Runtime half: :func:`retrace_sentry` counts XLA compiles inside a region
 (zero-compile steady-state contract — wired into serve_bench/perf_regress).
+
+Program-level sibling family — **XP001–XP005** — lives in
+``dist_svgd_tpu/analysis/audit.py`` and shares this package's ``Finding``
++ allowlist machinery, but audits *compiled plans* (jaxpr + lowered
+StableHLO) instead of source text: XP001 materialized-nxn (Gram matrix in
+a gram-free-declared program), XP002 collective-in-unsharded-plan, XP003
+donation-dropped, XP004 f64-promotion, XP005 bf16-pollution.  There is no
+source line to hang a disable comment on; the allowlist (path suffix
+``plan://<label>``) is the blessing mechanism, and
+``tools/program_audit.py`` is the gate.  Reporting (text/json/github) is
+shared through ``tools/jaxlint/report.py``.
 """
 
 from tools.jaxlint.core import Finding, lint_paths, lint_source, load_rules

@@ -51,3 +51,28 @@ def validate(allowlist=None) -> List[str]:
         if not reason.strip():
             errors.append(f"allowlist entry ({suffix!r}, {rule}) has no reason")
     return errors
+
+
+def stale_entries(findings, allowlist=None
+                  ) -> List[Tuple[str, str, Optional[int], str]]:
+    """Entries that waive **nothing** in ``findings`` (the full-tree lint
+    result) — dead weight that silently survives the code it excused being
+    fixed, moved, or deleted.  A stale entry is worse than a missing one:
+    the next finding that happens to land on the same ``(suffix, rule)``
+    gets waived by an excuse written for different code.  Reported by the
+    CLI on full-tree runs and enforced to be empty by
+    ``tests/test_jaxlint.py``.
+    """
+    entries = ALLOWLIST if allowlist is None else allowlist
+    stale = []
+    for entry in entries:
+        suffix, rule, line, _reason = entry
+        hit = any(
+            f.rule == rule
+            and f.path.replace("\\", "/").endswith(suffix)
+            and (line is None or line == f.line)
+            for f in findings
+        )
+        if not hit:
+            stale.append(entry)
+    return stale

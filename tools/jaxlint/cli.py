@@ -1,21 +1,29 @@
-"""jaxlint CLI: ``python -m tools.jaxlint [paths...] [--json]``.
+"""jaxlint CLI: ``python -m tools.jaxlint [paths...] [--format=...]``.
 
 Default paths are the three enforced trees (``dist_svgd_tpu``, ``tools``,
 ``experiments``) resolved against the repo root, so the bare invocation
 from anywhere inside the repo reproduces exactly what the tier-1 gate
 (``tests/test_jaxlint.py``) enforces.  Exit code 0 = no non-allowlisted
-findings; 1 = findings; 2 = the allowlist itself violates policy.
+findings; 1 = findings; 2 = the allowlist itself violates policy (a
+package-tree entry, a missing reason, or — on full-tree runs — a stale
+entry that waives nothing).
+
+Output rides ``tools/jaxlint/report.py`` (the renderer shared with
+``tools/program_audit.py``): ``--format=text`` (default, clickable
+``path:line`` lines), ``--format=json`` (one machine document), or
+``--format=github`` (workflow-command annotations CI surfaces inline on
+the PR).  ``--json`` remains as an alias for ``--format=json``.
 """
 
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 from typing import List, Optional
 
 from tools.jaxlint import allowlist as allowlist_mod
+from tools.jaxlint import report
 from tools.jaxlint.core import Finding, lint_paths, load_rules
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -34,8 +42,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("paths", nargs="*",
                     help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)} "
                          "under the repo root)")
-    ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output (one JSON document)")
+    ap.add_argument("--format", choices=report.FORMATS, default="text",
+                    dest="fmt", help="output format (default: text)")
+    ap.add_argument("--json", action="store_const", const="json",
+                    dest="fmt", help="alias for --format=json")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
     ap.add_argument("--no-allowlist", action="store_true",
@@ -43,8 +53,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        if args.as_json:
-            print(json.dumps({"rules": rule_table()}, indent=2))
+        if args.fmt == "json":
+            import json as _json
+
+            print(_json.dumps({"rules": rule_table()}, indent=2))
         else:
             for row in rule_table():
                 print(f"{row['rule']}  {row['summary']}")
@@ -56,6 +68,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"jaxlint: allowlist policy error: {e}", file=sys.stderr)
         return 2
 
+    full_tree = not args.paths
     paths = args.paths or [os.path.join(REPO_ROOT, p) for p in DEFAULT_PATHS]
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
@@ -72,19 +85,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             kept.append(f)
 
-    if args.as_json:
-        print(json.dumps({
-            "findings": [f.as_dict() for f in kept],
-            "allowlisted": [f.as_dict() for f in waived],
-            "rules": rule_table(),
-            "paths": paths,
-        }, indent=2))
-    else:
-        for f in kept:
-            print(f.format())
+    # stale-entry policy only judges the FULL enforced tree: a subset run
+    # legitimately misses the findings other trees' entries waive
+    stale = allowlist_mod.stale_entries(findings) if full_tree else []
+
+    report.render(kept, args.fmt, rules=rule_table(), paths=paths,
+                  allowlisted=[f.as_dict() for f in waived],
+                  stale_allowlist=[list(e) for e in stale])
+    if args.fmt == "text":
         summary = (f"jaxlint: {len(kept)} finding(s)"
                    + (f", {len(waived)} allowlisted" if waived else ""))
         print(summary, file=sys.stderr if kept else sys.stdout)
+    if stale:
+        for suffix, rule, line, _reason in stale:
+            print(
+                f"jaxlint: stale allowlist entry ({suffix!r}, {rule}, "
+                f"{line}): matches no current finding — delete it",
+                file=sys.stderr,
+            )
+        return 2
     return 1 if kept else 0
 
 

@@ -241,10 +241,12 @@ def load_rules():
         rules_lock,
         rules_retrace,
         rules_rng,
+        rules_statedict,
         rules_tracer,
     )
 
-    return [rules_retrace, rules_rng, rules_hostsync, rules_lock, rules_tracer]
+    return [rules_retrace, rules_rng, rules_hostsync, rules_lock,
+            rules_tracer, rules_statedict]
 
 
 def lint_source(path: str, source: str, rules=None) -> List[Finding]:

@@ -1,0 +1,68 @@
+"""One reporting path for findings: text, JSON, and GitHub annotations.
+
+Both the jaxlint CLI and ``tools/program_audit.py`` emit
+:class:`~tools.jaxlint.core.Finding` lists; this module is the single
+place that turns them into output so CI consumes one format family
+regardless of which gate produced the finding:
+
+- ``text`` — the clickable ``path:line: RULE message`` lines.
+- ``json`` — one document: ``{"findings": [...], ...extra}``.
+- ``github`` — workflow commands (``::error file=...,line=...,
+  title=RULE::message``) that GitHub renders as inline PR annotations.
+  Newlines/percents in messages are %-escaped per the workflow-command
+  spec; program-level findings (pseudo-paths like ``plan://label``) keep
+  the pseudo-path in ``file=`` — GitHub shows them as repo-level
+  annotations, which is the right rendering for a finding with no source
+  line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import IO, Iterable, List, Optional
+
+FORMATS = ("text", "json", "github")
+
+
+def _escape_property(s: str) -> str:
+    return (s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+            .replace(":", "%3A").replace(",", "%2C"))
+
+
+def _escape_data(s: str) -> str:
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def github_annotation(finding, level: str = "error") -> str:
+    """One ``::error`` workflow command for a finding."""
+    return (
+        f"::{level} file={_escape_property(finding.path)},"
+        f"line={max(finding.line, 1)},"
+        f"title={_escape_property(finding.rule)}::"
+        f"{_escape_data(finding.message)}"
+    )
+
+
+def render(findings: Iterable, fmt: str = "text",
+           stream: Optional[IO[str]] = None, **extra) -> None:
+    """Write ``findings`` to ``stream`` (stdout by default) in ``fmt``.
+
+    ``extra`` keys ride the JSON document verbatim (rule tables, waived
+    findings, card summaries); text/github ignore them — machine context
+    belongs in the machine format.
+    """
+    if fmt not in FORMATS:
+        raise ValueError(f"format must be one of {FORMATS}, got {fmt!r}")
+    out = stream if stream is not None else sys.stdout
+    findings = list(findings)
+    if fmt == "json":
+        doc = {"findings": [f.as_dict() for f in findings]}
+        doc.update(extra)
+        print(json.dumps(doc, indent=2), file=out)
+    elif fmt == "github":
+        for f in findings:
+            print(github_annotation(f), file=out)
+    else:
+        for f in findings:
+            print(f.format(), file=out)

@@ -1,0 +1,151 @@
+"""JL006 state-dict-drift: mutable state a checkpoint silently loses.
+
+Scope: any class that defines **both** ``state_dict`` and
+``load_state_dict`` (the repo's checkpoint protocol — samplers, streaming
+sources, supervisors).  The failure this catches is the kill→resume field
+loss: an attribute initialized in ``__init__`` and *re-assigned during
+operation* by a method that also mutates persisted state, yet never
+touched by ``state_dict``/``load_state_dict`` — after a resume the
+persisted fields come back and the drifted sibling silently resets to its
+construction value.
+
+The co-mutation requirement is the precision guard (zero-finding tier-1
+baseline, so speculative findings are build breakages): an attribute only
+ever set in ``__init__`` is configuration (reconstructed by the
+constructor, correctly absent from the checkpoint), and a method that
+mutates *only* unpersisted attributes is a cache/program builder
+(compiled-executable caches are rebuilt on load by design).  Only when a
+method updates persisted state **and** an unpersisted ``__init__``
+attribute in the same breath is that attribute evolving with the
+checkpointed trajectory — exactly the field someone forgot to add to
+``state_dict``.
+
+Attributes the protocol methods touch in *any* way (read, write, or via
+``getattr``/``setattr`` with a literal name) count as persisted; so do
+attributes whose name appears as a string literal inside either method
+(manifest keys are commonly built via dict literals).  The lazy-build
+idiom (``if self._x is None: self._x = build(...)``) is exempt wherever
+the store sits under such a guard — an attribute rebuilt on demand from
+other state is a cache, not trajectory state.  Suppress a deliberate
+transient (e.g. a stats field that must reset on resume) with
+``# jaxlint: disable=JL006`` at the drifting assignment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.jaxlint.core import Finding, Module
+
+RULE_ID = "JL006"
+SUMMARY = ("attribute mutated alongside persisted state but absent from "
+           "state_dict/load_state_dict")
+
+_PROTOCOL = ("state_dict", "load_state_dict")
+
+
+def _self_attrs_assigned(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            for el in ast.walk(tgt):  # tuple unpacking targets included
+                if (isinstance(el, ast.Attribute)
+                        and isinstance(el.value, ast.Name)
+                        and el.value.id == "self"):
+                    out.add(el.attr)
+    return out
+
+
+def _under_lazy_guard(module: Module, node: ast.AST, attr: str,
+                      stop: ast.AST) -> bool:
+    """True when ``node`` sits inside ``if self.<attr> is None:`` — the
+    lazy-build cache idiom."""
+    for anc in module.ancestors(node):
+        if anc is stop:
+            break
+        if isinstance(anc, ast.If) and isinstance(anc.test, ast.Compare):
+            t = anc.test
+            if (isinstance(t.left, ast.Attribute)
+                    and isinstance(t.left.value, ast.Name)
+                    and t.left.value.id == "self" and t.left.attr == attr
+                    and len(t.ops) == 1 and isinstance(t.ops[0], ast.Is)
+                    and len(t.comparators) == 1
+                    and isinstance(t.comparators[0], ast.Constant)
+                    and t.comparators[0].value is None):
+                return True
+    return False
+
+
+def _persisted_attrs(fn: ast.AST) -> Set[str]:
+    """Every attribute a protocol method touches: direct ``self.x`` loads
+    and stores, plus string literals that name an attribute (manifest-key
+    dicts, ``getattr(self, "x")``)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            out.add(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+            out.add("_" + node.value)  # "particles" key ↔ _particles attr
+    return out
+
+
+def check(module: Module) -> List[Optional[Finding]]:
+    findings: List[Optional[Finding]] = []
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods: Dict[str, ast.AST] = {
+            m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not all(name in methods for name in _PROTOCOL):
+            continue
+        persisted: Set[str] = set()
+        for name in _PROTOCOL:
+            persisted |= _persisted_attrs(methods[name])
+        init = methods.get("__init__")
+        if init is None:
+            continue
+        init_attrs = _self_attrs_assigned(init)
+        seen: Set[str] = set()
+        for name, method in methods.items():
+            if name in _PROTOCOL or name == "__init__":
+                continue
+            assigned = _self_attrs_assigned(method)
+            if not (assigned & persisted):
+                continue  # no co-mutation: cache/program builder
+            for attr in sorted((assigned & init_attrs) - persisted - seen):
+                # report once per attribute, at its first drifting store
+                for node in ast.walk(method):
+                    targets = []
+                    if isinstance(node, ast.Assign):
+                        targets = node.targets
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [node.target]
+                    if any(isinstance(el, ast.Attribute)
+                           and isinstance(el.value, ast.Name)
+                           and el.value.id == "self" and el.attr == attr
+                           for tgt in targets for el in ast.walk(tgt)):
+                        if _under_lazy_guard(module, node, attr, method):
+                            continue
+                        seen.add(attr)
+                        findings.append(module.finding(
+                            node, RULE_ID,
+                            f"'self.{attr}' is initialized in __init__ and "
+                            f"mutated here alongside persisted state, but "
+                            f"{cls.name}.state_dict/load_state_dict never "
+                            "touch it — a kill→resume silently resets it "
+                            "(persist it, or disable with a why-transient "
+                            "justification)",
+                        ))
+                        break
+    return findings

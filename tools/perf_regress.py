@@ -158,6 +158,17 @@ A/B timing protocol those notes derived:
   inflation while mirroring, judged on a +1 offset — the healthy value
   is 0) gate against their own median+MAD windows.
 
+- **program-card sibling gate (round 22)** — the *static* half of this
+  gate lives in ``tools/program_audit.py``: per-plan program cards
+  (collective inventory, donation aliasing, materialized-n×n, dtype
+  promotions — lowered on the CPU box, no TPU and no timing noise)
+  judged against ``tools/program_cards.json`` with the same
+  ``--record`` / ``--list-missing`` conventions as this file.  A plan
+  that grows a collective or drops donation fails *there*
+  deterministically before it ever reaches these timed rows; this
+  file's ``--list-missing`` cross-reports the sibling so one command
+  audits both artifacts.
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -523,6 +534,12 @@ def main():
         with open(INCUMBENTS_PATH) as fh:
             incumbents = json.load(fh)
         missing = missing_rows(incumbents)
+        # the static sibling gate's artifact is audited in the same breath
+        # (round 22): both files are CPU-readable, and a builder with no
+        # baseline card is exactly a windowed row with no history — a gate
+        # that silently cannot fire
+        from tools import program_audit
+
         print(json.dumps({
             "windowed_rows": len(WINDOWED_ROWS),
             "missing": missing,
@@ -532,6 +549,8 @@ def main():
             "gates": {k: ("windowed+unconditional"
                           if k in UNCONDITIONAL_ROW_KEYS else "windowed")
                       for k in missing},
+            "program_audit_missing": program_audit.missing_builders(
+                program_audit.load_baseline()),
         }))
         sys.exit(0)
 

@@ -1,0 +1,405 @@
+"""Program auditor gate: per-plan program cards + XP rule enforcement
+(round 22).
+
+``dist_svgd_tpu/analysis`` audits every compiled plan (jaxpr + lowered
+StableHLO) into a **program card** — collective inventory with payload
+bytes per mesh axis, donation-aliasing verification, dtype-promotion
+sweep, peak live-intermediate estimate, and the materialized-n×n check.
+This tool is the gate that makes those cards a *recorded artifact*: it
+builds a deterministic suite of representative plans on the CPU box
+(8 virtual devices, x64 on — the exact tier-1 environment), audits them,
+and compares each card against the committed baseline in
+``tools/program_cards.json``.
+
+A run FAILs deterministically — no accelerator, no timing noise — when:
+
+- any XP001–XP005 finding fires on a card (non-allowlisted; the
+  allowlist path suffix is ``plan://<label>``);
+- a card's per-kind **collective count** exceeds its baseline (a plan
+  that suddenly all-gathers twice per step is a regression even when
+  the numerics still pass);
+- a baseline card had ``donation_ok`` and the current one does not, or
+  its donation **marker count** dropped (the "donate_argnums set but
+  aliasing silently dropped" failure mode);
+- a card's materialized-n×n buffer count grew;
+- a card present in the baseline was not produced, or a produced card
+  has no baseline (run ``--record`` to bless a deliberate change).
+
+``peak_live_bytes_est`` and ``largest_intermediate_bytes`` ride the card
+for the record but do not gate — they are lowering-version-sensitive
+estimates, not contracts.
+
+Mirrors the ``tools/perf_regress.py`` conventions: ``--record`` refuses
+to overwrite the baseline while any gate FAILs (``--force`` overrides),
+and ``--list-missing`` audits the baseline file without building
+anything — builders whose cards are absent are gates that silently
+cannot fire.  Findings render through ``tools/jaxlint/report.py``
+(``--format=text|json|github``), the same reporting path as the jaxlint
+CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.jaxlint import allowlist as allowlist_mod
+from tools.jaxlint import report
+
+CARDS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "program_cards.json")
+
+#: Gate-relevant card fields: a baseline entry must carry all of these
+#: (``as_dict`` emits more — the extras ride for the record).
+GATED_FIELDS = ("collectives", "donation_ok", "donation_markers",
+                "nxn_buffers", "num_shards")
+
+
+# ---------------------------------------------------------------------------
+# builder suite
+# ---------------------------------------------------------------------------
+#
+# Each builder constructs a representative training/serving object inside a
+# scoped registry and runs exactly enough dispatches to capture first-call
+# avals.  Shapes are tiny (n=24 particles, d=2; 64x8 serving ensembles) so
+# the whole suite compiles in well under the tier-1 wall budget, and
+# distinctive (24 is no bucket size and no feature dim) so the n×n scan
+# cannot collide with an unrelated dimension.
+
+
+def _quad_logp(theta, data=None):
+    import jax.numpy as jnp
+
+    return -0.5 * jnp.sum(theta ** 2)
+
+
+def _build_sampler_exact():
+    from dist_svgd_tpu.sampler import Sampler
+
+    s = Sampler(2, _quad_logp)
+    s.run(n=24, num_iter=3, step_size=0.1, seed=0)
+    return s
+
+
+def _build_sampler_rff():
+    from dist_svgd_tpu.sampler import Sampler
+
+    s = Sampler(2, _quad_logp, kernel_approx="rff", phi_impl="xla")
+    s.run(n=24, num_iter=3, step_size=0.1, seed=0)
+    return s
+
+
+def _dist_particles():
+    import numpy as np
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.random.default_rng(0).normal(size=(16, 2)))
+
+
+def _build_dist_gather():
+    from dist_svgd_tpu.distsampler import DistSampler
+
+    ds = DistSampler(2, _quad_logp, None, _dist_particles(),
+                     include_wasserstein=False)
+    ds.run_steps(3, 0.05)
+    return ds
+
+
+def _build_dist_w2_sinkhorn():
+    from dist_svgd_tpu.distsampler import DistSampler
+
+    ds = DistSampler(2, _quad_logp, None, _dist_particles(),
+                     include_wasserstein=True,
+                     wasserstein_solver="sinkhorn")
+    ds.run_steps(3, 0.05)
+    return ds
+
+
+def _build_dist_rff():
+    from dist_svgd_tpu.distsampler import DistSampler
+
+    ds = DistSampler(2, _quad_logp, None, _dist_particles(),
+                     include_wasserstein=False, kernel_approx="rff",
+                     phi_impl="xla")
+    ds.run_steps(3, 0.05)
+    return ds
+
+
+def _serve_particles():
+    import numpy as np
+
+    return np.random.default_rng(1).normal(size=(64, 8)).astype(np.float32)
+
+
+def _build_serve_logreg():
+    import numpy as np
+    from dist_svgd_tpu.serving import PredictiveEngine
+
+    eng = PredictiveEngine("logreg", _serve_particles(),
+                           min_bucket=4, max_bucket=16)
+    eng.warmup()
+    eng.predict(np.random.default_rng(2).normal(size=(3, 7))
+                .astype(np.float32))
+    return eng
+
+
+def _build_serve_bf16():
+    from dist_svgd_tpu.serving import PredictiveEngine
+
+    eng = PredictiveEngine("logreg", _serve_particles(), min_bucket=4,
+                           max_bucket=8, dtype="bfloat16")
+    eng.warmup()
+    return eng
+
+
+#: name -> builder, in print order.  The names are the ``--list-missing``
+#: contract (mirroring ``perf_regress.WINDOWED_ROWS``): a name whose cards
+#: are absent from the baseline file is a gate that silently cannot fire.
+BUILDERS = (
+    ("sampler_exact", _build_sampler_exact),
+    ("sampler_rff", _build_sampler_rff),
+    ("dist_gather", _build_dist_gather),
+    ("dist_w2_sinkhorn", _build_dist_w2_sinkhorn),
+    ("dist_rff", _build_dist_rff),
+    ("serve_logreg", _build_serve_logreg),
+    ("serve_bf16", _build_serve_bf16),
+)
+BUILDER_NAMES = tuple(name for name, _ in BUILDERS)
+
+
+def setup_environment(device_count: int = 8) -> None:
+    """Pin the audit to the tier-1 CPU environment (8 virtual devices,
+    x64 on) so card signatures are reproducible across boxes.  Must run
+    before the first JAX import; delegates to ``tests/_jax_env.py`` so
+    the axon-plugin workaround stays in one place."""
+    from tests._jax_env import setup_cpu
+
+    setup_cpu(device_count, enable_x64=True)
+
+
+def run_suite(names=None) -> Tuple[list, list]:
+    """Build every requested suite entry in its own scoped registry and
+    audit it.  Returns ``(cards, findings)`` with each card's ``builder``
+    recorded in ``card.meta`` so the baseline knows which gate owns it."""
+    from dist_svgd_tpu.analysis import audit_registry, use_registry
+
+    selected = [(n, b) for n, b in BUILDERS if names is None or n in names]
+    unknown = set(names or ()) - {n for n, _ in selected}
+    if unknown:
+        raise SystemExit(f"program_audit: unknown builder(s) {sorted(unknown)}; "
+                         f"expected a subset of {list(BUILDER_NAMES)}")
+    all_cards, all_findings = [], []
+    for name, build in selected:
+        with use_registry() as reg:
+            # hold the builder's return value across the audit: the
+            # registry weakrefs the compiled plans, so dropping the owning
+            # sampler/engine before auditing would garbage-collect every
+            # program the builder just compiled
+            keepalive = build()
+            cards, findings = audit_registry(reg)
+            del keepalive
+        for card in cards:
+            card.meta["builder"] = name
+        all_cards.extend(cards)
+        all_findings.extend(findings)
+    return all_cards, all_findings
+
+
+# ---------------------------------------------------------------------------
+# gate
+# ---------------------------------------------------------------------------
+
+
+def baseline_key(card) -> str:
+    """Baseline identity: ``builder/label(signature)``.  The builder
+    namespace matters — e.g. ``sampler_exact`` and ``sampler_rff`` lower
+    the same label at the same avals (the φ choice is internal to the
+    scanned body), so the raw card key alone would alias two different
+    programs onto one baseline entry."""
+    return f"{card.meta.get('builder', '?')}/{card.key}"
+
+
+def load_baseline(path: str = CARDS_PATH) -> dict:
+    if not os.path.exists(path):
+        return {"cards": {}}
+    with open(path) as fh:
+        doc = json.load(fh)
+    doc.setdefault("cards", {})
+    return doc
+
+
+def compare_card(cur: dict, base: dict) -> List[str]:
+    """Regression reasons for one card vs its baseline (empty = PASS)."""
+    reasons = []
+    for kind in sorted(set(cur["collectives"]) | set(base["collectives"])):
+        was, now = base["collectives"].get(kind, 0), cur["collectives"].get(kind, 0)
+        if now > was:
+            reasons.append(f"collective {kind} count {was} -> {now}")
+    if base["donation_ok"] and not cur["donation_ok"]:
+        reasons.append("donation aliasing dropped (donation_ok True -> False)")
+    if cur["donation_markers"] < base["donation_markers"]:
+        reasons.append(f"donation markers {base['donation_markers']} -> "
+                       f"{cur['donation_markers']}")
+    if cur["nxn_buffers"] > base["nxn_buffers"]:
+        reasons.append(f"materialized nxn buffers {base['nxn_buffers']} -> "
+                       f"{cur['nxn_buffers']}")
+    if cur["num_shards"] != base["num_shards"]:
+        reasons.append(f"num_shards {base['num_shards']} -> {cur['num_shards']}")
+    return reasons
+
+
+def gate(cards, findings, baseline: dict,
+         builders=BUILDER_NAMES) -> Tuple[List[dict], List, bool]:
+    """Judge the suite.  Returns ``(rows, kept_findings, ok)`` where each
+    row is ``{"card", "status", "reasons"}`` — status ``PASS`` /
+    ``FAIL`` / ``NO_BASELINE`` / ``MISSING`` — and ``kept_findings`` are
+    the non-allowlisted XP findings (each one FAILs its card's row,
+    naming the rule).  ``builders`` scopes the disappeared-card check: a
+    ``--builders`` subset run must not flag the unbuilt suite entries'
+    baseline cards as MISSING."""
+    kept = [f for f in findings
+            if not allowlist_mod.is_allowlisted(f.path, f.rule, f.line)]
+    by_label: Dict[str, List] = {}
+    for f in kept:
+        by_label.setdefault(f.path[len("plan://"):], []).append(f)
+
+    base_cards = baseline.get("cards", {})
+    rows, seen = [], set()
+    for card in cards:
+        key = baseline_key(card)
+        seen.add(key)
+        # findings attach to a label; every card under that label FAILs
+        # (one serving label covers multiple bucket cards — all implicated)
+        reasons = [f"{f.rule}: {f.message}"
+                   for f in by_label.get(card.label, [])]
+        base = base_cards.get(key)
+        if base is None:
+            status = "FAIL" if reasons else "NO_BASELINE"
+            if not reasons:
+                reasons = ["no baseline card — run --record to bless"]
+        else:
+            reasons += compare_card(card.as_dict(), base)
+            status = "FAIL" if reasons else "PASS"
+        rows.append({"card": key, "status": status, "reasons": reasons})
+    in_scope = {key for key, card in base_cards.items()
+                if card.get("builder") in builders}
+    for key in sorted(in_scope - seen):
+        rows.append({"card": key, "status": "MISSING",
+                     "reasons": ["baseline card not produced by the suite"]})
+    ok = all(r["status"] == "PASS" for r in rows)
+    return rows, kept, ok
+
+
+def missing_builders(baseline: dict, expected=BUILDER_NAMES) -> List[str]:
+    """Builders with NO card in the baseline file — their regression
+    gates return NO_BASELINE every run, i.e. they silently cannot fire.
+    Works without JAX: it only reads the committed artifact."""
+    present = {card.get("builder") for card in baseline.get("cards", {}).values()}
+    return [name for name in expected if name not in present]
+
+
+def record(cards, path: str = CARDS_PATH) -> None:
+    doc = {
+        "_meta": {
+            "tool": "python -m tools.program_audit --record",
+            "environment": "cpu x8 virtual devices, x64 on (tests/_jax_env)",
+            "gated_fields": list(GATED_FIELDS),
+        },
+        "cards": {},
+    }
+    for card in cards:
+        d = card.as_dict()
+        d["builder"] = card.meta.get("builder")
+        doc["cards"][baseline_key(card)] = d
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.program_audit",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--builders", nargs="*", metavar="NAME",
+                    help=f"suite subset to run (default: all of "
+                         f"{' '.join(BUILDER_NAMES)})")
+    ap.add_argument("--format", choices=report.FORMATS, default="text",
+                    dest="fmt", help="finding/report format (default: text)")
+    ap.add_argument("--record", action="store_true",
+                    help="rewrite tools/program_cards.json from this run "
+                         "(refused while any XP finding fires — see --force)")
+    ap.add_argument("--force", action="store_true",
+                    help="allow --record despite findings (blessing a "
+                         "deliberate contract change)")
+    ap.add_argument("--list-missing", action="store_true",
+                    help="print the builders with no baseline card and exit "
+                         "(reads the artifact only; needs no JAX)")
+    ap.add_argument("--cards-path", default=CARDS_PATH,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    baseline = load_baseline(args.cards_path)
+
+    if args.list_missing:
+        # same contract as perf_regress --list-missing: audit the committed
+        # artifact without touching an accelerator or compiling anything
+        missing = missing_builders(baseline)
+        print(json.dumps({
+            "builders": len(BUILDER_NAMES),
+            "missing": missing,
+            # every gate here is unconditional: XP findings fire with or
+            # without a baseline; only the regression deltas go dormant
+            "gates": {name: "findings+regression" for name in missing},
+        }))
+        return 0
+
+    setup_environment()
+    cards, findings = run_suite(args.builders)
+    rows, kept, ok = gate(cards, findings, baseline,
+                          builders=tuple(args.builders)
+                          if args.builders else BUILDER_NAMES)
+
+    if args.fmt == "json":
+        report.render(kept, "json",
+                      rows=rows,
+                      cards=[c.as_dict() for c in cards],
+                      row={"row": "program_audit",
+                           "status": "PASS" if ok else "FAIL",
+                           "cards": len(cards), "findings": len(kept)})
+    else:
+        if args.fmt == "github" and kept:
+            report.render(kept, "github")
+        for row in rows:
+            line = f"program_audit: {row['status']:<11} {row['card']}"
+            if row["reasons"]:
+                line += "  [" + "; ".join(row["reasons"]) + "]"
+            print(line)
+        if args.fmt == "text" and kept:
+            report.render(kept, "text", stream=sys.stderr)
+        print(json.dumps({"row": "program_audit",
+                          "status": "PASS" if ok else "FAIL",
+                          "cards": len(cards), "findings": len(kept)}))
+
+    if args.record:
+        if kept and not args.force:
+            print("program_audit: refusing --record with live findings "
+                  "(pass --force to bless deliberately)", file=sys.stderr)
+            return 1
+        record(cards, args.cards_path)
+        print(json.dumps({"recorded_to": args.cards_path,
+                          "cards": len(cards)}))
+        return 0
+
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
