@@ -9,8 +9,11 @@ shapes; the compile call itself only sees a Python callable).  So
 ``Plan`` routes every compiled program through :meth:`ProgramRegistry.
 track`, which records an entry and returns a wrapper that snapshots the
 first call's ``ShapeDtypeStruct`` tree, then gets out of the way (one
-bool check per steady-state dispatch — the same discipline as plan.py's
-``_quiet_first_call``).
+bool check plus one profiler-global read per steady-state dispatch —
+the same discipline as plan.py's ``_quiet_first_call``).  The same
+wrapper is the runtime hook for ``telemetry/profile.py``: when a
+dispatch profiler is enabled, every call is routed through it so fenced
+wall time lands on this entry's ``plan://<label>`` identity.
 
 Memory discipline, because this rides *every* compile across a ~600-test
 tier-1 run:
@@ -38,6 +41,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
+
+# the dispatch profiler's switchboard (telemetry.profile never imports
+# analysis, so this edge is acyclic); the steady-state cost while
+# profiling is disabled is one module-global read per dispatch
+from dist_svgd_tpu.telemetry import profile as _profile
 
 __all__ = [
     "ProgramEntry",
@@ -75,7 +83,8 @@ class ProgramEntry:
     """
 
     __slots__ = ("seq", "label", "kind", "num_shards", "donate_argnums",
-                 "static_argnums", "meta", "ref", "avals", "calls")
+                 "static_argnums", "meta", "ref", "avals", "calls",
+                 "prof_cache")
 
     def __init__(self, seq: int, label: str, kind: str, num_shards: int,
                  donate_argnums: Tuple[int, ...],
@@ -91,6 +100,10 @@ class ProgramEntry:
         self.ref = ref
         self.avals: Optional[Tuple[Any, ...]] = None
         self.calls = 0
+        # (profiler, label dict, rows, bytes) cached by the dispatch
+        # profiler on its first profiled call; identity-keyed so a new
+        # profiler epoch re-derives it (see telemetry/profile.py)
+        self.prof_cache: Optional[tuple] = None
 
     # -------------------------------------------------------------- #
 
@@ -187,7 +200,10 @@ class ProgramRegistry:
                                 entry.avals = None
                         state["captured"] = True
             entry.calls += 1
-            return compiled(*args, **kwargs)
+            prof = _profile._PROFILER
+            if prof is None:
+                return compiled(*args, **kwargs)
+            return prof.call(entry, compiled, args, kwargs)
 
         dispatch.program_entry = entry  # type: ignore[attr-defined]
         return dispatch

@@ -59,6 +59,7 @@ from dist_svgd_tpu.parallel.exchange import (
 )
 from dist_svgd_tpu.parallel.mesh import AXIS, bind_shard_fn, make_mesh
 from dist_svgd_tpu.parallel.plan import Plan
+from dist_svgd_tpu.telemetry import profile as _profile
 from dist_svgd_tpu.telemetry import trace as _trace
 from dist_svgd_tpu.utils import checkpoint as _ckpt
 from dist_svgd_tpu.utils.rng import minibatch_key
@@ -1408,7 +1409,10 @@ class DistSampler:
         program (scan chunk, ring-hop chunk, Sinkhorn dual advance, ...) —
         unfenced unless ``time_dispatches`` already fences, so chained
         dispatches keep pipelining and the span honestly shows *dispatch*
-        latency in that mode (the tag says which)."""
+        latency in that mode (the tag says which).  An enabled dispatch
+        profiler fences every plan dispatch regardless — the ``fenced``
+        tag reflects it, and the pipelining caveat applies for as long as
+        profiling is on."""
         import time as _time
 
         rec = {"count": 0, "max_wall": None}
@@ -1417,13 +1421,17 @@ class DistSampler:
             tags = None
             if _trace.enabled():
                 tags = {"fn": getattr(fn, "__name__", type(fn).__name__),
-                        "fenced": bool(time_dispatches)}
+                        "fenced": (bool(time_dispatches)
+                                   or _profile.profiler_enabled())}
             with _trace.span(span_name, tags):
                 t0 = _time.perf_counter() if time_dispatches else None
                 out = fn(*args)
                 rec["count"] += 1
                 if time_dispatches:
-                    jax.block_until_ready(out)
+                    # profile.fence, not block_until_ready: when the
+                    # dispatch profiler is enabled it already fenced this
+                    # output — fence exactly once per dispatch
+                    _profile.fence(out)
                     wall = _time.perf_counter() - t0
                     rec["max_wall"] = (wall if rec["max_wall"] is None
                                        else max(rec["max_wall"], wall))

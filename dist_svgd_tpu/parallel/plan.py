@@ -101,6 +101,13 @@ def _quiet_first_call(fn: Callable) -> Callable:
             state["lowered"] = True
             return out
 
+    # keep the registry identity visible through the donation shim so
+    # cost tooling (telemetry/profile.py, program cards) can reach the
+    # ProgramEntry from whichever callable the caller ends up holding
+    entry = getattr(fn, "program_entry", None)
+    if entry is not None:
+        wrapped.program_entry = entry  # type: ignore[attr-defined]
+
     return wrapped
 
 

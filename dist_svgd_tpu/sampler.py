@@ -30,6 +30,7 @@ from dist_svgd_tpu.ops.approx import (
 from dist_svgd_tpu.ops.kernels import RBF, AdaptiveRBF
 from dist_svgd_tpu.ops.svgd import svgd_step_sequential
 from dist_svgd_tpu.parallel.plan import Plan
+from dist_svgd_tpu.telemetry import profile as _profile
 from dist_svgd_tpu.telemetry import trace as _trace
 from dist_svgd_tpu.utils.history import history_to_dataframe
 from dist_svgd_tpu.utils.rng import as_key, draw_minibatch, init_particles, minibatch_key
@@ -577,7 +578,8 @@ class Sampler:
         if steps_per_dispatch >= num_iter:
             run = self._run_fn(num_iter, record)
             with _trace.span("train.step_chunk",
-                             {"steps": num_iter, "execution": "monolithic"}
+                             {"steps": num_iter, "execution": "monolithic",
+                              "fenced": _profile.profiler_enabled()}
                              if _trace.enabled() else None):
                 final, hist = run(particles, eps, bkey,
                                   jnp.asarray(step_offset, jnp.int32), *extra)
@@ -604,8 +606,13 @@ class Sampler:
             run = self._run_fn(csize, record)
             # unfenced span: chained chunk dispatches keep pipelining, so
             # the span shows dispatch latency (the trailing host concat
-            # carries the execution wall)
-            with _trace.span("train.step_chunk", {"steps": csize}
+            # carries the execution wall) — unless the dispatch profiler
+            # is on, which fences every plan dispatch for per-program
+            # attribution and serialises the chunk chain for the duration
+            # (the span's `fenced` tag says which regime recorded it)
+            with _trace.span("train.step_chunk",
+                             {"steps": csize,
+                              "fenced": _profile.profiler_enabled()}
                              if _trace.enabled() else None):
                 final, hist = run(final, eps, bkey,
                                   jnp.asarray(step_offset + done, jnp.int32),

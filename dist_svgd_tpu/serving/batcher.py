@@ -89,6 +89,7 @@ import numpy as np
 
 from dist_svgd_tpu.telemetry import metrics as _metrics
 from dist_svgd_tpu.telemetry import trace as _trace
+from dist_svgd_tpu.telemetry import usage as _usage
 
 #: Batch-occupancy buckets (rows per dispatched batch): powers of two up to
 #: the queue bound's usual order of magnitude.
@@ -874,6 +875,18 @@ class MicroBatcher:
             self._m_requests.inc(**gl)
             self._m_rows.inc(n_rows, **gl)
             self._m_latency.observe(lat_ms / 1e3, **gl)
+        meter = _usage.get_meter()
+        if meter is not None:
+            # the cost ledger: same measured device window the histogram
+            # above observed, so usage and latency accounting agree by
+            # construction; queue-seconds are summed over the requests
+            # COMPLETED by this batch (their wait ended at this t0)
+            meter.record_batch(
+                tenant=tenant, generation=generation, rows=rows,
+                device_s=device_ms / 1e3,
+                queue_s=sum(max(t0 - req.enqueued, 0.0)
+                            for req, _, _ in latencies),
+                requests=len(latencies))
         if tracer is not None:
             # one lane tree per completed request: the cross-thread
             # enqueue→reply lifetime with the queue-wait / coalesce /

@@ -56,6 +56,7 @@ from dist_svgd_tpu.models.logreg import posterior_predictive_prob
 from dist_svgd_tpu.parallel.plan import Plan
 from dist_svgd_tpu.telemetry import metrics as _metrics
 from dist_svgd_tpu.telemetry import trace as _trace
+from dist_svgd_tpu.telemetry import usage as _usage
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
@@ -468,6 +469,16 @@ class PredictiveEngine:
             label=f"serve.{self.model}",
             audit=dict(pinned_f32=not low_precision))
 
+    def _record_compile(self, generation: str) -> None:
+        """Feed one kernel-cache miss to the process usage meter (cost
+        ledger) — a no-op unless metering is enabled.  Steady-state serve
+        windows are gated at zero of these (cost_attribution drill)."""
+        meter = _usage.get_meter()
+        if meter is not None:
+            meter.record_compile(
+                tenant=self.tenant,
+                generation=None if generation == "serving" else generation)
+
     def _kernel_for(self, bucket: int, generation: str = "serving"):
         """Returns ``(fn, dtype)`` snapshotted under one lock acquisition:
         a concurrent :meth:`reload` can never hand a caller the new
@@ -497,6 +508,8 @@ class PredictiveEngine:
                     miss = False
                 dtype = self._input_dtype(self._cand_particles.dtype)
             (self._m_misses if miss else self._m_hits).inc(**self._tlabels)
+            if miss:
+                self._record_compile(generation)
             return fn, dtype
         with self._lock:
             fn = self._kernels.get(bucket)
@@ -510,6 +523,8 @@ class PredictiveEngine:
             dtype = self._input_dtype(self._particles.dtype)
         # registry write outside the engine lock (its own lock suffices)
         (self._m_misses if miss else self._m_hits).inc(**self._tlabels)
+        if miss:
+            self._record_compile(generation)
         if self._kernel_cache is not None:
             # report the use outside the engine lock: the shared LRU may
             # evict another engine's bucket (its _evict_bucket takes THAT

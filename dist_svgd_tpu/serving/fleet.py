@@ -87,7 +87,10 @@ stop at the process boundary; three additions carry them across it:
   ``default_serving_slos`` over the *federated* window (fleet-wide p99,
   not any one replica's), and ``/fleet`` serves the one-stop status
   document ``tools/fleet_status.py`` renders (breaker states, per-tenant
-  fleet rps/p99 from merged histograms, SLO verdicts).
+  fleet rps/p99 from merged histograms, fleet-wide cost columns from the
+  federated ``svgd_usage_*`` series, SLO verdicts); ``/usage`` answers
+  cost-per-tenant across the fleet (``telemetry/usage.py:usage_summary``
+  over the merged registry, per-replica breakdown included).
 """
 
 from __future__ import annotations
@@ -108,6 +111,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from dist_svgd_tpu.resilience.backoff import Backoff
 from dist_svgd_tpu.telemetry import metrics as _metrics
 from dist_svgd_tpu.telemetry import trace as _trace
+from dist_svgd_tpu.telemetry import usage as _usage
 from dist_svgd_tpu.telemetry.slo import default_serving_slos
 
 __all__ = [
@@ -1681,6 +1685,15 @@ class FleetRouter:
                 name = labels.get("tenant", "") or "(default)"
                 tenants.setdefault(name, {})["requests_total"] = (
                     req.value(**labels))
+        # fleet-wide cost columns from the federated usage counters
+        # (telemetry/usage.py; zero-filled absent — replicas without
+        # metering simply contribute nothing)
+        usage = _usage.usage_summary(fed)
+        for name, row in usage["tenants"].items():
+            tenants.setdefault(name, {}).update(
+                device_seconds_total=row["device_seconds"],
+                usage_rows_total=row["rows"],
+            )
         doc = self.health()
         doc.update(
             ts=time.time(),
@@ -1746,6 +1759,16 @@ class FleetRouter:
                     self._write_json(200, router.evaluate_slo())
                 elif path == "/fleet":
                     self._write_json(200, router.fleet_status())
+                elif path == "/usage":
+                    # fleet-wide cost-per-tenant: one federation sweep,
+                    # then the usage summary over the MERGED registry —
+                    # tenants/totals from the rollup series, per-replica
+                    # breakdown from the replica-labelled ones
+                    router.federation.scrape_once()
+                    self._write_json(200, {
+                        "metering": True,
+                        **_usage.usage_summary(
+                            router.federation.fleet_registry)})
                 else:
                     self._write_json(404, {"error": f"no route {self.path}"})
 

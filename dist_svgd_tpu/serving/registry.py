@@ -629,6 +629,17 @@ class ModelRegistry:
             "batcher": bstats,
         }
 
+    def usage(self) -> Dict[str, Any]:
+        """Per-tenant cost accounting (``telemetry/usage.py``): reads the
+        active meter's registry when metering is enabled, else this
+        registry's own metrics sink (whose missing ``svgd_usage_*``
+        series yield an empty map — enable metering to populate it)."""
+        from dist_svgd_tpu.telemetry import usage as _usage
+
+        meter = _usage.get_meter()
+        reg = meter.registry if meter is not None else self.metrics
+        return {"metering": meter is not None, **_usage.usage_summary(reg)}
+
     def health(self) -> Dict[str, Any]:
         """The ``/healthz`` aggregate: overall status + per-tenant rows."""
         with self._lock:

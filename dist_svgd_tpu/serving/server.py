@@ -24,6 +24,11 @@ JSON in/out:
   (``telemetry/slo.py``): burn rates for the serve-p99 / shed-rate /
   dispatch-error objectives over the window since the last ``/slo`` poll,
   ``status`` ``ok``/``breach`` at the top;
+- ``GET  /usage``        — per-tenant cost accounting
+  (``telemetry/usage.py:usage_summary`` over this server's registry:
+  device-seconds, rows, queue-seconds, requests, compiles, with
+  per-generation breakdowns) — empty tenant map until usage metering is
+  enabled (the serving CLI enables it by default);
 - ``GET  /autoscale``    — the adaptive-capacity controller's status
   (``serving/autoscale.py``: live lanes / coalescing window / quota
   scale, bounds, streaks, recent decisions); 404 when the server runs
@@ -226,6 +231,8 @@ class PredictionServer:
                     self._reply(200, server.metrics())
                 elif path == "/slo":
                     self._reply(200, server.slo_engine.evaluate())
+                elif path == "/usage":
+                    self._reply(200, server.usage())
                 elif path == "/autoscale":
                     if server.autoscale is None:
                         self._reply(404, {"error": "no autoscale "
@@ -450,6 +457,19 @@ class PredictionServer:
         return {**server_side, "batcher": self.batcher.stats(),
                 "engine": self.engine.stats()}
 
+    def usage(self) -> Dict[str, Any]:
+        """The ``/usage`` document: per-tenant cost accounting.  Reads
+        the active meter's registry when metering is enabled (the CLI
+        enables it on this server's registry, making them the same);
+        otherwise this server's registry, whose empty ``svgd_usage_*``
+        series yield an empty tenant map."""
+        from dist_svgd_tpu.telemetry import usage as _usage
+
+        meter = _usage.get_meter()
+        reg = meter.registry if meter is not None else self.registry
+        return {"metering": meter is not None,
+                **_usage.usage_summary(reg)}
+
     # ------------------------------------------------------------------ #
 
     def start(self) -> "PredictionServer":
@@ -583,6 +603,11 @@ def main(argv=None):
                     action=argparse.BooleanOptionalAction,
                     help="pre-trace every padding bucket up to max-batch "
                          "before binding the port")
+    ap.add_argument("--usage-metering", default=True,
+                    action=argparse.BooleanOptionalAction,
+                    help="per-tenant cost accounting (telemetry/usage.py) "
+                         "on this replica's registry: /usage locally, "
+                         "federated svgd_usage_* series fleet-wide")
     args = ap.parse_args(argv)
 
     from dist_svgd_tpu.utils.metrics import JsonlLogger
@@ -628,6 +653,12 @@ def main(argv=None):
             lanes=args.lanes, max_wait_ms=args.max_wait_ms,
             max_queue_rows=args.max_queue_rows, logger=logger,
         )
+    if args.usage_metering:
+        from dist_svgd_tpu.telemetry import usage as _usage_mod
+
+        # meter the server's own registry so /metrics.dump carries the
+        # svgd_usage_* series and the fleet federation picks them up
+        _usage_mod.enable_usage(registry=srv.registry)
     if args.trace_export:
         from dist_svgd_tpu import telemetry
 

@@ -21,6 +21,18 @@ One layer, four complementary views of the same running system:
 - :mod:`~dist_svgd_tpu.telemetry.slo` — **declarative SLOs** (burn rates
   over the registry's histogram windows, gauge ceilings, staleness);
   the serving server exposes the evaluation at ``/slo``.
+- :mod:`~dist_svgd_tpu.telemetry.profile` — **dispatch profiler**: while
+  enabled, every plan-compiled dispatch is fenced and its wall time
+  attributed to its ``plan://<label>`` program identity
+  (``svgd_prog_dispatch_seconds{label}`` + rows/bytes counters);
+  ``tools/trace_report.py --programs`` renders the top-programs view.
+- :mod:`~dist_svgd_tpu.telemetry.usage` — **per-tenant cost metering**:
+  monotonic device-seconds / rows / queue-seconds / requests / compiles
+  counters fed by the serving path, summarised at ``/usage`` and
+  federated fleet-wide by ``serving/fleet.py``.
+- :mod:`~dist_svgd_tpu.telemetry.history` — **telemetry history**: a
+  bounded on-disk ring of periodic window-delta registry snapshots;
+  ``tools/anomaly_report.py`` runs change-point detection over it.
 
 Quickstart (see README "Observability" and "Posterior health")::
 
@@ -103,6 +115,19 @@ __all__ = [
     "default_serving_slos",
     "default_training_slos",
     "default_streaming_slos",
+    "DispatchProfiler",
+    "enable_profiler",
+    "disable_profiler",
+    "get_profiler",
+    "profiler_enabled",
+    "UsageMeter",
+    "enable_usage",
+    "disable_usage",
+    "get_meter",
+    "usage_enabled",
+    "usage_summary",
+    "TelemetryHistory",
+    "HistoryRecorder",
 ]
 
 _LAZY = {
@@ -119,6 +144,21 @@ _LAZY = {
     "default_serving_slos": "slo",
     "default_training_slos": "slo",
     "default_streaming_slos": "slo",
+    # profile/usage/history are stdlib+numpy-light, but stay lazy so the
+    # eager import surface is exactly what PR 5 left it
+    "DispatchProfiler": "profile",
+    "enable_profiler": "profile",
+    "disable_profiler": "profile",
+    "get_profiler": "profile",
+    "profiler_enabled": "profile",
+    "UsageMeter": "usage",
+    "enable_usage": "usage",
+    "disable_usage": "usage",
+    "get_meter": "usage",
+    "usage_enabled": "usage",
+    "usage_summary": "usage",
+    "TelemetryHistory": "history",
+    "HistoryRecorder": "history",
 }
 
 

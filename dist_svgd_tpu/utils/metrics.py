@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dist_svgd_tpu.telemetry import profile as _profile
+
 
 class JsonlLogger:
     """Append-only JSON-lines metric log.
@@ -161,7 +163,13 @@ class StepTimer:
     completed span of that name (explicit timestamps — the fence already
     happened, so the span covers the honest device wall).  The tracer's
     fencing discipline is this class's, inherited; disabled tracing costs
-    one ``None`` check per mark."""
+    one ``None`` check per mark.
+
+    The fence routes through :func:`dist_svgd_tpu.telemetry.profile.
+    fence`: when the dispatch profiler is enabled it has *already* fenced
+    the value this mark is handed, and fencing twice would bill the
+    device round-trip to both windows — ``fence`` consumes the
+    profiler's note and blocks at most once per dispatch."""
 
     def __init__(self, span_name: Optional[str] = None):
         self._last = time.perf_counter()
@@ -170,7 +178,7 @@ class StepTimer:
 
     def mark(self, value=None) -> float:
         if value is not None:
-            jax.block_until_ready(value)
+            _profile.fence(value)
         now = time.perf_counter()
         lap = now - self._last
         self._last = now

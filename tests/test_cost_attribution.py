@@ -1,0 +1,671 @@
+"""Cost attribution & telemetry history (round 23): the dispatch
+profiler's per-program attribution and fence-once contract, the usage
+meter's per-tenant ledger (and its partition identity), the on-disk
+telemetry history ring, the change-point anomaly detector's
+deterministic fixture verdicts, and the cost-drill accounting gates at
+test size.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dist_svgd_tpu.parallel.plan import Plan
+from dist_svgd_tpu.telemetry import profile as profile_mod
+from dist_svgd_tpu.telemetry import usage as usage_mod
+from dist_svgd_tpu.telemetry.history import (
+    HistoryRecorder,
+    TelemetryHistory,
+    list_series,
+    series_values,
+)
+from dist_svgd_tpu.telemetry.metrics import MetricsRegistry
+from dist_svgd_tpu.utils.metrics import StepTimer
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+@pytest.fixture(autouse=True)
+def _switchboards_off():
+    """Every test starts and ends with profiler and meter disabled — the
+    process-global switchboards must not leak across tests."""
+    profile_mod.disable_profiler()
+    usage_mod.disable_usage()
+    yield
+    profile_mod.disable_profiler()
+    usage_mod.disable_usage()
+
+
+def _compiled_double(label="costtest.double"):
+    return Plan(None).compile(lambda x: x * 2.0, label=label)
+
+
+# --------------------------------------------------------------------- #
+# dispatch profiler: attribution, switchboard, fence-once
+# --------------------------------------------------------------------- #
+
+
+def test_profiler_attributes_plan_dispatch(rng):
+    """One profiled dispatch lands one histogram observation plus exact
+    rows/bytes on the program's label."""
+    reg = MetricsRegistry()
+    fn = _compiled_double("costtest.attr")
+    x = jnp.asarray(rng.normal(size=(8, 3)).astype(np.float32))
+    fn(x)  # warm outside the profiled window
+    profile_mod.enable_profiler(registry=reg)
+    try:
+        out = fn(x)
+        # fence-once: the profiler already fenced this value; StepTimer's
+        # fence consumes the note instead of blocking again
+        assert profile_mod.fence(out) is out
+    finally:
+        profile_mod.disable_profiler()
+    summary = profile_mod.summary(reg)
+    row = summary["costtest.attr"]
+    assert row["dispatches"] == 1
+    assert row["rows"] == 8
+    assert row["bytes"] == 8 * 3 * 4
+    assert row["seconds"] > 0.0
+    assert profile_mod.attributed_seconds(reg, "costtest.") == pytest.approx(
+        row["seconds"])
+    assert profile_mod.attributed_seconds(reg, "other.") == 0.0
+
+
+def test_profiler_disabled_is_passthrough(rng):
+    """Disabled profiler: dispatches write nothing anywhere and the
+    switchboard reads None."""
+    assert profile_mod.get_profiler() is None
+    assert not profile_mod.profiler_enabled()
+    fn = _compiled_double("costtest.off")
+    out = fn(jnp.ones((4, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    # a later-enabled profiler starts from a clean slate for this label
+    reg = MetricsRegistry()
+    profile_mod.enable_profiler(registry=reg)
+    profile_mod.disable_profiler()
+    assert "costtest.off" not in profile_mod.summary(reg)
+
+
+def test_profiler_switchboard_idempotent():
+    reg = MetricsRegistry()
+    p1 = profile_mod.enable_profiler(registry=reg)
+    p2 = profile_mod.enable_profiler()
+    assert p1 is p2
+    assert profile_mod.profiler_enabled()
+    assert profile_mod.disable_profiler() is p1
+    assert profile_mod.disable_profiler() is None
+    assert not profile_mod.profiler_enabled()
+
+
+def test_profiler_epoch_rebinds_entry_cache(rng):
+    """The per-entry fast-path cache is keyed on profiler identity: a new
+    profiler epoch (new registry) re-derives it instead of writing into
+    the dead registry."""
+    fn = _compiled_double("costtest.epoch")
+    x = jnp.ones((2, 2), np.float32)
+    reg1, reg2 = MetricsRegistry(), MetricsRegistry()
+    profile_mod.enable_profiler(registry=reg1)
+    fn(x)
+    profile_mod.disable_profiler()
+    profile_mod.enable_profiler(registry=reg2)
+    fn(x)
+    fn(x)
+    profile_mod.disable_profiler()
+    assert profile_mod.summary(reg1)["costtest.epoch"]["dispatches"] == 1
+    assert profile_mod.summary(reg2)["costtest.epoch"]["dispatches"] == 2
+
+
+def test_noop_measure_is_shared_and_zero_alloc():
+    """PR-5 discipline: while disabled, measure() hands back ONE shared
+    no-op and fence(None) passes through — zero allocations, pinned with
+    tracemalloc like the tracer's no-op span."""
+    import tracemalloc
+
+    assert profile_mod.measure("a") is profile_mod.measure("b")
+    assert profile_mod.fence(None) is None
+
+    def loop():
+        for _ in range(200):
+            with profile_mod.measure("hot"):
+                pass
+            profile_mod.fence(None)
+
+    loop()  # warm lazy caches before measuring
+    tracemalloc.start()
+    try:
+        filters = [tracemalloc.Filter(True, profile_mod.__file__)]
+        before = tracemalloc.take_snapshot().filter_traces(filters)
+        loop()
+        after = tracemalloc.take_snapshot().filter_traces(filters)
+    finally:
+        tracemalloc.stop()
+    grown = sum(max(s.size_diff, 0)
+                for s in after.compare_to(before, "lineno"))
+    assert grown == 0, f"disabled profiler path allocated {grown} bytes"
+
+
+def test_measure_context_records_host_span():
+    reg = MetricsRegistry()
+    profile_mod.enable_profiler(registry=reg)
+    try:
+        with profile_mod.measure("host.section"):
+            pass
+    finally:
+        profile_mod.disable_profiler()
+    assert profile_mod.summary(reg)["host.section"]["dispatches"] == 1
+
+
+def test_fence_exactly_once_with_steptimer(rng, monkeypatch):
+    """The double-fencing fix, pinned with a block_until_ready call-count
+    spy: profiler fences the dispatch, StepTimer.mark() on the same value
+    consumes the note (no second block); without the profiler the timer
+    fences itself."""
+    calls = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda v: calls.append(1) or real(v))
+
+    fn = _compiled_double("costtest.fence")
+    x = jnp.asarray(rng.normal(size=(4, 2)).astype(np.float32))
+    fn(x)  # warm
+
+    profile_mod.enable_profiler(registry=MetricsRegistry())
+    try:
+        calls.clear()
+        out = fn(x)
+        assert len(calls) == 1  # the profiler's fence
+        StepTimer().mark(out)
+        assert len(calls) == 1  # note consumed: no second fence
+        StepTimer().mark(out)
+        assert len(calls) == 2  # note was one-shot
+    finally:
+        profile_mod.disable_profiler()
+
+    calls.clear()
+    out = fn(x)
+    assert calls == []  # disabled profiler: dispatch not fenced
+    StepTimer().mark(out)
+    assert len(calls) == 1  # the timer's own fence still happens
+
+
+# --------------------------------------------------------------------- #
+# usage meter
+# --------------------------------------------------------------------- #
+
+
+def test_usage_meter_partitions_totals():
+    """Each batch writes exactly one label set, so tenants sum to totals
+    exactly — the accounting identity the drill gates within 1%."""
+    reg = MetricsRegistry()
+    meter = usage_mod.UsageMeter(registry=reg)
+    meter.record_batch(tenant="acme", generation=None, rows=10,
+                       device_s=0.5, queue_s=0.1, requests=2)
+    meter.record_batch(tenant="acme", generation="gen-2", rows=6,
+                       device_s=0.25, queue_s=0.0, requests=1)
+    meter.record_batch(tenant="globex", generation=None, rows=4,
+                       device_s=0.125, queue_s=0.05, requests=1)
+    meter.record_batch(tenant=None, generation=None, rows=3,
+                       device_s=0.0625, queue_s=0.0, requests=1)
+    meter.record_compile(tenant="acme")
+
+    s = usage_mod.usage_summary(reg)
+    acme = s["tenants"]["acme"]
+    assert acme["device_seconds"] == pytest.approx(0.75)
+    assert acme["rows"] == 16
+    assert acme["requests"] == 3
+    assert acme["compiles"] == 1
+    assert acme["generations"]["gen-2"]["rows"] == 6
+    assert s["tenants"]["globex"]["device_seconds"] == pytest.approx(0.125)
+    assert s["tenants"][usage_mod.DEFAULT_TENANT]["rows"] == 3
+    total = sum(t["device_seconds"] for t in s["tenants"].values())
+    assert total == pytest.approx(s["totals"]["device_seconds"])
+    assert s["totals"]["device_seconds"] == pytest.approx(0.9375)
+    assert s["replicas"] == {}
+
+
+def test_usage_summary_replica_breakdown():
+    """Replica-labelled series (a federated registry) feed the per-replica
+    breakdown and are excluded from tenants/totals — no double count."""
+    reg = MetricsRegistry()
+    ctr = reg.counter(usage_mod.DEVICE_SECONDS_TOTAL, "test")
+    ctr.inc(1.0, tenant="acme")                    # fleet rollup
+    ctr.inc(0.75, tenant="acme", replica="r0")     # per-replica ingest
+    ctr.inc(0.25, tenant="acme", replica="r1")
+    s = usage_mod.usage_summary(reg)
+    assert s["totals"]["device_seconds"] == pytest.approx(1.0)
+    assert s["replicas"]["r0"]["acme"]["device_seconds"] == pytest.approx(0.75)
+    assert s["replicas"]["r1"]["acme"]["device_seconds"] == pytest.approx(0.25)
+
+
+def test_usage_switchboard():
+    reg = MetricsRegistry()
+    assert usage_mod.get_meter() is None
+    m1 = usage_mod.enable_usage(registry=reg)
+    assert usage_mod.enable_usage() is m1
+    assert usage_mod.usage_enabled()
+    assert usage_mod.disable_usage() is m1
+    assert usage_mod.get_meter() is None
+
+
+# --------------------------------------------------------------------- #
+# serving integration: batcher feeds the meter, engine counts compiles,
+# steady state stays recompile-free with both instruments on
+# --------------------------------------------------------------------- #
+
+
+def _tiny_serving(rng, registry, tenants=("acme", "globex")):
+    from dist_svgd_tpu.serving.batcher import MicroBatcher
+    from dist_svgd_tpu.serving.engine import PredictiveEngine
+
+    engines = {
+        t: PredictiveEngine(
+            "logreg",
+            rng.normal(size=(32, 5)).astype(np.float32),
+            min_bucket=8, max_bucket=8, registry=registry, tenant=t)
+        for t in tenants
+    }
+    batcher = MicroBatcher(
+        lambda x, tenant=None: engines[tenant].predict(x),
+        max_batch=8, max_wait_ms=0.5, registry=registry)
+    return engines, batcher
+
+
+def test_serving_meters_tenants_and_stays_compile_free(rng):
+    """End to end at test size: warmed engines behind one batcher, BOTH
+    instruments on — per-tenant ledgers match the submitted work, tenant
+    device-seconds sum to the batcher's measured dispatch wall, and the
+    retrace sentry holds the window at zero compiles."""
+    from jaxlint import retrace_sentry
+
+    reg = MetricsRegistry()
+    engines, batcher = _tiny_serving(rng, reg)
+    try:
+        for eng in engines.values():
+            eng.warmup()
+        x = rng.normal(size=(4, 4)).astype(np.float32)
+        batcher.submit(x, tenant="acme").result(timeout=10)  # settle
+
+        usage_before = usage_mod.usage_summary(reg)
+        profile_mod.enable_profiler(registry=reg)
+        usage_mod.enable_usage(registry=reg)
+        try:
+            with retrace_sentry("cost test window") as sentry:
+                futs = [batcher.submit(x, tenant=t)
+                        for _ in range(6) for t in ("acme", "globex")]
+                for f in futs:
+                    f.result(timeout=10)
+        finally:
+            profile_mod.disable_profiler()
+            usage_mod.disable_usage()
+
+        s = usage_mod.usage_summary(reg)
+        for t in ("acme", "globex"):
+            before = usage_before["tenants"].get(t, {})
+            assert (s["tenants"][t]["requests"]
+                    - before.get("requests", 0)) == 6
+            assert (s["tenants"][t]["rows"] - before.get("rows", 0)) == 24
+            assert s["tenants"][t]["device_seconds"] > 0.0
+            assert s["tenants"][t]["compiles"] == before.get("compiles", 0)
+        # profiler saw the same dispatches, attributed to the plan label
+        prog = profile_mod.summary(reg, "serve.")
+        assert sum(r["dispatches"] for r in prog.values()) > 0
+        assert sum(r["rows"] for r in prog.values()) > 0
+        if sentry.supported:
+            assert sentry.compiles == 0
+    finally:
+        batcher.close()
+
+
+def test_engine_compile_miss_lands_in_ledger(rng):
+    """A cold bucket with metering on books one compile to the engine's
+    tenant."""
+    from dist_svgd_tpu.serving.engine import PredictiveEngine
+
+    reg = MetricsRegistry()
+    eng = PredictiveEngine(
+        "logreg", rng.normal(size=(16, 4)).astype(np.float32),
+        min_bucket=4, max_bucket=4, registry=reg, tenant="cold")
+    usage_mod.enable_usage(registry=reg)
+    try:
+        eng.predict(rng.normal(size=(2, 3)).astype(np.float32))
+    finally:
+        usage_mod.disable_usage()
+    assert usage_mod.usage_summary(reg)["tenants"]["cold"]["compiles"] >= 1
+
+
+def test_server_usage_route(rng):
+    """/usage answers the meter's summary (metering flag + tenants) over
+    the server's own registry."""
+    import urllib.request
+
+    from dist_svgd_tpu.serving import PredictionServer
+    from dist_svgd_tpu.serving.engine import PredictiveEngine
+
+    eng = PredictiveEngine(
+        "logreg", rng.normal(size=(16, 4)).astype(np.float32),
+        min_bucket=4, max_bucket=8, tenant="acme")
+    with PredictionServer(eng, port=0, max_batch=8, max_wait_ms=1.0) as srv:
+        usage_mod.enable_usage(registry=srv.registry)
+        try:
+            body = json.dumps(
+                {"inputs": rng.normal(size=(2, 3)).tolist()}).encode()
+            req = urllib.request.Request(
+                f"{srv.url}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(
+                    f"{srv.url}/usage", timeout=10) as resp:
+                doc = json.loads(resp.read())
+        finally:
+            usage_mod.disable_usage()
+    assert doc["metering"] is True
+    # HTTP /predict carries no tenant: the batch books to the default
+    # row; the engine's cold-bucket compile books to its own tenant
+    row = doc["tenants"][usage_mod.DEFAULT_TENANT]
+    assert row["requests"] >= 1
+    assert row["rows"] >= 2
+    assert row["device_seconds"] > 0.0
+    assert doc["tenants"]["acme"]["compiles"] >= 1
+
+
+def test_model_registry_usage_reads_meter_registry(rng):
+    from dist_svgd_tpu.serving.registry import ModelRegistry
+
+    reg = MetricsRegistry()
+    mr = ModelRegistry(metrics=MetricsRegistry())
+    meter = usage_mod.enable_usage(registry=reg)
+    try:
+        meter.record_batch(tenant="acme", generation=None, rows=2,
+                           device_s=0.01, queue_s=0.0, requests=1)
+        doc = mr.usage()
+        assert doc["metering"] is True
+        assert doc["tenants"]["acme"]["rows"] == 2
+    finally:
+        usage_mod.disable_usage()
+    doc = mr.usage()  # meter off: falls back to its own (empty) registry
+    assert doc["metering"] is False
+    assert doc["tenants"] == {}
+
+
+# --------------------------------------------------------------------- #
+# telemetry history ring
+# --------------------------------------------------------------------- #
+
+
+def test_history_ring_prunes_and_resumes_seq(tmp_path):
+    root = str(tmp_path / "hist")
+    hist = TelemetryHistory(root, capacity=3)
+    for _ in range(5):
+        hist.append({"format": "svgd-telemetry-history-1", "window": {}})
+    assert len(hist) == 3
+    seqs = [int(os.path.basename(p)[10:18]) for p in hist.paths()]
+    assert seqs == [2, 3, 4]  # oldest pruned, numbering monotone
+    # a restarted ring re-seats itself after the survivors
+    hist2 = TelemetryHistory(root, capacity=3)
+    path = hist2.append({"window": {}})
+    assert os.path.basename(path) == "telemetry_00000005.json"
+    assert [r["seq"] for r in hist2.records()] == [3, 4, 5]
+
+
+def test_recorder_windows_and_reset_clamp(tmp_path):
+    """record_once writes window DELTAS (first record cumulative with
+    interval 0), inheriting dump_delta's counter reset-clamp."""
+    reg = MetricsRegistry()
+    ctr = reg.counter("svgd_test_total", "t")
+    clock = iter([100.0, 160.0, 220.0]).__next__
+    rec = HistoryRecorder(reg, str(tmp_path / "h"), interval_s=60.0,
+                          clock=clock)
+
+    ctr.inc(5)
+    r0 = rec.record_once()
+    assert r0["interval_s"] == 0.0
+    ctr.inc(3)
+    r1 = rec.record_once()
+    assert r1["interval_s"] == pytest.approx(60.0)
+
+    records = rec.history.records()
+    vals = series_values(records, "svgd_test_total", labels={})
+    assert vals == [5.0, 3.0]  # cumulative first, then the window delta
+
+    # a counter reset (restart) clamps to a zero window, never negative
+    reg._metrics["svgd_test_total"]._series.clear()
+    ctr.inc(1)
+    r2 = rec.record_once()
+    vals = series_values(rec.history.records(), "svgd_test_total", labels={})
+    assert vals[-1] == 0.0
+    assert r2["interval_s"] == pytest.approx(60.0)
+
+
+def test_recorder_maybe_record_honours_interval(tmp_path):
+    reg = MetricsRegistry()
+    rec = HistoryRecorder(reg, str(tmp_path / "h"), interval_s=30.0,
+                          clock=lambda: 0.0)
+    assert rec.maybe_record(now=0.0) is not None
+    assert rec.maybe_record(now=10.0) is None
+    assert rec.maybe_record(now=31.0) is not None
+    assert len(rec.history) == 2
+
+
+def test_series_values_histogram_stats(tmp_path):
+    reg = MetricsRegistry()
+    hist = reg.histogram("svgd_test_seconds", "t")
+    rec = HistoryRecorder(reg, str(tmp_path / "h"), clock=lambda: 0.0)
+    for v in (0.01, 0.01, 0.02, 0.04):
+        hist.observe(v)
+    rec.record_once()
+    records = rec.history.records()
+    assert list_series(records) == [("svgd_test_seconds", "histogram", {})]
+    assert series_values(records, "svgd_test_seconds",
+                         stat="count") == [4.0]
+    assert series_values(records, "svgd_test_seconds",
+                         stat="sum") == [pytest.approx(0.08)]
+    assert series_values(records, "svgd_test_seconds",
+                         stat="mean") == [pytest.approx(0.02)]
+    (p99,) = series_values(records, "svgd_test_seconds", stat="p99")
+    live = hist.quantile(0.99)
+    assert p99 == pytest.approx(live)
+
+
+# --------------------------------------------------------------------- #
+# anomaly report: deterministic fixture verdicts + CLI exit codes
+# --------------------------------------------------------------------- #
+
+
+def _write_fixture_history(root, gauge_values):
+    """A history whose svgd_test_gauge traces gauge_values, one record
+    per window, with a constant co-recorded counter."""
+    reg = MetricsRegistry()
+    g = reg.gauge("svgd_test_gauge", "t")
+    c = reg.counter("svgd_test_total", "t")
+    clock = iter(float(60 * i) for i in range(len(gauge_values))).__next__
+    rec = HistoryRecorder(reg, root, interval_s=60.0, clock=clock)
+    for v in gauge_values:
+        g.set(v)
+        c.inc(100)
+        rec.record_once()
+    return rec.history
+
+
+CLEAN = [10.0, 10.2, 9.9, 10.1, 10.0, 9.8, 10.1, 10.0, 9.9, 10.2]
+STEPPED = CLEAN[:5] + [v + 20.0 for v in CLEAN[5:]]
+
+
+def test_detect_step_change_fixture_verdicts():
+    from anomaly_report import detect_step_change
+
+    assert detect_step_change(CLEAN) is None
+    hit = detect_step_change(STEPPED)
+    assert hit is not None
+    assert hit["split_index"] == 5
+    assert hit["shift"] == pytest.approx(20.0, rel=0.05)
+    # deterministic: same fixture, same verdict
+    assert detect_step_change(STEPPED) == detect_step_change(STEPPED)
+
+
+def test_analyze_records_flags_injected_step_only(tmp_path):
+    from anomaly_report import analyze_records
+
+    clean = _write_fixture_history(str(tmp_path / "clean"), CLEAN).records()
+    stepped = _write_fixture_history(
+        str(tmp_path / "step"), STEPPED).records()
+
+    assert analyze_records(clean)["anomalies"] == []
+    report = analyze_records(stepped)
+    assert [a["metric"] for a in report["anomalies"]] == ["svgd_test_gauge"]
+    assert report["anomalies"][0]["split_index"] == 5
+    # the flat co-recorded counter stays silent even under --rate
+    report = analyze_records(stepped, rate=True)
+    assert [a["metric"] for a in report["anomalies"]] == ["svgd_test_gauge"]
+
+
+def test_anomaly_report_cli_exit_codes(tmp_path, capsys):
+    from anomaly_report import main as anomaly_main
+
+    clean_dir = str(tmp_path / "clean")
+    step_dir = str(tmp_path / "step")
+    _write_fixture_history(clean_dir, CLEAN)
+    _write_fixture_history(step_dir, STEPPED)
+
+    assert anomaly_main([clean_dir]) == 0
+    assert anomaly_main([step_dir]) == 1
+    out = json.loads(capsys.readouterr().out.splitlines()[-1]) \
+        if anomaly_main([step_dir, "--json"]) == 1 else None
+    assert out and out["anomalies"][0]["metric"] == "svgd_test_gauge"
+    assert anomaly_main([str(tmp_path / "missing")]) == 2
+    assert anomaly_main([str(tmp_path)]) == 2  # dir without records
+
+
+# --------------------------------------------------------------------- #
+# cost drill at test size + row gates
+# --------------------------------------------------------------------- #
+
+
+def test_cost_drill_row_and_accounting(rng):
+    import cost_drill
+
+    row = cost_drill.run_drill(
+        tenants=(("a", 256), ("b", 128)), n_features=8, max_batch=8,
+        requests=24, clients=2, ab_rounds=0, history_windows=2)
+    assert row["metric"] == "cost_attribution"
+    # the accounting identity holds at any size (same measurement both
+    # sides); coverage does NOT — it needs the compute-dominant sizing
+    # the full drill uses, so only pin it is a sane fraction here
+    assert row["tenant_sum_err_frac"] < 0.01
+    assert 0.0 < row["coverage"] <= 1.0
+    assert row["recompiles"] == 0
+    if row["sentry_supported"]:
+        assert row["sentry_compiles"] == 0
+    assert row["requests"] == 24
+    assert set(row["tenant_device_s"]) == {"a", "b"}
+    assert row["tenant_device_s"]["a"] > 0.0
+    assert row["history_records"] == 3  # baseline + one per segment
+    assert row["profiler_overhead_frac"] == 0.0  # ab_rounds=0
+    assert any(p["label"].startswith("serve.")
+               for p in row["top_programs"])
+
+
+def test_cost_drill_row_ok_gates():
+    import cost_drill
+
+    good = {"coverage": 0.97, "tenant_sum_err_frac": 0.002,
+            "recompiles": 0, "sentry_compiles": 0, "sentry_supported": True}
+    ok, why = cost_drill.row_ok(good)
+    assert ok and why == []
+    for bad, frag in (
+            ({**good, "coverage": 0.90}, "coverage"),
+            ({**good, "tenant_sum_err_frac": 0.05}, "sum"),
+            ({**good, "recompiles": 2}, "recompile"),
+            ({**good, "sentry_compiles": 1}, "sentry")):
+        ok, why = cost_drill.row_ok(bad)
+        assert not ok and any(frag in w for w in why)
+    # an unsupported sentry doesn't fail the row on its own
+    ok, _ = cost_drill.row_ok(
+        {**good, "sentry_supported": False, "sentry_compiles": 3})
+    assert ok
+
+
+# --------------------------------------------------------------------- #
+# fleet_status cost columns + trace_report --programs
+# --------------------------------------------------------------------- #
+
+
+def test_fleet_status_cost_rates():
+    import fleet_status
+
+    first = {"tenants": {"acme": {"requests_total": 100,
+                                  "device_seconds_total": 5.0,
+                                  "usage_rows_total": 1000}}}
+    second = {"tenants": {"acme": {"requests_total": 140,
+                                   "device_seconds_total": 6.0,
+                                   "usage_rows_total": 1400},
+                          "new": {"requests_total": 10}}}
+    rates = fleet_status.derive_rates(first, second, 2.0)
+    assert rates["acme"]["rps"] == pytest.approx(20.0)
+    assert rates["acme"]["device_s_per_s"] == pytest.approx(0.5)
+    assert rates["acme"]["rows_per_s"] == pytest.approx(200.0)
+    # a tenant absent from the first poll has no window yet
+    assert rates["new"]["rps"] is None
+    assert rates["new"]["device_s_per_s"] is None
+
+    u1 = {"replicas": {"r0": {"acme": {"device_seconds": 1.0, "rows": 100},
+                              "beta": {"device_seconds": 1.0, "rows": 100}}}}
+    u2 = {"replicas": {"r0": {"acme": {"device_seconds": 1.5, "rows": 300},
+                              "beta": {"device_seconds": 1.5, "rows": 100}}}}
+    rr = fleet_status.derive_replica_rates(u1, u2, 2.0)
+    assert rr["r0"]["device_s_per_s"] == pytest.approx(0.5)
+    assert rr["r0"]["rows_per_s"] == pytest.approx(100.0)
+    assert fleet_status.derive_replica_rates(None, u2, 2.0) == {}
+
+
+def test_trace_report_programs_view(rng, tmp_path, capsys):
+    """--programs renders the top-programs table off a saved registry
+    dump (and off a history directory's summed windows)."""
+    import trace_report
+
+    reg = MetricsRegistry()
+    fn = _compiled_double("serve.tiny")
+    x = jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32))
+    fn(x)
+    profile_mod.enable_profiler(registry=reg)
+    try:
+        fn(x)
+        fn(x)
+    finally:
+        profile_mod.disable_profiler()
+
+    dump_path = str(tmp_path / "dump.json")
+    with open(dump_path, "w") as fh:
+        json.dump(reg.dump(), fh)
+    report = trace_report.program_rows(
+        trace_report.load_program_dumps(dump_path))
+    (prog,) = report["programs"]
+    assert prog["label"] == "serve.tiny"
+    assert prog["dispatches"] == 2
+    assert prog["rows"] == 8
+    assert prog["share"] == pytest.approx(1.0)
+    assert report["total_seconds"] > 0.0
+
+    assert trace_report.main(["--programs", dump_path]) == 0
+    out = capsys.readouterr().out
+    assert "serve.tiny" in out
+
+    # history-directory input: windows sum
+    hist_dir = str(tmp_path / "hist")
+    rec = HistoryRecorder(reg, hist_dir, clock=lambda: 0.0)
+    rec.record_once()
+    report = trace_report.program_rows(
+        trace_report.load_program_dumps(hist_dir))
+    assert report["programs"][0]["dispatches"] == 2

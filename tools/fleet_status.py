@@ -13,6 +13,14 @@ counters are restart-clamped upstream, so a replica bouncing between the
 two polls can only under-count, never go negative).  ``--interval-s 0``
 skips the second poll (rates report ``null``).
 
+The same two-poll delta drives the **cost columns** (round 23): per
+tenant, fleet-wide device-seconds/s (the fraction of one device the
+tenant is burning) and rows/s from the federated ``svgd_usage_*``
+counters riding the ``/fleet`` tenants rows; and per replica, the same
+two rates from the router's ``/usage`` per-replica breakdown (polled at
+the same two instants).  Replicas without usage metering contribute
+nothing and the columns print ``-``.
+
 Usage::
 
     python tools/fleet_status.py --url http://127.0.0.1:8100
@@ -46,36 +54,108 @@ def fetch_fleet(url: str, timeout_s: float = 5.0) -> Dict[str, Any]:
     return doc
 
 
+def fetch_usage(url: str, timeout_s: float = 5.0
+                ) -> Optional[Dict[str, Any]]:
+    """GET ``<url>/usage`` (the router's federated cost summary), or
+    ``None`` against a router without the route."""
+    req = urllib.request.Request(url.rstrip("/") + "/usage")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            doc = json.loads(resp.read())
+    except urllib.error.HTTPError:
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def _rate(cur: Optional[float], prev: Optional[float],
+          interval_s: float) -> Optional[float]:
+    if cur is None or prev is None or interval_s <= 0:
+        return None
+    return max(float(cur) - float(prev), 0.0) / interval_s
+
+
 def derive_rates(first: Dict[str, Any], second: Dict[str, Any],
-                 interval_s: float) -> Dict[str, Optional[float]]:
-    """Per-tenant fleet rps from the two polls' federated request totals
-    (non-negative by construction — the federation clamps restarts)."""
-    rates: Dict[str, Optional[float]] = {}
+                 interval_s: float) -> Dict[str, Dict[str, Optional[float]]]:
+    """Per-tenant fleet rates from the two polls' federated counters
+    (non-negative by construction — the federation clamps restarts):
+    ``{tenant: {rps, device_s_per_s, rows_per_s}}``."""
+    rates: Dict[str, Dict[str, Optional[float]]] = {}
     t0 = first.get("tenants", {})
     for name, row in second.get("tenants", {}).items():
-        cur = row.get("requests_total")
-        prev = (t0.get(name) or {}).get("requests_total")
-        if cur is None or prev is None or interval_s <= 0:
-            rates[name] = None
-        else:
-            rates[name] = max(cur - prev, 0.0) / interval_s
+        prev = t0.get(name) or {}
+        rates[name] = {
+            "rps": _rate(row.get("requests_total"),
+                         prev.get("requests_total"), interval_s),
+            "device_s_per_s": _rate(row.get("device_seconds_total"),
+                                    prev.get("device_seconds_total"),
+                                    interval_s),
+            "rows_per_s": _rate(row.get("usage_rows_total"),
+                                prev.get("usage_rows_total"), interval_s),
+        }
     return rates
 
 
+def derive_replica_rates(first: Optional[Dict[str, Any]],
+                         second: Optional[Dict[str, Any]],
+                         interval_s: float
+                         ) -> Dict[str, Dict[str, Optional[float]]]:
+    """Per-replica cost rates from two ``/usage`` polls' per-replica
+    breakdowns, summed over tenants: ``{replica: {device_s_per_s,
+    rows_per_s}}``."""
+    if not first or not second:
+        return {}
+
+    def _totals(doc):
+        out: Dict[str, Dict[str, float]] = {}
+        for rid, tenants in (doc.get("replicas") or {}).items():
+            agg = {"device_seconds": 0.0, "rows": 0.0}
+            for row in tenants.values():
+                agg["device_seconds"] += float(row.get("device_seconds", 0.0))
+                agg["rows"] += float(row.get("rows", 0))
+            out[rid] = agg
+        return out
+
+    prev, cur = _totals(first), _totals(second)
+    return {
+        rid: {
+            "device_s_per_s": _rate(agg["device_seconds"],
+                                    (prev.get(rid) or {}).get(
+                                        "device_seconds"), interval_s),
+            "rows_per_s": _rate(agg["rows"],
+                                (prev.get(rid) or {}).get("rows"),
+                                interval_s),
+        }
+        for rid, agg in cur.items()
+    }
+
+
 def build_report(first: Dict[str, Any], second: Optional[Dict[str, Any]],
-                 interval_s: float) -> Dict[str, Any]:
+                 interval_s: float,
+                 usage_first: Optional[Dict[str, Any]] = None,
+                 usage_second: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
     """The tool's JSON document: the latest status doc plus derived
-    per-tenant rates and a one-word health verdict."""
+    per-tenant rates (requests + cost) and a one-word health verdict."""
     doc = second if second is not None else first
     rates = (derive_rates(first, second, interval_s)
-             if second is not None else
-             {name: None for name in doc.get("tenants", {})})
+             if second is not None else {})
+    replica_rates = derive_replica_rates(usage_first, usage_second,
+                                         interval_s)
     slo_status = (doc.get("slo") or {}).get("status")
     healthy = bool(doc.get("replicas_closed")) and slo_status != "breach"
+
+    def _round(v, nd):
+        return None if v is None else round(v, nd)
+
     tenants = {}
     for name, row in doc.get("tenants", {}).items():
-        tenants[name] = {**row, "rps": (None if rates.get(name) is None
-                                        else round(rates[name], 2))}
+        r = rates.get(name) or {}
+        tenants[name] = {
+            **row,
+            "rps": _round(r.get("rps"), 2),
+            "device_s_per_s": _round(r.get("device_s_per_s"), 4),
+            "rows_per_s": _round(r.get("rows_per_s"), 1),
+        }
     return {
         "metric": "fleet_status",
         "healthy": healthy,
@@ -88,7 +168,13 @@ def build_report(first: Dict[str, Any], second: Optional[Dict[str, Any]],
                            "ejections": st.get("ejections"),
                            "generation": st.get("generation"),
                            "last_healthy_age_s": st.get(
-                               "last_healthy_age_s")}
+                               "last_healthy_age_s"),
+                           "device_s_per_s": _round(
+                               (replica_rates.get(rid) or {}).get(
+                                   "device_s_per_s"), 4),
+                           "rows_per_s": _round(
+                               (replica_rates.get(rid) or {}).get(
+                                   "rows_per_s"), 1)}
                      for rid, st in (doc.get("replicas") or {}).items()},
         "federation": doc.get("federation"),
         "tenants": tenants,
@@ -116,6 +202,10 @@ def render(report: Dict[str, Any]) -> str:
             line += f" ejections={st['ejections']}"
         if st.get("last_healthy_age_s") is not None:
             line += f" last_healthy={st['last_healthy_age_s']}s ago"
+        if st.get("device_s_per_s") is not None:
+            line += f" dev_s/s={st['device_s_per_s']:.4f}"
+        if st.get("rows_per_s") is not None:
+            line += f" rows/s={st['rows_per_s']:.1f}"
         out.append(line)
     fed = report.get("federation") or {}
     line = (f"federation: {fed.get('scrapes', 0)} sweeps, last "
@@ -128,13 +218,19 @@ def render(report: Dict[str, Any]) -> str:
     if tenants:
         name_w = max([len(n) for n in tenants] + [6])
         out.append(f"{'tenant':{name_w}s} {'requests':>9s} {'rps':>8s} "
-                   f"{'p50ms':>9s} {'p99ms':>9s}")
+                   f"{'p50ms':>9s} {'p99ms':>9s} {'dev_s/s':>9s} "
+                   f"{'rows/s':>9s}")
         for name in sorted(tenants):
             t = tenants[name]
             rps = "-" if t.get("rps") is None else f"{t['rps']:.1f}"
+            dev = ("-" if t.get("device_s_per_s") is None
+                   else f"{t['device_s_per_s']:.4f}")
+            rows = ("-" if t.get("rows_per_s") is None
+                    else f"{t['rows_per_s']:.1f}")
             out.append(
                 f"{name:{name_w}s} {t.get('requests', 0):9d} {rps:>8s} "
-                f"{t.get('p50_ms', 0.0):9.3f} {t.get('p99_ms', 0.0):9.3f}")
+                f"{t.get('p50_ms', 0.0):9.3f} {t.get('p99_ms', 0.0):9.3f} "
+                f"{dev:>9s} {rows:>9s}")
     slo = (report.get("slo") or {}).get("objectives") or {}
     if slo:
         out.append("slo objectives:")
@@ -146,13 +242,18 @@ def render(report: Dict[str, Any]) -> str:
 
 
 def collect(url: str, interval_s: float, timeout_s: float = 5.0
-            ) -> Tuple[Dict[str, Any], Optional[Dict[str, Any]]]:
+            ) -> Tuple[Dict[str, Any], Optional[Dict[str, Any]],
+                       Optional[Dict[str, Any]], Optional[Dict[str, Any]]]:
+    """Both polls, each pairing ``/fleet`` with ``/usage`` (the latter
+    tolerated missing) so status and per-replica cost share a window."""
     first = fetch_fleet(url, timeout_s=timeout_s)
-    second = None
+    usage_first = fetch_usage(url, timeout_s=timeout_s)
+    second = usage_second = None
     if interval_s > 0:
         time.sleep(interval_s)
         second = fetch_fleet(url, timeout_s=timeout_s)
-    return first, second
+        usage_second = fetch_usage(url, timeout_s=timeout_s)
+    return first, second, usage_first, usage_second
 
 
 def main(argv=None) -> int:
@@ -168,14 +269,16 @@ def main(argv=None) -> int:
                     help="emit the report as one JSON document")
     args = ap.parse_args(argv)
     try:
-        first, second = collect(args.url, args.interval_s,
-                                timeout_s=args.timeout_s)
+        first, second, usage_first, usage_second = collect(
+            args.url, args.interval_s, timeout_s=args.timeout_s)
     except (urllib.error.URLError, OSError, ValueError,
             json.JSONDecodeError) as e:
         print(f"fleet_status: cannot read {args.url}/fleet: {e}",
               file=sys.stderr)
         return 2
-    report = build_report(first, second, args.interval_s)
+    report = build_report(first, second, args.interval_s,
+                          usage_first=usage_first,
+                          usage_second=usage_second)
     if args.json:
         print(json.dumps(report))
     else:

@@ -169,6 +169,20 @@ A/B timing protocol those notes derived:
   file's ``--list-missing`` cross-reports the sibling so one command
   audits both artifacts.
 
+- **cost-attribution gates (round 23)** — ``cost_attribution``
+  (``tools/cost_drill.py:run_drill``: one multi-tenant serve window with
+  the dispatch profiler AND the usage meter enabled, under the retrace
+  sentry, with a telemetry-history recorder snapshotting between window
+  segments).  Unconditional FAILs (``row_ok``): attributed per-program
+  dispatch wall under 95 % of the measured dispatch-wall window,
+  per-tenant device-seconds not summing to the total within 1 % (an
+  accounting identity, not a noise band), or ANY in-window recompile
+  (kernel-cache misses, usage compile counts, or sentry compiles).  The
+  profiler's own serve cost (``profiler_overhead``, interleaved
+  off/on best-of A/B from the same drill) FAILs above the same fixed
+  3 % ceiling as the tracer; ``cost_attr_rps`` (the measured window's
+  closed-loop throughput) gates against its own median+MAD window.
+
 - **retrace sentry (round 9)** — the timed rounds and the serving window
   both run under ``tools/jaxlint``'s ``retrace_sentry``: after the untimed
   warm-up pass, ANY XLA compilation inside a measurement window is a
@@ -247,7 +261,10 @@ TOL_FACTOR = {"config1_ups": 2.0, "covertype_bf16x3_ups": 1.5,
               # the rollout walls are real-clock stage holds + open-loop
               # replay scheduling; the overhead frac is a p99-vs-p99
               # ratio on a 2-core box — the host-noisiest kind of row
-              "rollout_promote_s": 2.0, "shadow_overhead_frac": 2.0}
+              "rollout_promote_s": 2.0, "shadow_overhead_frac": 2.0,
+              # the cost-drill window is closed-loop serving like the
+              # serve rows — host-scheduling-noisy
+              "cost_attr_rps": 2.0}
 
 #: Every row key judged against a median+MAD incumbent window — the
 #: ``--list-missing`` contract: a key listed here with no history in the
@@ -267,6 +284,7 @@ WINDOWED_ROWS = (
     "multihost_ring_hop_wall_ms", "multihost_updates_per_s",
     "freshness_p99_s",
     "rollout_promote_s", "shadow_overhead_frac",
+    "cost_attr_rps",
 )
 
 #: Windowed rows whose source drill ALSO carries unconditional ``row_ok``
@@ -280,6 +298,7 @@ UNCONDITIONAL_ROW_KEYS = frozenset({
     "multihost_ring_hop_wall_ms", "multihost_updates_per_s",
     "freshness_p99_s",
     "rollout_promote_s", "shadow_overhead_frac",
+    "cost_attr_rps",
 })
 
 #: Hard ceiling on the span tracer's measured serve-bench cost (round 10):
@@ -288,6 +307,12 @@ UNCONDITIONAL_ROW_KEYS = frozenset({
 #: incumbents — "observability that slows the service down" is a regression
 #: by definition, not a noise band question.
 TELEMETRY_OVERHEAD_MAX = 0.03
+
+#: Same fixed-ceiling discipline for the dispatch profiler + usage meter
+#: (round 23): the interleaved off/on A/B inside ``tools/cost_drill.py``
+#: FAILs above this fraction of closed-loop rps — always-on attribution
+#: must stay cheap enough to leave on.
+PROFILER_OVERHEAD_MAX = 0.03
 
 #: Same fixed-ceiling discipline for the posterior diagnostics (round 11):
 #: the diagnostics-on/off A/B over one warmed supervised run
@@ -1338,6 +1363,65 @@ def main():
         if status == "FAIL":
             failures += 1
         results[ov_key] = ov_val
+        print(json.dumps(row), flush=True)
+
+    # cost-attribution gates (round 23): the cost drill — one
+    # multi-tenant serve window with the dispatch profiler + usage meter
+    # enabled under the retrace sentry.  Unconditional FAILs
+    # (cost_drill.row_ok): attributed dispatch wall under 95% of the
+    # measured window, per-tenant device-seconds off the total by more
+    # than 1% (an accounting identity), or any in-window recompile.
+    import cost_drill
+
+    ca_row = cost_drill.run_drill()
+    ca_ok, ca_why = cost_drill.row_ok(ca_row)
+    row = {"bench": "cost_attribution",
+           "coverage": ca_row.get("coverage"),
+           "attributed_s": ca_row.get("attributed_s"),
+           "measured_device_s": ca_row.get("measured_device_s"),
+           "tenant_device_s": ca_row.get("tenant_device_s"),
+           "tenant_sum_err_frac": ca_row.get("tenant_sum_err_frac"),
+           "recompiles": ca_row.get("recompiles"),
+           "sentry_compiles": ca_row.get("sentry_compiles"),
+           "history_records": ca_row.get("history_records"),
+           "history_anomalies": ca_row.get("history_anomalies")}
+    if not ca_ok:
+        row["status"] = "FAIL"
+        row["error"] = "; ".join(ca_why)
+        failures += 1
+    else:
+        row["status"] = "PASS"
+    print(json.dumps(row), flush=True)
+
+    # profiler-overhead gate: the drill's interleaved off/on A/B against
+    # the same fixed ceiling as the tracer — never recorded as an
+    # incumbent ("attribution that slows serving down" is a regression
+    # by definition)
+    ca_ov = ca_row.get("profiler_overhead_frac")
+    row = {"bench": "profiler_overhead", "value": ca_ov,
+           "unit": "fraction of serve rps lost with profiler+metering on",
+           "rps_disabled": ca_row.get("rps_disabled"),
+           "rps_enabled": ca_row.get("rps_enabled"),
+           "ceiling": PROFILER_OVERHEAD_MAX}
+    if ca_ov is None or ca_ov > PROFILER_OVERHEAD_MAX:
+        row["status"] = "FAIL"
+        failures += 1
+    else:
+        row["status"] = "PASS"
+    print(json.dumps(row), flush=True)
+
+    if ca_ok:
+        ca_key = "cost_attr_rps"
+        ca_val = ca_row.get("rps")
+        row = {"bench": ca_key, "value": ca_val, "unit": "req/s"}
+        tol = min(args.tol * TOL_FACTOR.get(ca_key, 1.0), 0.9)
+        status, info = judge_row(
+            ca_val, incumbent_history(incumbents, ca_key), tol, True)
+        row.update(info)
+        row["status"] = status
+        if status == "FAIL":
+            failures += 1
+        results[ca_key] = ca_val
         print(json.dumps(row), flush=True)
 
     print(json.dumps({

@@ -36,6 +36,15 @@ found their replica tree — the fleet drill gates this at 1.0 in fake
 mode), and the orphan count (replica traces whose router export is
 missing — reported, never crashing).
 
+``--programs`` (round 23) renders the **per-program cost attribution**
+view instead of a span summary: the top programs by fenced dispatch
+self-time from the ``svgd_prog_*`` series the dispatch profiler
+(``telemetry/profile.py``) writes — per ``plan://<label>`` identity:
+dispatches, total seconds, mean ms, share of attributed wall, rows and
+input bytes.  Input is a saved ``MetricsRegistry.dump()`` JSON (e.g. a
+``/metrics.dump`` fetch) or a telemetry **history directory**
+(``telemetry/history.py`` ring), whose window deltas are summed.
+
 A missing, empty, or corrupt input — including a stitch export without a
 process header or clock anchor — exits with one line on stderr and a
 nonzero status (2) — no tracebacks from the CLI.
@@ -47,10 +56,13 @@ Usage::
     python tools/trace_report.py serve.jsonl --top 5
     python tools/trace_report.py postmortem_001_guard_violation.jsonl --postmortem
     python tools/trace_report.py --stitch router.json replica0.json replica1.json
+    python tools/trace_report.py --programs metrics_dump.json
+    python tools/trace_report.py --programs telemetry_history_dir/ --top 5
 """
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -546,6 +558,86 @@ def render_postmortem(header, snapshot, diagnostics, events, top=10):
     return "\n".join(out)
 
 
+#: The dispatch profiler's metric names (telemetry/profile.py) — read
+#: from dump documents here so the tool stays importable without jax.
+_PROG_SECONDS = "svgd_prog_dispatch_seconds"
+_PROG_ROWS = "svgd_prog_dispatch_rows_total"
+_PROG_BYTES = "svgd_prog_dispatch_bytes_total"
+
+
+def load_program_dumps(path):
+    """The dump documents behind one ``--programs`` input: a metrics
+    dump JSON file → ``[dump]``; a telemetry history directory → every
+    record's window delta (summed downstream)."""
+    if os.path.isdir(path):
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from dist_svgd_tpu.telemetry.history import TelemetryHistory
+
+        records = TelemetryHistory(path).records()
+        if not records:
+            raise ValueError("no telemetry history records in directory")
+        return [rec.get("window", {}) for rec in records]
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or "metrics" not in doc:
+        raise ValueError("not a MetricsRegistry.dump() document")
+    return [doc]
+
+
+def program_rows(dumps):
+    """Per-label attribution rows summed over ``dumps``, sorted by total
+    dispatch seconds (descending).  Federated ``replica``-labelled
+    series are skipped — the rollup series already carry the total."""
+    agg = {}
+    for dump in dumps:
+        metrics = dump.get("metrics", {})
+        for name, key in ((_PROG_SECONDS, None), (_PROG_ROWS, "rows"),
+                          (_PROG_BYTES, "bytes")):
+            for s in (metrics.get(name) or {}).get("series", []):
+                labels = s.get("labels") or {}
+                if "replica" in labels:
+                    continue
+                label = labels.get("label", "")
+                row = agg.setdefault(label, {
+                    "label": label, "dispatches": 0, "seconds": 0.0,
+                    "rows": 0, "bytes": 0,
+                })
+                if key is None:  # the histogram: sum + count
+                    row["seconds"] += float(s.get("sum", 0.0) or 0.0)
+                    row["dispatches"] += int(s.get("count", 0) or 0)
+                else:
+                    row[key] += int(s.get("value", 0) or 0)
+    rows = sorted(agg.values(), key=lambda r: -r["seconds"])
+    total = sum(r["seconds"] for r in rows)
+    for r in rows:
+        r["mean_ms"] = (1e3 * r["seconds"] / r["dispatches"]
+                        if r["dispatches"] else 0.0)
+        r["share"] = (r["seconds"] / total) if total > 0 else 0.0
+    return {"metric": "program_attribution", "windows": len(dumps),
+            "total_seconds": total, "programs": rows}
+
+
+def render_programs(report, top=10):
+    rows = report["programs"][:top]
+    out = [f"program attribution: {len(report['programs'])} programs, "
+           f"{report['total_seconds']:.4f} s attributed over "
+           f"{report['windows']} window(s)"]
+    if not rows:
+        return (out[0] + " (no svgd_prog_* series — was the dispatch "
+                "profiler enabled?)")
+    label_w = max([len(r["label"]) for r in rows] + [7])
+    out.append(f"{'program':{label_w}s} {'disp':>8s} {'total_s':>10s} "
+               f"{'mean_ms':>9s} {'share':>7s} {'rows':>12s} {'MB':>10s}")
+    for r in rows:
+        out.append(
+            f"{r['label']:{label_w}s} {r['dispatches']:8d} "
+            f"{r['seconds']:10.4f} {r['mean_ms']:9.3f} "
+            f"{100 * r['share']:6.1f}% {r['rows']:12d} "
+            f"{r['bytes'] / 1e6:10.2f}")
+    return "\n".join(out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", nargs="+",
@@ -565,13 +657,39 @@ def main(argv=None):
                     help="join router + replica exports into one tree per "
                          "request on the X-Fleet-Trace ids (files "
                          "self-identify via their process headers)")
+    ap.add_argument("--programs", action="store_true",
+                    help="render the dispatch profiler's per-program cost "
+                         "attribution (input: a metrics dump JSON or a "
+                         "telemetry history directory) instead of a span "
+                         "summary")
     args = ap.parse_args(argv)
-    if args.stitch and args.postmortem:
-        ap.error("--stitch and --postmortem are mutually exclusive")
+    if sum((args.stitch, args.postmortem, args.programs)) > 1:
+        ap.error("--stitch, --postmortem and --programs are mutually "
+                 "exclusive")
     if not args.stitch and len(args.trace) != 1:
         ap.error("exactly one trace file expected (pass --stitch to join "
                  "several exports)")
     trace_path = args.trace[0]
+
+    if args.programs:
+        try:
+            report = program_rows(load_program_dumps(trace_path))
+        except OSError as e:
+            print(f"trace_report: cannot read {e.filename or trace_path}: "
+                  f"{e.strerror or e}", file=sys.stderr)
+            return 2
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError,
+                TypeError) as e:
+            print(f"trace_report: {trace_path} is not a metrics dump or "
+                  f"telemetry history: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            doc = dict(report)
+            doc["programs"] = doc["programs"][:args.top]
+            print(json.dumps(doc))
+        else:
+            print(render_programs(report, top=args.top))
+        return 0
 
     try:
         if args.stitch:
